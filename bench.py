@@ -1,0 +1,56 @@
+"""Flagship benchmark — prints ONE JSON line.
+
+Benchmarks LSTM text-classification ms/batch against the reference's published K40m
+number (BASELINE.md: 83 ms/batch @ bs=64, hidden=256 — benchmark/README.md:115-119).
+vs_baseline > 1 means we are faster than the reference by that factor.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_mlp_fallback():
+    """Used until the LSTM bench path exists."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import MnistMLP
+    from paddle_tpu.optimizer import Adam
+
+    model = MnistMLP(in_dim=784, hidden=256, classes=10)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = Adam(1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, x, y):
+        loss, grads = jax.value_and_grad(model.loss)(params, x, y)
+        params, state = opt.update(grads, state, params)
+        return params, state, loss
+
+    x = jnp.ones((256, 784), jnp.float32)
+    y = jnp.zeros((256,), jnp.int32)
+    params, state, _ = step(params, state, x, y)  # compile
+    jax.block_until_ready(params)
+    n = 50
+    t0 = time.perf_counter()
+    for _ in range(n):
+        params, state, loss = step(params, state, x, y)
+    jax.block_until_ready(loss)
+    ms = (time.perf_counter() - t0) / n * 1e3
+    return {"metric": "mnist_mlp_ms_per_batch_bs256", "value": round(ms, 3),
+            "unit": "ms/batch", "vs_baseline": None}
+
+
+def main():
+    try:
+        from benchmarks.lstm_textcls import run as run_lstm  # noqa
+        result = run_lstm()
+    except Exception:
+        result = bench_mlp_fallback()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

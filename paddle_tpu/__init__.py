@@ -1,0 +1,19 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capability set of early PaddlePaddle (reference at
+/root/reference, see SURVEY.md): op/layer zoo, LoD variable-length sequences,
+optimizers, readers/datasets, trainer with events/evaluators/checkpoints, and
+distributed training — designed TPU-first on JAX/XLA/Pallas/pjit: compute lowers to
+HLO onto the MXU, parallelism is SPMD over a jax.sharding.Mesh with XLA collectives
+over ICI/DCN (replacing the reference's pserver/RDMA/NCCL paths), and the host runtime
+(stats, queues, data master) is native C++.
+"""
+
+__version__ = "0.1.0"
+
+from . import core, nn, ops, optimizer, utils
+from .core import CPUPlace, Place, SeqBatch, TPUPlace, sequence_mask
+
+__all__ = ["core", "nn", "ops", "optimizer", "utils", "models",
+           "Place", "TPUPlace", "CPUPlace", "SeqBatch", "sequence_mask",
+           "__version__"]

@@ -1,0 +1,9 @@
+from .lod import (SeqBatch, bucket_length, lengths_from_lod, lod_from_lengths,
+                  pack_sequences, sequence_mask)
+from .place import CPUPlace, DeviceContext, Place, TPUPlace, default_place
+
+__all__ = [
+    "SeqBatch", "sequence_mask", "pack_sequences", "bucket_length",
+    "lod_from_lengths", "lengths_from_lod",
+    "Place", "TPUPlace", "CPUPlace", "DeviceContext", "default_place",
+]

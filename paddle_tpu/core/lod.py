@@ -1,0 +1,118 @@
+"""Variable-length (LoD) sequence batches under XLA's static-shape regime.
+
+The reference represents ragged minibatches without padding:
+``Argument.sequenceStartPositions`` / ``subSequenceStartPositions``
+(paddle/parameter/Argument.h:84-90) in gen-1 and ``LoDTensor`` — tensor + level-of-detail
+nested offsets — in gen-2 (paddle/framework/lod_tensor.h:57,82). Layers then re-pack
+sequences to step-major batches (gserver/layers/SequenceToBatch.cpp,
+operators/math/sequence2batch.cc).
+
+On TPU, compiled shapes must be static, so the canonical batch form here is
+**padded-dense + lengths (+ nested lod kept host-side)**:
+
+* ``data``:   [batch, max_len, ...] padded along the time axis
+* ``lengths``:[batch] int32 valid lengths
+* ``lod``:    optional tuple of host-side offset tuples for nesting levels >= 2
+              (level 0 is implied by ``lengths``)
+
+``SeqBatch`` is a pytree, so it flows through jit/grad/pjit. Masking helpers replace the
+reference's shrink-live-batch machinery (lod_rank_table + shrink_rnn_memory_op):
+sorting-by-length is unnecessary when every step is masked, and XLA pads the cost away
+in fused elementwise work.
+
+Bucketing (``bucket_length``) bounds the number of distinct compiled shapes — the analog
+of the reference's shape-keyed recompile avoidance concern (SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class SeqBatch:
+    """A padded ragged batch: data [B, T, ...] + lengths [B]."""
+
+    data: jax.Array
+    lengths: jax.Array
+    # host-side nested offsets for sub-sequences (gen-2 LoD levels beyond the first);
+    # static metadata, not traced.
+    lod: Optional[Tuple[Tuple[int, ...], ...]] = None
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.lengths), self.lod
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, lengths = children
+        return cls(data, lengths, aux)
+
+    # -- shape helpers -----------------------------------------------------
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.float32) -> jax.Array:
+        """[B, T] 1.0 where a timestep is valid."""
+        return sequence_mask(self.lengths, self.max_len, dtype)
+
+
+def sequence_mask(lengths: jax.Array, max_len: int, dtype=jnp.float32) -> jax.Array:
+    """[B, T] validity mask from lengths — the workhorse replacing LoD offsets on device."""
+    pos = jnp.arange(max_len, dtype=lengths.dtype)
+    return (pos[None, :] < lengths[:, None]).astype(dtype)
+
+
+def bucket_length(n: int, buckets: Sequence[int] = (8, 16, 32, 64, 128, 256, 512, 1024)) -> int:
+    """Round a max sequence length up to a fixed bucket to bound recompiles."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(n)
+
+
+def pack_sequences(seqs: Sequence[np.ndarray], max_len: Optional[int] = None,
+                   pad_value=0, bucket: bool = True) -> SeqBatch:
+    """Host-side: list of per-example [len, ...] arrays -> padded SeqBatch.
+
+    The feeder-side analog of DataProviderConverter building an Argument
+    (py_paddle/dataprovider_converter.py:247).
+    """
+    if not seqs:
+        raise ValueError("pack_sequences: empty sequence list")
+    seqs = [np.asarray(s) for s in seqs]
+    lengths = np.array([s.shape[0] for s in seqs], dtype=np.int32)
+    tmax = int(max_len if max_len is not None else max(1, lengths.max(initial=1)))
+    if bucket and max_len is None:
+        tmax = bucket_length(tmax)
+    feat_shape = seqs[0].shape[1:]
+    out = np.full((len(seqs), tmax) + feat_shape, pad_value, dtype=seqs[0].dtype)
+    for i, s in enumerate(seqs):
+        n = min(s.shape[0], tmax)
+        out[i, :n] = s[:n]
+        lengths[i] = n
+    return SeqBatch(jnp.asarray(out), jnp.asarray(lengths))
+
+
+def lod_from_lengths(lengths: Sequence[int]) -> Tuple[int, ...]:
+    """Offsets vector from lengths — same shape as LoD level offsets
+    (framework/lod_tensor.h:57)."""
+    off = [0]
+    for n in lengths:
+        off.append(off[-1] + int(n))
+    return tuple(off)
+
+
+def lengths_from_lod(offsets: Sequence[int]) -> Tuple[int, ...]:
+    return tuple(int(offsets[i + 1] - offsets[i]) for i in range(len(offsets) - 1))

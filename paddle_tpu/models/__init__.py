@@ -1,0 +1,3 @@
+from .mlp import MnistMLP
+
+__all__ = ["MnistMLP"]

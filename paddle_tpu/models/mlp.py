@@ -1,0 +1,35 @@
+"""MNIST MLP — the minimum end-to-end model.
+
+Analog of the reference's acceptance test
+``python/paddle/v2/fluid/tests/book/test_recognize_digits_mlp.py`` (two 128-unit relu
+hidden layers + softmax-10) and the v1 demo ``v1_api_demo/mnist/``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+
+
+class MnistMLP(nn.Module):
+    def __init__(self, in_dim: int = 784, hidden: int = 128, classes: int = 10):
+        super().__init__()
+        self.fc1 = nn.Linear(in_dim, hidden, act="relu")
+        self.fc2 = nn.Linear(hidden, hidden, act="relu")
+        self.out = nn.Linear(hidden, classes)
+
+    def __call__(self, params, x, **kw):
+        h = self.fc1(params["fc1"], x)
+        h = self.fc2(params["fc2"], h)
+        return self.out(params["out"], h)  # logits
+
+    def loss(self, params, x, labels):
+        logits = self(params, x)
+        return jnp.mean(L.softmax_with_cross_entropy(logits, labels))
+
+    def accuracy(self, params, x, labels):
+        logits = self(params, x)
+        return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))

@@ -1,0 +1,11 @@
+from .initializer import constant, gen1_default, msra, normal, ones, uniform, xavier, zeros
+from .layers import (AvgPool2D, BatchNorm, Conv2D, Conv2DTranspose, Dropout,
+                     Embedding, Fc, LayerNorm, Linear, MaxPool2D)
+from .module import Lambda, Module, Sequential, apply_stat_updates, param_count
+
+__all__ = [
+    "Module", "Sequential", "Lambda", "param_count", "apply_stat_updates",
+    "Linear", "Fc", "Embedding", "Conv2D", "Conv2DTranspose", "BatchNorm",
+    "LayerNorm", "Dropout", "MaxPool2D", "AvgPool2D",
+    "constant", "zeros", "ones", "uniform", "normal", "xavier", "msra", "gen1_default",
+]

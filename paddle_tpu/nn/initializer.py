@@ -1,0 +1,82 @@
+"""Parameter initializers.
+
+Analog of python/paddle/v2/fluid/initializer.py (Constant/Uniform/Normal/Xavier/MSRA)
+and the gen-1 ``initial_std``/``initial_mean`` ParameterConfig fields
+(proto/ParameterConfig.proto).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[[jax.Array, Tuple[int, ...], jnp.dtype], jax.Array]
+
+
+def constant(value: float = 0.0) -> Initializer:
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+    return init
+
+
+zeros = constant(0.0)
+ones = constant(1.0)
+
+
+def uniform(low: float = -1.0, high: float = 1.0) -> Initializer:
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, low, high)
+    return init
+
+
+def normal(mean: float = 0.0, std: float = 1.0) -> Initializer:
+    def init(key, shape, dtype=jnp.float32):
+        return mean + std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def _fans(shape: Sequence[int]) -> Tuple[int, int]:
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [kh, kw, cin, cout]
+    receptive = 1
+    for s in shape[:-2]:
+        receptive *= s
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def xavier(uniform_dist: bool = True) -> Initializer:
+    """Glorot init (ref: fluid/initializer.py XavierInitializer)."""
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        if uniform_dist:
+            limit = math.sqrt(6.0 / (fan_in + fan_out))
+            return jax.random.uniform(key, shape, dtype, -limit, limit)
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def msra(uniform_dist: bool = False) -> Initializer:
+    """He init (ref: fluid/initializer.py MSRAInitializer)."""
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        if uniform_dist:
+            limit = math.sqrt(6.0 / fan_in)
+            return jax.random.uniform(key, shape, dtype, -limit, limit)
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def gen1_default(initial_std: float = None) -> Initializer:
+    """Gen-1 default: N(0, 1/sqrt(fan_in)) (ref: parameter/Parameter.cpp randomize)."""
+    def init(key, shape, dtype=jnp.float32):
+        std = initial_std if initial_std is not None else 1.0 / math.sqrt(_fans(shape)[0])
+        return std * jax.random.normal(key, shape, dtype)
+    return init

@@ -1,0 +1,194 @@
+"""Core layers.
+
+The user-facing layer zoo, re-providing the reference's gserver layers
+(gserver/layers/: FullyConnectedLayer, ConvBaseLayer + exconv/cudnn_conv variants,
+BatchNormalizationLayer, embeddings via TableProjection, pooling layers, MixedLayer
+projections) and the fluid layer builders (python/paddle/v2/fluid/layers.py: fc:18,
+embedding:90, conv2d:638, batch_norm:765). Each layer is a Module: params are explicit,
+__call__ is pure, XLA fuses the bias/activation into the matmul/conv.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import activations as A
+from ..ops import conv as conv_ops
+from ..ops import norm as norm_ops
+from ..ops import pool as pool_ops
+from ..ops.random import dropout as dropout_op
+from . import initializer as I
+from .module import Module
+
+
+def _act(act: Union[None, str, Callable]):
+    if act is None:
+        return lambda x: x
+    if callable(act):
+        return act
+    return A.get(act)
+
+
+class Linear(Module):
+    """Fully-connected layer (ref: gserver/layers/FullyConnectedLayer.cpp; fluid fc)."""
+
+    def __init__(self, in_dim: int, out_dim: int, act: Union[None, str, Callable] = None,
+                 bias: bool = True, w_init: Optional[I.Initializer] = None,
+                 name: str = "fc"):
+        super().__init__()
+        self.in_dim, self.out_dim = in_dim, out_dim
+        self.act = _act(act)
+        self.use_bias = bias
+        self.param("w", (in_dim, out_dim), w_init or I.xavier())
+        if bias:
+            self.param("b", (out_dim,), I.zeros)
+
+    def __call__(self, params, x, **kw):
+        x = x.reshape((x.shape[0], -1)) if x.ndim > 2 and x.shape[-1] != self.in_dim else x
+        y = jnp.matmul(x, params["w"])
+        if self.use_bias:
+            y = y + params["b"]
+        return self.act(y)
+
+
+# gen-1 name
+Fc = Linear
+
+
+class Embedding(Module):
+    """Lookup table (ref: gserver TableProjection/table_projection; fluid embedding:90;
+    operators/lookup_table_op.cc — the sparse-grad path becomes SelectedRows-style
+    updates in optimizer.sparse)."""
+
+    def __init__(self, vocab_size: int, dim: int, padding_idx: Optional[int] = None,
+                 w_init: Optional[I.Initializer] = None):
+        super().__init__()
+        self.vocab_size, self.dim = vocab_size, dim
+        self.padding_idx = padding_idx
+        self.param("w", (vocab_size, dim), w_init or I.normal(0.0, 0.01))
+
+    def __call__(self, params, ids, **kw):
+        out = jnp.take(params["w"], ids, axis=0)
+        if self.padding_idx is not None:
+            out = jnp.where((ids == self.padding_idx)[..., None], 0.0, out)
+        return out
+
+
+class Conv2D(Module):
+    """2-D conv + bias + act, NHWC (ref: gserver/layers/ExpandConvLayer.cpp /
+    CudnnConvLayer.cpp; fluid conv2d:638)."""
+
+    def __init__(self, in_ch: int, out_ch: int, kernel: Union[int, Tuple[int, int]],
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 act: Union[None, str, Callable] = None, bias: bool = True,
+                 w_init: Optional[I.Initializer] = None):
+        super().__init__()
+        k = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
+        self.act = _act(act)
+        self.use_bias = bias
+        self.param("w", k + (in_ch // groups, out_ch), w_init or I.msra())
+        if bias:
+            self.param("b", (out_ch,), I.zeros)
+
+    def __call__(self, params, x, **kw):
+        y = conv_ops.conv2d(x, params["w"], stride=self.stride, padding=self.padding,
+                            dilation=self.dilation, groups=self.groups)
+        if self.use_bias:
+            y = y + params["b"]
+        return self.act(y)
+
+
+class Conv2DTranspose(Module):
+    """ref: operators/conv_transpose_op.cc."""
+
+    def __init__(self, in_ch: int, out_ch: int, kernel, stride=1, padding=0,
+                 act=None, bias: bool = True):
+        super().__init__()
+        k = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.stride, self.padding = stride, padding
+        self.act = _act(act)
+        self.use_bias = bias
+        self.param("w", k + (in_ch, out_ch), I.msra())
+        if bias:
+            self.param("b", (out_ch,), I.zeros)
+
+    def __call__(self, params, x, **kw):
+        y = conv_ops.conv2d_transpose(x, params["w"], stride=self.stride,
+                                      padding=self.padding)
+        if self.use_bias:
+            y = y + params["b"]
+        return self.act(y)
+
+
+class BatchNorm(Module):
+    """Functional batch norm (ref: 3 BN impls in gserver + operators/batch_norm_op.cc).
+
+    Running stats are non-trainable ``stat`` buffers (excluded from optimizer
+    updates/decay). In train mode the updated stats are recorded into the
+    ``mutable`` collector; merge them back with ``nn.apply_stat_updates``.
+    """
+
+    def __init__(self, channels: int, momentum: float = 0.9, eps: float = 1e-5,
+                 act: Union[None, str, Callable] = None):
+        super().__init__()
+        self.momentum, self.eps = momentum, eps
+        self.act = _act(act)
+        self.param("gamma", (channels,), I.ones)
+        self.param("beta", (channels,), I.zeros)
+        self.stat("moving_mean", (channels,), I.zeros)
+        self.stat("moving_var", (channels,), I.ones)
+
+    def __call__(self, params, x, train: bool = False, mutable=None, **kw):
+        y, nm, nv = norm_ops.batch_norm(
+            x, params["gamma"], params["beta"], params["stats"]["moving_mean"],
+            params["stats"]["moving_var"], train=train, momentum=self.momentum,
+            eps=self.eps)
+        if train:
+            self.record_stats(mutable, {"moving_mean": nm, "moving_var": nv})
+        return self.act(y)
+
+
+class LayerNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-5):
+        super().__init__()
+        self.eps = eps
+        self.param("gamma", (dim,), I.ones)
+        self.param("beta", (dim,), I.zeros)
+
+    def __call__(self, params, x, **kw):
+        return norm_ops.layer_norm(x, params["gamma"], params["beta"], self.eps)
+
+
+class Dropout(Module):
+    """ref: operators/dropout_op.cc; needs rng passed at call time."""
+
+    def __init__(self, rate: float):
+        super().__init__()
+        self.rate = rate
+
+    def __call__(self, params, x, train: bool = False, rng: Optional[jax.Array] = None, **kw):
+        if not train or rng is None:
+            return x
+        return dropout_op(x, self.rate, rng, train=True)
+
+
+class MaxPool2D(Module):
+    def __init__(self, kernel, stride=None, padding=0):
+        super().__init__()
+        self.kernel, self.stride, self.padding = kernel, stride, padding
+
+    def __call__(self, params, x, **kw):
+        return pool_ops.max_pool2d(x, self.kernel, self.stride, self.padding)
+
+
+class AvgPool2D(Module):
+    def __init__(self, kernel, stride=None, padding=0):
+        super().__init__()
+        self.kernel, self.stride, self.padding = kernel, stride, padding
+
+    def __call__(self, params, x, **kw):
+        return pool_ops.avg_pool2d(x, self.kernel, self.stride, self.padding)

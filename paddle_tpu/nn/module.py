@@ -1,0 +1,186 @@
+"""Minimal functional module system.
+
+This replaces the reference's ``Layer`` base + ``NeuralNetwork`` topological executor
+(gserver/layers/Layer.h:62, gserver/gradientmachines/NeuralNetwork.cpp:247-297) with a
+TPU-idiomatic design: a Module is a *declaration* of parameters + a pure ``__call__``
+over an explicit params pytree. There is no forward/backward pair per layer — JAX
+autodiff derives the backward, and XLA schedules the whole graph (the reference's
+per-layer timers/order bookkeeping disappears into the compiler).
+
+Conventions:
+* parameters declared in ``__init__`` via ``self.param(name, shape, init)``;
+  child modules assigned as attributes are auto-registered.
+* ``module.init(rng)`` -> nested dict pytree of arrays (a "ParameterMap", the analog of
+  paddle.v2.parameters.Parameters).
+* ``module(params, *args, train=False)`` is pure; dropout/BN take an explicit ``rng`` /
+  mutable-state convention (BN returns updated stats when train=True).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .initializer import Initializer, gen1_default
+
+
+class _ParamSpec:
+    __slots__ = ("shape", "init", "dtype")
+
+    def __init__(self, shape, init, dtype):
+        self.shape = tuple(shape)
+        self.init = init
+        self.dtype = dtype
+
+
+class Module:
+    """Base class; subclasses declare params/children in __init__.
+
+    Two buffer kinds, mirroring the reference's typed parameter buffers
+    (parameter/Parameter.h:60 bufs_[PARAMETER_VALUE/GRADIENT/MOMENTUM...]):
+    * ``param`` — trainable; lives directly in the module's params subtree.
+    * ``stat`` — non-trainable running state (e.g. BN moving stats); lives under a
+      ``"stats"`` key in the subtree. Optimizers skip any leaf under ``"stats"``.
+      Train-mode updates are collected through the ``mutable`` dict passed at call
+      time and merged back with :func:`apply_stat_updates`.
+    """
+
+    def __init__(self):
+        object.__setattr__(self, "_param_specs", {})
+        object.__setattr__(self, "_stat_specs", {})
+        object.__setattr__(self, "_children", {})
+        object.__setattr__(self, "_path", "")
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Module):
+            self._children[name] = value
+        elif isinstance(value, (list, tuple)) and value and all(isinstance(v, Module) for v in value):
+            for i, v in enumerate(value):
+                self._children[f"{name}_{i}"] = v
+        object.__setattr__(self, name, value)
+
+    def param(self, name: str, shape, init: Optional[Initializer] = None,
+              dtype=jnp.float32) -> str:
+        """Declare a parameter; returns its name for later lookup in the params dict."""
+        if init is None:
+            init = gen1_default()
+        self._param_specs[name] = _ParamSpec(shape, init, dtype)
+        return name
+
+    def stat(self, name: str, shape, init: Optional[Initializer] = None,
+             dtype=jnp.float32) -> str:
+        """Declare non-trainable running state (BN moving stats etc.)."""
+        if init is None:
+            init = gen1_default()
+        self._stat_specs[name] = _ParamSpec(shape, init, dtype)
+        return name
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        """Build the nested params dict for this module tree (assigns paths)."""
+        self._assign_paths("")
+        return self._init(rng)
+
+    def _assign_paths(self, path: str):
+        object.__setattr__(self, "_path", path)
+        for name, child in self._children.items():
+            child._assign_paths(f"{path}/{name}" if path else name)
+
+    def _init(self, rng: jax.Array) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        n = len(self._param_specs) + len(self._stat_specs) + len(self._children)
+        keys = jax.random.split(rng, max(1, n))
+        i = 0
+        for name, spec in self._param_specs.items():
+            out[name] = spec.init(keys[i], spec.shape, spec.dtype)
+            i += 1
+        if self._stat_specs:
+            stats = {}
+            for name, spec in self._stat_specs.items():
+                stats[name] = spec.init(keys[i], spec.shape, spec.dtype)
+                i += 1
+            out["stats"] = stats
+        for name, child in self._children.items():
+            out[name] = child._init(keys[i])
+            i += 1
+        return out
+
+    def record_stats(self, mutable, updates: Dict[str, jax.Array]):
+        """Record train-mode stat updates into the caller-provided collector."""
+        if mutable is not None:
+            mutable[self._path] = updates
+
+    def sublayers(self) -> Dict[str, "Module"]:
+        return dict(self._children)
+
+    def __call__(self, params, *args, **kwargs):
+        raise NotImplementedError
+
+    # convenience: iterate (path, leaf) over a params dict built by this module
+    @staticmethod
+    def named_parameters(params, prefix: str = "") -> List[Tuple[str, jax.Array]]:
+        out = []
+        for k, v in params.items():
+            path = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                out.extend(Module.named_parameters(v, path))
+            else:
+                out.append((path, v))
+        return out
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def apply_stat_updates(params, mutable: Dict[str, Dict[str, jax.Array]]):
+    """Merge collected stat updates (path -> {name: value}) back into params.
+
+    Use with the train step:
+        def loss_fn(p):
+            mut = {}
+            out = model(p, x, train=True, mutable=mut)
+            return loss(out), mut
+        (l, mut), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params = apply_stat_updates(opt_params, mut)
+    """
+    if not mutable:
+        return params
+    params = dict(params)
+    for path, updates in mutable.items():
+        node = params
+        keys = [k for k in path.split("/") if k]
+        for k in keys:
+            node[k] = dict(node[k])
+            node = node[k]
+        stats = dict(node.get("stats", {}))
+        stats.update(updates)
+        node["stats"] = stats
+    return params
+
+
+class Sequential(Module):
+    """Chain of modules applied in order (topological list — the degenerate
+    NeuralNetwork.cpp:259 layer loop)."""
+
+    def __init__(self, *mods: Module):
+        super().__init__()
+        self.mods = list(mods)
+
+    def __call__(self, params, x, **kw):
+        for i, m in enumerate(self.mods):
+            x = m(params[f"mods_{i}"], x, **kw)
+        return x
+
+
+class Lambda(Module):
+    """Parameter-free function as a module."""
+
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self.fn = fn
+
+    def __call__(self, params, x, **kw):
+        return self.fn(x)

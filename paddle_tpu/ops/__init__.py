@@ -1,0 +1,3 @@
+from . import activations, conv, loss, math, norm, pool, random
+
+__all__ = ["math", "activations", "loss", "conv", "pool", "norm", "random"]

@@ -1,0 +1,180 @@
+"""Activation functions.
+
+The reference registers 17 activations with hand-written forward/backward pairs
+(paddle/gserver/activations/ActivationFunction.cpp:97-441) and a gen-2 op family
+(paddle/operators/activation_op.cc, ~20 registrations). Here each is a pure function —
+JAX autodiff provides the backward, XLA fuses them into adjacent matmuls (the fusion the
+reference had to do by hand in hl_* kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.registry import Registry
+
+ACTIVATIONS = Registry("activation")
+
+
+def _reg(name):
+    def deco(fn):
+        ACTIVATIONS.register(name, fn)
+        return fn
+    return deco
+
+
+@_reg("linear")
+@_reg("identity")
+def identity(x):
+    return x
+
+
+@_reg("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@_reg("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@_reg("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@_reg("brelu")
+def brelu(x, t_min=0.0, t_max=24.0):
+    """bounded relu (ref ActivationFunction.cpp brelu, operators/activation_op.cc BRelu)."""
+    return jnp.clip(x, t_min, t_max)
+
+
+@_reg("relu6")
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+@_reg("leaky_relu")
+def leaky_relu(x, alpha=0.02):
+    return jax.nn.leaky_relu(x, alpha)
+
+
+@_reg("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@_reg("gelu")
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+@_reg("softrelu")
+def softrelu(x, threshold=40.0):
+    """log(1+exp(x)) with clipping (ref: softrelu in ActivationFunction.cpp)."""
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+softplus = ACTIVATIONS.register("softplus", jax.nn.softplus)
+
+
+@_reg("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@_reg("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    """scaled tanh (ref: stanh)."""
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@_reg("hard_sigmoid")
+def hard_sigmoid(x, slope=0.2, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@_reg("hard_shrink")
+def hard_shrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@_reg("soft_shrink")
+def soft_shrink(x, lam=0.5):
+    return jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0))
+
+
+@_reg("thresholded_relu")
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@_reg("abs")
+def abs_act(x):
+    return jnp.abs(x)
+
+
+@_reg("square")
+def square(x):
+    return jnp.square(x)
+
+
+@_reg("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@_reg("exponential")
+@_reg("exp")
+def exponential(x):
+    return jnp.exp(x)
+
+
+@_reg("log")
+def log(x):
+    return jnp.log(x)
+
+
+@_reg("reciprocal")
+def reciprocal(x):
+    return 1.0 / x
+
+
+@_reg("pow")
+def pow_act(x, factor=1.0):
+    return jnp.power(x, factor)
+
+
+@_reg("swish")
+def swish(x, beta=1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+@_reg("softmax")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@_reg("log_softmax")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def sequence_softmax(x, lengths, axis=1):
+    """softmax over valid timesteps of a padded sequence batch [B, T, ...]
+    (ref: sequence_softmax in ActivationFunction.cpp / operators/sequence_softmax_op.cc)."""
+    from ..core.lod import sequence_mask
+    mask = sequence_mask(lengths, x.shape[axis], jnp.bool_)
+    shape = [1] * x.ndim
+    shape[0], shape[axis] = mask.shape
+    mask = mask.reshape(shape)
+    neg = jnp.finfo(x.dtype).min
+    z = jnp.where(mask, x, neg)
+    out = jax.nn.softmax(z, axis=axis)
+    return jnp.where(mask, out, 0.0)
+
+
+def get(name: str):
+    return ACTIVATIONS.get(name)

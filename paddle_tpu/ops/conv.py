@@ -1,0 +1,117 @@
+"""Convolution ops.
+
+Replaces the reference's conv stack — im2col+gemm (paddle/function/GemmConvOp.cpp,
+operators/conv_op.cc), cuDNN conv (gserver/layers/CudnnConvLayer.cpp,
+operators/conv_cudnn_op.cc), depthwise (function/DepthwiseConvOp.cpp), transpose conv
+(operators/conv_transpose_op.cc) — with ``lax.conv_general_dilated``, which XLA lowers
+straight onto the MXU. Layout is NHWC (TPU-native; the reference is NCHW — the Python
+layer API accepts either and we transpose at the boundary).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IntOr2 = Union[int, Sequence[int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(v)  # type: ignore
+
+
+def _padding(pad: Union[str, IntOr2]) -> Union[str, Sequence[Tuple[int, int]]]:
+    if isinstance(pad, str):
+        return pad.upper()
+    p = _pair(pad)
+    return [(p[0], p[0]), (p[1], p[1])]
+
+
+def conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+           padding: Union[str, IntOr2] = 0, dilation: IntOr2 = 1,
+           groups: int = 1) -> jax.Array:
+    """NHWC conv. w: [kh, kw, cin/groups, cout]. (ref: operators/conv_op.cc conv2d)."""
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=_pair(stride),
+        padding=_padding(padding),
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+
+
+def depthwise_conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+                     padding: Union[str, IntOr2] = 0) -> jax.Array:
+    """w: [kh, kw, 1, channels*mult] with groups=channels
+    (ref: function/DepthwiseConvOp.cpp)."""
+    c = x.shape[-1]
+    return conv2d(x, w, stride=stride, padding=padding, groups=c)
+
+
+def conv2d_transpose(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+                     padding: Union[str, IntOr2] = 0) -> jax.Array:
+    """Gradient-of-conv as forward op (ref: operators/conv_transpose_op.cc).
+
+    w: [kh, kw, cin, cout] — HWIO w.r.t. the forward (upsampling) direction."""
+    s = _pair(stride)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding)
+        kh, kw = w.shape[0], w.shape[1]
+        # conv_transpose padding: SAME-style inversion of forward conv padding
+        pad = [(kh - 1 - p[0], kh - 1 - p[0]), (kw - 1 - p[1], kw - 1 - p[1])]
+    return lax.conv_transpose(x, w, strides=s, padding=pad,
+                              dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def conv3d(x: jax.Array, w: jax.Array, *, stride=1, padding=0, dilation=1,
+           groups: int = 1) -> jax.Array:
+    """NDHWC 3-D conv (ref: operators/conv3d via conv_op.cc)."""
+    def _t3(v):
+        return (v, v, v) if isinstance(v, int) else tuple(v)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _t3(padding)
+        pad = [(pi, pi) for pi in p]
+    return lax.conv_general_dilated(
+        x, w, window_strides=_t3(stride), padding=pad, rhs_dilation=_t3(dilation),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"), feature_group_count=groups)
+
+
+def row_conv(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Lookahead row convolution over time (ref: function/RowConvOp.cpp,
+    operators/row_conv_op.cc). x: [B, T, D], w: [context, D]."""
+    context = w.shape[0]
+    xpad = jnp.pad(x, ((0, 0), (0, context - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(context):
+        out = out + xpad[:, i:i + x.shape[1], :] * w[i][None, None, :]
+    return out
+
+
+def im2col(x: jax.Array, kernel: IntOr2, stride: IntOr2 = 1,
+           padding: IntOr2 = 0) -> jax.Array:
+    """Patch extraction (ref: function/Im2Col.h, operators/math/im2col.cc) — exposed for
+    block_expand-style layers. x: [B, H, W, C] -> [B, oh, ow, kh*kw*C]."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    B, H, W, C = x.shape
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=[(ph, ph), (pw, pw)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # conv_general_dilated_patches emits features channel-major (C, kh, kw);
+    # reorder to the documented patch-major (kh, kw, C) layout.
+    patches = patches.reshape(B, oh, ow, C, kh, kw)
+    patches = jnp.transpose(patches, (0, 1, 2, 4, 5, 3))
+    return patches.reshape(B, oh, ow, kh * kw * C)

@@ -1,0 +1,148 @@
+"""Loss functions.
+
+Covers the reference's cost-layer zoo (paddle/gserver/layers/CostLayer.cpp — 20+ losses)
+and the gen-2 loss operators (cross_entropy_op.cc, softmax_with_cross_entropy_op.cc,
+huber_loss_op.cc, rank_loss_op.cc, margin_rank_loss_op.cc, smooth_l1_loss_op.cc,
+squared_l2_loss_op.cc, modified_huber_loss_op.cc, sigmoid_cross_entropy_with_logits_op.cc,
+hinge_loss_op.cc, log_loss_op.cc). All return per-example losses [B] (or [B, 1]) like
+the reference; reduce with ``mean`` for the scalar cost.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot_like(labels, logits):
+    return jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+
+
+def cross_entropy(probs: jax.Array, label: jax.Array, soft_label: bool = False,
+                  eps: float = 1e-8) -> jax.Array:
+    """-log p[label] over probabilities (ref: operators/cross_entropy_op.cc)."""
+    if soft_label:
+        return -jnp.sum(label * jnp.log(probs + eps), axis=-1)
+    p = jnp.take_along_axis(probs, label[..., None].astype(jnp.int32), axis=-1)
+    return -jnp.log(p[..., 0] + eps)
+
+
+def softmax_with_cross_entropy(logits: jax.Array, label: jax.Array,
+                               soft_label: bool = False) -> jax.Array:
+    """Fused, numerically-stable version (ref: softmax_with_cross_entropy_op.cc)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=-1)
+    lp = jnp.take_along_axis(logp, label[..., None].astype(jnp.int32), axis=-1)
+    return -lp[..., 0]
+
+
+def sigmoid_cross_entropy_with_logits(x: jax.Array, label: jax.Array) -> jax.Array:
+    """ref: sigmoid_cross_entropy_with_logits_op.cc (elementwise)."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def square_error(x: jax.Array, label: jax.Array) -> jax.Array:
+    """Sum-of-squares cost (ref: CostLayer.cpp SumOfSquaresCostLayer,
+    operators/squared_l2_distance_op.cc)."""
+    d = x - label
+    return 0.5 * jnp.sum(jnp.square(d).reshape(d.shape[0], -1), axis=-1)
+
+
+def smooth_l1(x: jax.Array, label: jax.Array, sigma: float = 1.0) -> jax.Array:
+    """ref: smooth_l1_loss_op.cc."""
+    s2 = sigma * sigma
+    d = jnp.abs(x - label)
+    per = jnp.where(d < 1.0 / s2, 0.5 * s2 * jnp.square(d), d - 0.5 / s2)
+    return jnp.sum(per.reshape(per.shape[0], -1), axis=-1)
+
+
+def huber_regression(x: jax.Array, label: jax.Array, delta: float = 1.0) -> jax.Array:
+    """ref: huber_loss_op.cc / CostLayer.cpp HuberRegressionLoss."""
+    d = jnp.abs(x - label)
+    per = jnp.where(d <= delta, 0.5 * jnp.square(d), delta * (d - 0.5 * delta))
+    return jnp.sum(per.reshape(per.shape[0], -1), axis=-1)
+
+
+def huber_classification(x: jax.Array, label: jax.Array) -> jax.Array:
+    """Two-class huber (ref: CostLayer.cpp HuberTwoClassification); label in {0,1}."""
+    y = 2.0 * label - 1.0
+    z = x[..., 0] if x.ndim > 1 else x
+    a = y * z
+    return jnp.where(a < -1.0, -4.0 * a, jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
+
+
+def modified_huber(x: jax.Array, label: jax.Array) -> jax.Array:
+    """ref: modified_huber_loss_op.cc; label in {0,1}."""
+    y = 2.0 * label - 1.0
+    a = y * (x[..., 0] if x.ndim > 1 else x)
+    return jnp.where(a < -1.0, -4.0 * a, jnp.square(jnp.maximum(1.0 - a, 0.0)))
+
+
+def hinge(x: jax.Array, label: jax.Array) -> jax.Array:
+    """ref: hinge_loss_op.cc; label in {0,1}."""
+    y = 2.0 * label - 1.0
+    return jnp.maximum(0.0, 1.0 - y * (x[..., 0] if x.ndim > 1 else x))
+
+
+def log_loss(prob: jax.Array, label: jax.Array, eps: float = 1e-7) -> jax.Array:
+    """ref: log_loss_op.cc."""
+    p = prob[..., 0] if prob.ndim > 1 else prob
+    return -label * jnp.log(p + eps) - (1.0 - label) * jnp.log(1.0 - p + eps)
+
+
+def rank_loss(left: jax.Array, right: jax.Array, label: jax.Array) -> jax.Array:
+    """Pairwise RankNet loss (ref: rank_loss_op.cc, CostLayer.cpp RankingCost).
+
+    label = 1 if left should rank higher."""
+    d = left - right
+    d = d[..., 0] if d.ndim > 1 else d
+    return jnp.log1p(jnp.exp(d)) - label * d
+
+
+def margin_rank_loss(left: jax.Array, right: jax.Array, label: jax.Array,
+                     margin: float = 0.0) -> jax.Array:
+    """ref: margin_rank_loss_op.cc; label in {-1, 1}."""
+    l_ = left[..., 0] if left.ndim > 1 else left
+    r_ = right[..., 0] if right.ndim > 1 else right
+    return jnp.maximum(0.0, -label * (l_ - r_) + margin)
+
+
+def multi_binary_label_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Multi-label sigmoid CE summed over classes (ref: CostLayer.cpp
+    MultiBinaryLabelCrossEntropy)."""
+    return jnp.sum(sigmoid_cross_entropy_with_logits(logits, labels), axis=-1)
+
+
+def soft_binary_class_cross_entropy(p: jax.Array, label: jax.Array,
+                                    eps: float = 1e-8) -> jax.Array:
+    """ref: CostLayer.cpp SoftBinaryClassCrossEntropy."""
+    per = -label * jnp.log(p + eps) - (1.0 - label) * jnp.log(1.0 - p + eps)
+    return jnp.sum(per.reshape(per.shape[0], -1), axis=-1)
+
+
+def squared_l2_norm(x: jax.Array) -> jax.Array:
+    """ref: squared_l2_norm_op.cc — scalar."""
+    return jnp.sum(jnp.square(x))
+
+
+def kldiv_loss(logp: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.sum(target * (jnp.log(jnp.maximum(target, 1e-12)) - logp), axis=-1)
+
+
+def nce_loss(logits_pos: jax.Array, logits_neg: jax.Array) -> jax.Array:
+    """Noise-contrastive estimation surface (ref: gserver/layers/NCELayer.cpp,
+    operators/nce_op.cc): positive logit [B], negative logits [B, K]."""
+    pos = sigmoid_cross_entropy_with_logits(logits_pos, jnp.ones_like(logits_pos))
+    neg = sigmoid_cross_entropy_with_logits(logits_neg, jnp.zeros_like(logits_neg))
+    return pos + jnp.sum(neg, axis=-1)
+
+
+def masked_seq_loss(per_step_loss: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Average per-sequence loss over valid steps of a padded [B, T] loss tensor —
+    the LoD-aware cost reduction used by sequence models."""
+    from ..core.lod import sequence_mask
+    m = sequence_mask(lengths, per_step_loss.shape[1], per_step_loss.dtype)
+    return jnp.sum(per_step_loss * m, axis=1) / jnp.maximum(lengths.astype(per_step_loss.dtype), 1.0)

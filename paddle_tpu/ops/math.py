@@ -1,0 +1,197 @@
+"""Dense math ops.
+
+TPU-native replacement for the reference's BLAS path: ``Matrix::mul`` -> gemm
+(paddle/math/MathFunctions.h:63, cuda/src/hl_cuda_cublas.cc:225) and the gen-2
+``mul``/``matmul``/elementwise operator families (paddle/operators/mul_op.cc,
+matmul_op.cc, elementwise_*_op.cc). Everything lowers to HLO; matmuls target the MXU —
+keep them batched and (optionally) bfloat16 via the ``precision`` policy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def matmul(x: jax.Array, y: jax.Array, *, transpose_x: bool = False,
+           transpose_y: bool = False, precision=None) -> jax.Array:
+    """Batched matmul (ref: operators/matmul_op.cc semantics).
+
+    Leading batch dims broadcast; 1-D operands get the usual vector promotion.
+    """
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=precision)
+
+
+def mul(x: jax.Array, y: jax.Array, *, x_num_col_dims: int = 1,
+        y_num_col_dims: int = 1) -> jax.Array:
+    """Flattening matmul (ref: operators/mul_op.cc): collapse x's leading
+    ``x_num_col_dims`` dims to rows and the rest to cols, similarly for y."""
+    xs, ys = x.shape, y.shape
+    xm = x.reshape((int(jnp.prod(jnp.array(xs[:x_num_col_dims]))), -1))
+    ym = y.reshape((int(jnp.prod(jnp.array(ys[:y_num_col_dims]))), -1))
+    out = jnp.matmul(xm, ym)
+    return out.reshape(xs[:x_num_col_dims] + ys[y_num_col_dims:])
+
+
+def fc(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Array:
+    """x @ w + b — the FullyConnectedLayer forward (gserver/layers/FullyConnectedLayer.cpp)."""
+    out = jnp.matmul(x.reshape((x.shape[0], -1)), w)
+    if b is not None:
+        out = out + b
+    return out
+
+
+# elementwise family (ref: operators/elementwise_{add,sub,mul,div}_op.cc with axis
+# broadcast semantics; XLA broadcasting subsumes the axis attribute)
+def _ewise(op, x, y, axis: int = -1):
+    if x.ndim != y.ndim and axis != -1 and y.ndim > 0:
+        # ref semantics: y's shape aligns to x's dims starting at `axis`
+        shape = [1] * x.ndim
+        for i, s in enumerate(y.shape):
+            shape[axis + i] = s
+        y = y.reshape(shape)
+    return op(x, y)
+
+
+elementwise_add = partial(_ewise, jnp.add)
+elementwise_sub = partial(_ewise, jnp.subtract)
+elementwise_mul = partial(_ewise, jnp.multiply)
+elementwise_div = partial(_ewise, jnp.divide)
+elementwise_max = partial(_ewise, jnp.maximum)
+elementwise_min = partial(_ewise, jnp.minimum)
+elementwise_pow = partial(_ewise, jnp.power)
+
+
+def scale(x, scale_factor=1.0, bias=0.0, bias_after_scale=True):
+    """ref: operators/scale_op.cc."""
+    if bias_after_scale:
+        return x * scale_factor + bias
+    return (x + bias) * scale_factor
+
+
+def clip(x, min_val, max_val):
+    """ref: operators/clip_op.cc."""
+    return jnp.clip(x, min_val, max_val)
+
+
+def clip_by_norm(x, max_norm):
+    """ref: operators/clip_by_norm_op.cc."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / jnp.maximum(norm, 1e-12)), x)
+
+
+# reductions (ref: operators/reduce_op.cc registers sum/mean/max/min)
+def reduce_sum(x, axis=None, keepdims=False):
+    return jnp.sum(x, axis=axis, keepdims=keepdims)
+
+
+def reduce_mean(x, axis=None, keepdims=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdims)
+
+
+def reduce_max(x, axis=None, keepdims=False):
+    return jnp.max(x, axis=axis, keepdims=keepdims)
+
+
+def reduce_min(x, axis=None, keepdims=False):
+    return jnp.min(x, axis=axis, keepdims=keepdims)
+
+
+def mean(x):
+    """ref: operators/mean_op.cc."""
+    return jnp.mean(x)
+
+
+# shape ops (ref: reshape/transpose/concat/split/expand/pad/crop/cast ops)
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes)
+
+
+def concat(xs: Sequence[jax.Array], axis: int = 0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    idx = list(jnp.cumsum(jnp.array(num_or_sections))[:-1])
+    return jnp.split(x, [int(i) for i in idx], axis=axis)
+
+
+def expand(x, expand_times: Sequence[int]):
+    """ref: operators/expand_op.cc (tile)."""
+    return jnp.tile(x, expand_times)
+
+
+def pad(x, paddings, pad_value=0.0):
+    """ref: operators/pad_op.cc; paddings is [(lo, hi)] per dim."""
+    return jnp.pad(x, paddings, constant_values=pad_value)
+
+
+def crop(x, offsets: Sequence[int], shape: Sequence[int]):
+    """ref: operators/crop_op.cc."""
+    return lax.dynamic_slice(x, list(offsets), list(shape))
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def gather(x, index, axis=0):
+    """ref: operators/gather_op.cc."""
+    return jnp.take(x, index, axis=axis)
+
+
+def scatter(x, index, updates, overwrite=True):
+    """ref: operators/scatter_op.cc — writes rows of ``updates`` at ``index``."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def cos_sim(x, y, eps: float = 1e-8):
+    """Row-wise cosine similarity (ref: function/CosSimOp.cpp, operators/cos_sim_op.cc)."""
+    nx = jnp.sqrt(jnp.sum(jnp.square(x), -1) + eps)
+    ny = jnp.sqrt(jnp.sum(jnp.square(y), -1) + eps)
+    return jnp.sum(x * y, -1) / (nx * ny)
+
+
+def l2_normalize(x, axis=-1, eps=1e-12):
+    """ref: operators/norm_op.cc."""
+    return x / jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+
+
+def top_k(x, k: int):
+    """ref: operators/top_k_op.cc — returns (values, indices) over last dim."""
+    return lax.top_k(x, k)
+
+
+def argmax(x, axis=-1):
+    """ref: gserver/layers/MaxIdLayer.cpp."""
+    return jnp.argmax(x, axis=axis)
+
+
+def interpolation(x, y, w):
+    """out = w*x + (1-w)*y (ref: gserver/layers/InterpolationLayer.cpp)."""
+    w = w.reshape(w.shape + (1,) * (x.ndim - w.ndim))
+    return w * x + (1.0 - w) * y
+
+
+def sum_op(xs: Sequence[jax.Array]):
+    """ref: operators/sum_op.cc — adds N tensors."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out

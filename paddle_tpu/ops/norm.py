@@ -1,0 +1,76 @@
+"""Normalization ops.
+
+Replaces the reference's three batch-norm implementations (gserver/layers/
+BatchNormalizationLayer.cpp, CudnnBatchNormLayer.cpp, MKLDNNBatchNormLayer.cpp; gen-2
+operators/batch_norm_op.cc), cross-map response normalization (function/
+CrossMapNormalOp.cpp, operators/lrn_op.cc), and layer_norm with pure-XLA computations.
+Batch norm is functional: train mode returns updated running stats explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+               running_mean: jax.Array, running_var: jax.Array, *,
+               train: bool, momentum: float = 0.9, eps: float = 1e-5,
+               axis_mask: Optional[Tuple[int, ...]] = None):
+    """Batch normalization over all axes but the last (channel-last layout).
+
+    Returns (y, new_mean, new_var); in eval mode new stats are the running stats
+    unchanged. (ref: operators/batch_norm_op.cc, moving-average update with
+    ``momentum`` as in BatchNormBaseLayer.cpp)."""
+    red = axis_mask if axis_mask is not None else tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+        new_mean = momentum * running_mean + (1.0 - momentum) * mean
+        new_var = momentum * running_var + (1.0 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean) * inv * gamma + beta
+    return y, new_mean, new_var
+
+
+def layer_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+               eps: float = 1e-5, axis: int = -1) -> jax.Array:
+    """ref: operators/layer_norm_op.cc (later fluid; standard form)."""
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def lrn(x: jax.Array, size: int = 5, alpha: float = 1e-4, beta: float = 0.75,
+        k: float = 1.0) -> jax.Array:
+    """Local response norm across channels, NHWC (ref: operators/lrn_op.cc,
+    function/CrossMapNormalOp.cpp)."""
+    sq = jnp.square(x)
+    half = size // 2
+    pad = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        acc = acc + pad[..., i:i + x.shape[-1]]
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+def cross_map_norm(x, size=5, scale=1e-4, pow_=0.75):
+    """gen-1 naming (gserver/layers/NormLayer.cpp CMRProjectionNormLayer)."""
+    return lrn(x, size=size, alpha=scale, beta=pow_, k=1.0)
+
+
+def group_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array, groups: int,
+               eps: float = 1e-5) -> jax.Array:
+    shape = x.shape
+    C = shape[-1]
+    xg = x.reshape(shape[:-1] + (groups, C // groups))
+    red = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.var(xg, axis=red, keepdims=True)
+    xn = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(shape)
+    return xn * gamma + beta

@@ -1,0 +1,153 @@
+"""Pooling ops.
+
+Replaces paddle/function pooling paths and gen-2 pool2d/pool3d (+cudnn, with-index)
+operators (operators/pool_op.cc, pool_with_index_op.cc) and the ROI/spatial-pyramid
+layers (gserver/layers/ROIPoolLayer.cpp, SpatialPyramidPoolLayer.cpp) with
+``lax.reduce_window`` — XLA's native windowed reduction. NHWC layout.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IntOr2 = Union[int, Sequence[int]]
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _pads(padding, k):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _pair(padding)
+    return [(0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0)]
+
+
+def max_pool2d(x: jax.Array, kernel: IntOr2, stride: IntOr2 = None,
+               padding: Union[str, IntOr2] = 0) -> jax.Array:
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, init, lax.max, (1, kh, kw, 1), (1, sh, sw, 1),
+                             _pads(padding, kernel))
+
+
+def avg_pool2d(x: jax.Array, kernel: IntOr2, stride: IntOr2 = None,
+               padding: Union[str, IntOr2] = 0,
+               count_include_pad: bool = True) -> jax.Array:
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    pads = _pads(padding, kernel)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pads)
+    if count_include_pad or isinstance(padding, str):
+        return summed / (kh * kw)
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pads)
+    return summed / counts
+
+
+def global_avg_pool2d(x: jax.Array) -> jax.Array:
+    return jnp.mean(x, axis=(1, 2))
+
+
+def global_max_pool2d(x: jax.Array) -> jax.Array:
+    return jnp.max(x, axis=(1, 2))
+
+
+def max_pool2d_with_index(x: jax.Array, kernel: IntOr2, stride: IntOr2 = None,
+                          padding: IntOr2 = 0) -> Tuple[jax.Array, jax.Array]:
+    """ref: operators/pool_with_index_op.cc — returns (pooled, flat argmax index
+    within each window's input plane), used by unpooling."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    B, H, W, C = x.shape
+    flat_idx = jnp.arange(H * W, dtype=jnp.float32).reshape(1, H, W, 1)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    init = (jnp.array(-jnp.inf, x.dtype), jnp.array(-1.0))
+    vals, idxs = lax.reduce_window((x, flat_idx), init, reducer,
+                                   (1, kh, kw, 1), (1, sh, sw, 1), _pads(padding, kernel))
+    return vals, idxs.astype(jnp.int32)
+
+
+def max_pool3d(x: jax.Array, kernel, stride=None, padding=0) -> jax.Array:
+    k = (kernel,) * 3 if isinstance(kernel, int) else tuple(kernel)
+    s = k if stride is None else ((stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    pads = [(0, 0)] + [(pi, pi) for pi in p] + [(0, 0)]
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1,) + k + (1,), (1,) + s + (1,), pads)
+
+
+def avg_pool3d(x: jax.Array, kernel, stride=None, padding=0) -> jax.Array:
+    k = (kernel,) * 3 if isinstance(kernel, int) else tuple(kernel)
+    s = k if stride is None else ((stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    pads = [(0, 0)] + [(pi, pi) for pi in p] + [(0, 0)]
+    summed = lax.reduce_window(x, 0.0, lax.add, (1,) + k + (1,), (1,) + s + (1,), pads)
+    return summed / (k[0] * k[1] * k[2])
+
+
+def spatial_pyramid_pool(x: jax.Array, pyramid_height: int,
+                         pool_type: str = "max") -> jax.Array:
+    """ref: gserver/layers/SpatialPyramidPoolLayer.cpp, operators/spp_op.cc.
+
+    Pools the feature map at pyramid levels 1x1, 2x2, ... 2^(h-1) bins and concats.
+    Output length is fixed: sum over levels of bins^2 * C, independent of H/W —
+    bin boundaries are computed per-bin (floor/ceil), SPP-paper style."""
+    B, H, W, C = x.shape
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        for i in range(bins):
+            y0, y1 = (i * H) // bins, -(-((i + 1) * H) // bins)
+            for j in range(bins):
+                x0, x1 = (j * W) // bins, -(-((j + 1) * W) // bins)
+                region = x[:, y0:y1, x0:x1, :]
+                if pool_type == "max":
+                    outs.append(jnp.max(region, axis=(1, 2)))
+                else:
+                    outs.append(jnp.mean(region, axis=(1, 2)))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def roi_pool(feat: jax.Array, rois: jax.Array, out_size: Tuple[int, int],
+             spatial_scale: float = 1.0) -> jax.Array:
+    """ROI max pooling (ref: gserver/layers/ROIPoolLayer.cpp, operators/roi_pool_op.cc).
+
+    feat: [H, W, C] single image feature; rois: [N, 4] (x1, y1, x2, y2) in input scale.
+    Static-shape implementation: for each output bin, build a mask over the feature map
+    and take a masked max — O(N * oh * ow) masked reductions, fine for detection heads.
+    """
+    H, W, C = feat.shape
+    oh, ow = out_size
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0) / oh
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0) / ow
+
+        def one_bin(i, j):
+            y_lo, y_hi = y1 + i * rh, y1 + (i + 1) * rh
+            x_lo, x_hi = x1 + j * rw, x1 + (j + 1) * rw
+            my = (ys >= jnp.floor(y_lo)) & (ys < jnp.ceil(y_hi))
+            mx = (xs >= jnp.floor(x_lo)) & (xs < jnp.ceil(x_hi))
+            m = (my[:, None] & mx[None, :])[:, :, None]
+            return jnp.max(jnp.where(m, feat, -jnp.inf), axis=(0, 1))
+
+        rows = jnp.stack([jnp.stack([one_bin(i, j) for j in range(ow)]) for i in range(oh)])
+        return rows  # [oh, ow, C]
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))

@@ -1,0 +1,32 @@
+"""Random ops — dropout and random fills.
+
+ref: operators/dropout_op.cc, gaussian_random_op.cc, uniform_random_op.cc. Explicit
+PRNG keys (JAX convention) replace the reference's global generators; under jit the
+threefry bits generate on-device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout(x: jax.Array, rate: float, rng: jax.Array, train: bool = True,
+            scale_in_train: bool = True) -> jax.Array:
+    """ref dropout semantics: in eval the output is x (upscale-in-train) or
+    x*(1-rate) (downgrade-in-infer) depending on implementation flag."""
+    if not train or rate <= 0.0:
+        return x if scale_in_train else x * (1.0 - rate)
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    if scale_in_train:
+        return jnp.where(mask, x / keep, 0.0)
+    return jnp.where(mask, x, 0.0)
+
+
+def gaussian_random(rng, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+    return mean + std * jax.random.normal(rng, shape, dtype)
+
+
+def uniform_random(rng, shape, low=-1.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(rng, shape, dtype, low, high)

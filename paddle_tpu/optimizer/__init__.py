@@ -1,0 +1,15 @@
+from .lr_scheduler import (constant_lr, exponential_decay, inverse_time_decay,
+                           linear_warmup, natural_exp_decay, piecewise_decay,
+                           poly_decay, discexp_lr)
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, DecayedAdagrad,
+                         Ftrl, Momentum, Optimizer, ProximalGD, RMSProp,
+                         ParameterAverager)
+from .clip import clip_by_global_norm, clip_by_norm, clip_by_value
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adagrad", "DecayedAdagrad", "Adadelta",
+    "RMSProp", "Adam", "Adamax", "ProximalGD", "Ftrl", "ParameterAverager",
+    "constant_lr", "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "poly_decay", "piecewise_decay", "linear_warmup", "discexp_lr",
+    "clip_by_value", "clip_by_norm", "clip_by_global_norm",
+]

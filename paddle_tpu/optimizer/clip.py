@@ -1,0 +1,27 @@
+"""Gradient clipping — ref: parameter/FirstOrderOptimizer.h:346 (OptimizerWithGradientClipping),
+operators/clip_op.cc, fluid GradientClipByGlobalNorm."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def clip_by_value(grads, min_val: float, max_val: float):
+    return jax.tree_util.tree_map(lambda g: jnp.clip(g, min_val, max_val), grads)
+
+
+def clip_by_norm(grads, max_norm: float):
+    from ..ops.math import clip_by_norm as _clip_one
+    return jax.tree_util.tree_map(lambda g: _clip_one(g, max_norm), grads)
+
+
+def global_norm(grads):
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    n = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)

@@ -1,0 +1,81 @@
+"""Learning-rate schedules.
+
+Analog of the reference's LR schedulers (paddle/parameter/LearningRateScheduler.cpp —
+registered types: constant, poly, caffe_poly, exp, discexp, linear, manual, pass_manual)
+and fluid's learning_rate_decay functions. Each schedule is a pure fn step -> lr scale,
+usable inside jit (step is a traced scalar).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def constant_lr(lr: float):
+    def sched(step):
+        return jnp.asarray(lr, jnp.float32)
+    return sched
+
+
+def exponential_decay(lr: float, decay_steps: int, decay_rate: float,
+                      staircase: bool = False):
+    def sched(step):
+        p = step / decay_steps
+        if staircase:
+            p = jnp.floor(p)
+        return lr * jnp.power(decay_rate, p)
+    return sched
+
+
+def natural_exp_decay(lr: float, decay_steps: int, decay_rate: float,
+                      staircase: bool = False):
+    def sched(step):
+        p = step / decay_steps
+        if staircase:
+            p = jnp.floor(p)
+        return lr * jnp.exp(-decay_rate * p)
+    return sched
+
+
+def inverse_time_decay(lr: float, decay_steps: int, decay_rate: float,
+                       staircase: bool = False):
+    def sched(step):
+        p = step / decay_steps
+        if staircase:
+            p = jnp.floor(p)
+        return lr / (1.0 + decay_rate * p)
+    return sched
+
+
+def poly_decay(lr: float, decay_steps: int, end_lr: float = 1e-4, power: float = 1.0,
+               cycle: bool = False):
+    def sched(step):
+        if cycle:
+            decay = decay_steps * jnp.maximum(1.0, jnp.ceil(step / decay_steps))
+        else:
+            decay = decay_steps
+        s = jnp.minimum(step.astype(jnp.float32) if hasattr(step, "astype") else float(step), decay)
+        return (lr - end_lr) * jnp.power(1.0 - s / decay, power) + end_lr
+    return sched
+
+
+def piecewise_decay(boundaries, values):
+    def sched(step):
+        lr = jnp.asarray(values[0], jnp.float32)
+        for b, v in zip(boundaries, values[1:]):
+            lr = jnp.where(step >= b, v, lr)
+        return lr
+    return sched
+
+
+def discexp_lr(lr: float, decay_rate: float, decay_steps: int):
+    """gen-1 'discexp': lr * decay_rate^floor(step/decay_steps)
+    (ref: LearningRateScheduler.cpp discexp)."""
+    return exponential_decay(lr, decay_steps, decay_rate, staircase=True)
+
+
+def linear_warmup(base_sched, warmup_steps: int, start_frac: float = 0.0):
+    def sched(step):
+        warm = start_frac + (1.0 - start_frac) * (step / max(warmup_steps, 1))
+        return jnp.where(step < warmup_steps, warm, 1.0) * base_sched(step)
+    return sched

@@ -1,0 +1,312 @@
+"""First-order optimizers.
+
+Re-provides the reference's optimizer zoo:
+* gen-1 ``ParameterOptimizer`` hierarchy (paddle/parameter/FirstOrderOptimizer.h —
+  SGD:24, SparseMomentum:63, AdaGrad:111, AdaDelta:141, RMSProp:167,
+  DecayedAdaGrad:210, Adam:255, AdaMax:290) and ``AverageOptimizer``
+  (AverageOptimizer.cpp, parameter averaging);
+* gen-2 optimizer operators (operators/{sgd,momentum,adam,adamax,adagrad,adadelta,
+  decayed_adagrad,rmsprop,proximal_gd,proximal_adagrad,ftrl}_op.cc) and the standalone
+  C-ABI optimizer lib (paddle/optimizer/*.cc) used by the Go pserver.
+
+Design: functional update — ``init(params) -> state``, ``update(grads, state, params,
+step) -> (new_params, new_state)``. The whole update is one fused XLA computation (the
+reference needed hand-written TrainingAlgorithmOp.cu kernels for this). L1/L2
+regularization (parameter/Regularizer.cpp) and clipping compose as pre-update hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import clip as clip_mod
+
+Params = Any
+State = Dict[str, Any]
+tmap = jax.tree_util.tree_map
+
+
+def _is_stat_path(path) -> bool:
+    """True if a pytree path goes through a "stats" dict key (nn.Module.stat)."""
+    for entry in path:
+        if getattr(entry, "key", None) == "stats":
+            return True
+    return False
+
+
+def _sched(lr):
+    if callable(lr):
+        return lr
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+class Optimizer:
+    """Base: handles lr schedule, weight decay (L2), L1, and clipping."""
+
+    def __init__(self, learning_rate=0.01, weight_decay: float = 0.0,
+                 l1_decay: float = 0.0, grad_clip: Optional[Tuple[str, float]] = None):
+        self.lr = _sched(learning_rate)
+        self.weight_decay = weight_decay
+        self.l1_decay = l1_decay
+        self.grad_clip = grad_clip
+
+    # -- subclass API ---------------------------------------------------
+    def init_slot(self, p: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    def apply_one(self, p, g, slot, lr, step) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    # -- public ---------------------------------------------------------
+    def init(self, params: Params) -> State:
+        slots = tmap(lambda p: self.init_slot(p), params)
+        return {"step": jnp.zeros((), jnp.int32), "slots": slots}
+
+    def _preprocess(self, grads, params):
+        if self.weight_decay:
+            grads = tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        if self.l1_decay:
+            grads = tmap(lambda g, p: g + self.l1_decay * jnp.sign(p), grads, params)
+        if self.grad_clip is not None:
+            kind, val = self.grad_clip
+            if kind == "value":
+                grads = clip_mod.clip_by_value(grads, -val, val)
+            elif kind == "norm":
+                grads = clip_mod.clip_by_norm(grads, val)
+            elif kind == "global_norm":
+                grads = clip_mod.clip_by_global_norm(grads, val)
+            else:
+                raise ValueError(f"unknown clip kind {kind}")
+        return grads
+
+    def update(self, grads: Params, state: State, params: Params) -> Tuple[Params, State]:
+        """Apply one update. Leaves under a ``"stats"`` key (non-trainable running
+        state, see nn.Module.stat) pass through untouched — no decay, no slots."""
+        step = state["step"] + 1
+        lr = self.lr(step.astype(jnp.float32))
+        grads = self._preprocess(grads, params)
+
+        flat_p, treedef = jax.tree_util.tree_flatten_with_path(params)
+        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        flat_s = treedef.flatten_up_to(state["slots"])
+        new_p, new_s = [], []
+        for (path, p), g, s in zip(flat_p, flat_g, flat_s):
+            if _is_stat_path(path):
+                new_p.append(p)
+                new_s.append(s)
+                continue
+            np_, ns_ = self.apply_one(p, g, s, lr, step)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                {"step": step, "slots": jax.tree_util.tree_unflatten(treedef, new_s)})
+
+
+class SGD(Optimizer):
+    """Plain SGD (ref: FirstOrderOptimizer.h:24 SgdOptimizer; operators/sgd_op.cc)."""
+
+    def apply_one(self, p, g, slot, lr, step):
+        return p - lr * g, slot
+
+
+class Momentum(Optimizer):
+    """Momentum/Nesterov (ref: operators/momentum_op.cc; gen-1 momentum is folded into
+    SgdOptimizer via ParameterConfig.momentum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.mu = momentum
+        self.nesterov = use_nesterov
+
+    def init_slot(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, slot, lr, step):
+        v = self.mu * slot["velocity"] + g
+        if self.nesterov:
+            p = p - lr * (g + self.mu * v)
+        else:
+            p = p - lr * v
+        return p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    """ref: FirstOrderOptimizer.h:111 AdagradParameterOptimizer;
+    operators/adagrad_op.cc."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.eps = epsilon
+
+    def init_slot(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, slot, lr, step):
+        m = slot["moment"] + jnp.square(g)
+        p = p - lr * g / (jnp.sqrt(m) + self.eps)
+        return p, {"moment": m}
+
+
+class DecayedAdagrad(Optimizer):
+    """ref: FirstOrderOptimizer.h:210 DecayedAdagradParameterOptimizer;
+    operators/decayed_adagrad_op.cc."""
+
+    def __init__(self, learning_rate=0.01, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.decay, self.eps = decay, epsilon
+
+    def init_slot(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, slot, lr, step):
+        m = self.decay * slot["moment"] + (1.0 - self.decay) * jnp.square(g)
+        p = p - lr * g / (jnp.sqrt(m) + self.eps)
+        return p, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    """ref: FirstOrderOptimizer.h:141 AdaDeltaParameterOptimizer;
+    operators/adadelta_op.cc."""
+
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.eps = rho, epsilon
+
+    def init_slot(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p), "avg_sq_update": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, slot, lr, step):
+        asg = self.rho * slot["avg_sq_grad"] + (1.0 - self.rho) * jnp.square(g)
+        upd = jnp.sqrt(slot["avg_sq_update"] + self.eps) / jnp.sqrt(asg + self.eps) * g
+        asu = self.rho * slot["avg_sq_update"] + (1.0 - self.rho) * jnp.square(upd)
+        return p - lr * upd, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    """ref: FirstOrderOptimizer.h:167 RMSPropParameterOptimizer;
+    operators/rmsprop_op.cc (with momentum slot)."""
+
+    def __init__(self, learning_rate=0.01, rho=0.95, epsilon=1e-6, momentum=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.eps, self.mu = rho, epsilon, momentum
+
+    def init_slot(self, p):
+        return {"mean_square": jnp.zeros_like(p), "moment": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, slot, lr, step):
+        ms = self.rho * slot["mean_square"] + (1.0 - self.rho) * jnp.square(g)
+        mom = self.mu * slot["moment"] + lr * g / jnp.sqrt(ms + self.eps)
+        return p - mom, {"mean_square": ms, "moment": mom}
+
+
+class Adam(Optimizer):
+    """ref: FirstOrderOptimizer.h:255 AdamParameterOptimizer; operators/adam_op.cc."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def init_slot(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, slot, lr, step):
+        t = step.astype(jnp.float32)
+        m = self.b1 * slot["m"] + (1.0 - self.b1) * g
+        v = self.b2 * slot["v"] + (1.0 - self.b2) * jnp.square(g)
+        mhat = m / (1.0 - jnp.power(self.b1, t))
+        vhat = v / (1.0 - jnp.power(self.b2, t))
+        return p - lr * mhat / (jnp.sqrt(vhat) + self.eps), {"m": m, "v": v}
+
+
+class Adamax(Optimizer):
+    """ref: FirstOrderOptimizer.h:290 AdamaxParameterOptimizer;
+    operators/adamax_op.cc."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def init_slot(self, p):
+        return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, slot, lr, step):
+        t = step.astype(jnp.float32)
+        m = self.b1 * slot["m"] + (1.0 - self.b1) * g
+        u = jnp.maximum(self.b2 * slot["u"], jnp.abs(g))
+        p = p - lr / (1.0 - jnp.power(self.b1, t)) * m / (u + self.eps)
+        return p, {"m": m, "u": u}
+
+
+class ProximalGD(Optimizer):
+    """ref: operators/proximal_gd_op.cc — L1/L2 proximal step."""
+
+    def __init__(self, learning_rate=0.01, l1: float = 0.0, l2: float = 0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2 = l1, l2
+
+    def apply_one(self, p, g, slot, lr, step):
+        prox = p - lr * g
+        if self.l1 > 0:
+            prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * self.l1, 0.0)
+        return prox / (1.0 + lr * self.l2), slot
+
+
+class Ftrl(Optimizer):
+    """ref: operators/ftrl_op.cc."""
+
+    def __init__(self, learning_rate=0.01, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def init_slot(self, p):
+        return {"squared": jnp.zeros_like(p), "linear": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, slot, lr, step):
+        n, z = slot["squared"], slot["linear"]
+        n_new = n + jnp.square(g)
+        sigma = (jnp.power(n_new, -self.lr_power) - jnp.power(jnp.maximum(n, 1e-38), -self.lr_power)) / lr
+        z_new = z + g - sigma * p
+        denom = (jnp.power(n_new, -self.lr_power)) / lr + 2.0 * self.l2
+        p_new = jnp.where(
+            jnp.abs(z_new) > self.l1,
+            -(z_new - jnp.sign(z_new) * self.l1) / denom,
+            0.0)
+        return p_new, {"squared": n_new, "linear": z_new}
+
+
+class ParameterAverager:
+    """Parameter averaging for eval (ref: parameter/AverageOptimizer.cpp,
+    ``average_window`` in OptimizationConfig).
+
+    ``average_window`` in (0, 1) selects an exponential moving average with that
+    decay (approximating the reference's sliding window over ~1/(1-w) batches);
+    0 means a plain cumulative mean over all accumulated steps. ``average()``
+    returns the raw params until ``min_count`` accumulations have happened."""
+
+    def __init__(self, average_window: float = 0.0, min_count: int = 0):
+        self.window = average_window
+        self.min_count = min_count
+
+    def init(self, params):
+        return {"sum": tmap(jnp.zeros_like, params), "count": jnp.zeros((), jnp.float32)}
+
+    def accumulate(self, state, params):
+        if self.window > 0.0:
+            w = self.window
+            return {"sum": tmap(lambda s, p: w * s + (1.0 - w) * p, state["sum"], params),
+                    "count": state["count"] + 1.0}
+        return {"sum": tmap(lambda s, p: s + p, state["sum"], params),
+                "count": state["count"] + 1.0}
+
+    def average(self, state, params):
+        c = jnp.maximum(state["count"], 1.0)
+        if self.window > 0.0:
+            # bias-correct the EMA like Adam's m-hat
+            avg = tmap(lambda s: s / (1.0 - jnp.power(self.window, c)), state["sum"])
+        else:
+            avg = tmap(lambda s: s / c, state["sum"])
+        use_avg = state["count"] >= self.min_count
+        return tmap(lambda a, p: jnp.where(use_avg, a, p), avg, params)

@@ -1,0 +1,7 @@
+from .flags import FLAGS, Flags
+from .logging import get_logger, logger
+from .registry import Registry
+from .stats import GLOBAL_STATS, StatSet, timer
+
+__all__ = ["FLAGS", "Flags", "Registry", "StatSet", "GLOBAL_STATS", "timer",
+           "get_logger", "logger"]

@@ -1,0 +1,22 @@
+"""Logging setup — analog of paddle/utils/Logging.h (glog-style)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(levelname).1s %(asctime)s %(name)s] %(message)s"
+
+
+def get_logger(name: str = "paddle_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%m%d %H:%M:%S"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+logger = get_logger()

@@ -1,0 +1,79 @@
+"""Scoped-timer statistics.
+
+Analog of the reference's ``StatSet`` / ``REGISTER_TIMER*`` machinery
+(paddle/utils/Stat.h:63-242), used along the whole train path
+(TrainerInternal.cpp:94-152, NeuralNetwork.cpp:260). Python-side timers cover the host
+loop; device time comes from jax profiler traces. A native C++ StatSet with the same
+semantics lives in native/ (see paddle_tpu.utils.native) for the C++ runtime components.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+
+class StatItem:
+    __slots__ = ("name", "total", "count", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def add(self, seconds: float):
+        self.total += seconds
+        self.count += 1
+        if seconds > self.max:
+            self.max = seconds
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def __repr__(self):
+        return (f"Stat={self.name:<30} total={self.total * 1e3:10.2f}ms "
+                f"avg={self.avg * 1e3:8.3f}ms max={self.max * 1e3:8.3f}ms count={self.count}")
+
+
+class StatSet:
+    """Accumulates named timers; thread-safe like the reference's global StatSet."""
+
+    def __init__(self):
+        self._items: Dict[str, StatItem] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str) -> StatItem:
+        with self._lock:
+            item = self._items.get(name)
+            if item is None:
+                item = self._items[name] = StatItem(name)
+            return item
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.get(name).add(time.perf_counter() - t0)
+
+    def reset(self):
+        with self._lock:
+            self._items.clear()
+
+    def report(self) -> str:
+        with self._lock:
+            lines = [repr(i) for i in sorted(self._items.values(), key=lambda i: -i.total)]
+        return "\n".join(lines)
+
+    def items(self):
+        with self._lock:
+            return dict(self._items)
+
+
+GLOBAL_STATS = StatSet()
+timer = GLOBAL_STATS.timer

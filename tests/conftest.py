@@ -1,0 +1,32 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's strategy of testing distributed logic in-process
+(SURVEY.md §4.3: pserver tests on localhost, MultiGradientMachine with threads):
+sharding/collective tests run on 8 virtual CPU devices so no TPU pod is needed.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers the axon TPU plugin and forces
+# jax_platforms="axon,cpu"; override it so tests run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.RandomState(0)

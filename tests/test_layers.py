@@ -1,0 +1,73 @@
+"""Layer-zoo unit tests (shape/semantics checks, analog of gserver/tests basics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import nn
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import pool as pool_ops
+from paddle_tpu.optimizer import SGD
+
+
+def test_conv2d_transpose_channel_change(rng):
+    layer = nn.Conv2DTranspose(8, 16, 3, stride=2, padding=1)
+    params = layer.init(rng)
+    y = layer(params, jnp.ones((2, 5, 5, 8)))
+    assert y.shape[0] == 2 and y.shape[-1] == 16
+
+
+def test_batchnorm_in_sequential_train(rng):
+    model = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm(4), nn.Linear(4, 2))
+    params = model.init(rng)
+    mut = {}
+    y = model(params, jnp.ones((8, 4)), train=True, mutable=mut)
+    assert y.shape == (8, 2)
+    # updated stats collected and mergeable
+    assert len(mut) == 1
+    new_params = nn.apply_stat_updates(params, mut)
+    path = next(iter(mut))
+    assert "moving_mean" in mut[path]
+    # eval mode: no mutable needed
+    y2 = model(new_params, jnp.ones((8, 4)))
+    assert y2.shape == (8, 2)
+
+
+def test_bn_stats_not_touched_by_optimizer(rng):
+    bn = nn.BatchNorm(4)
+    params = bn.init(rng)
+    opt = SGD(learning_rate=0.5, weight_decay=0.1)
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    new_params, _ = opt.update(grads, state, params)
+    # moving stats must be bit-identical (no decay applied)
+    np.testing.assert_array_equal(np.asarray(new_params["stats"]["moving_var"]),
+                                  np.asarray(params["stats"]["moving_var"]))
+    # trainable gamma DID get weight-decayed
+    assert not np.allclose(np.asarray(new_params["gamma"]), np.asarray(params["gamma"]))
+
+
+def test_spp_fixed_length_across_input_sizes():
+    for hw in (4, 5, 7):
+        x = jnp.ones((1, hw, hw, 3))
+        out = pool_ops.spatial_pyramid_pool(x, pyramid_height=2)
+        assert out.shape == (1, (1 + 4) * 3), out.shape
+
+
+def test_im2col_patch_major_layout():
+    # 1x3x3x2 input with distinct values; single 3x3 patch must read as
+    # (kh, kw, C) row-major
+    x = jnp.arange(18, dtype=jnp.float32).reshape(1, 3, 3, 2)
+    patches = conv_ops.im2col(x, kernel=3)
+    assert patches.shape == (1, 1, 1, 18)
+    np.testing.assert_array_equal(np.asarray(patches).ravel(),
+                                  np.asarray(x).ravel())
+
+
+def test_dropout_eval_identity(rng):
+    d = nn.Dropout(0.5)
+    params = d.init(rng)
+    x = jnp.ones((4, 4))
+    np.testing.assert_array_equal(np.asarray(d(params, x)), np.asarray(x))
+    y = d(params, x, train=True, rng=jax.random.PRNGKey(1))
+    assert float(jnp.sum(y == 0.0)) > 0  # some units dropped
