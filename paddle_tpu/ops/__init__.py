@@ -1,3 +1,5 @@
-from . import activations, conv, loss, math, norm, pool, random
+from . import (activations, beam_search, conv, crf, ctc, loss, math, metrics,
+               norm, pool, random, rnn, sequence, sparse)
 
-__all__ = ["math", "activations", "loss", "conv", "pool", "norm", "random"]
+__all__ = ["math", "activations", "loss", "conv", "pool", "norm", "random",
+           "rnn", "sequence", "crf", "ctc", "beam_search", "metrics", "sparse"]

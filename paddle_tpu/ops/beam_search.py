@@ -1,0 +1,118 @@
+"""On-device beam search.
+
+The reference runs beam search on CPU with per-step Python callbacks
+(gserver/gradientmachines/RecurrentGradientMachine.cpp:1020 ``beamSearch`` over
+``Path`` objects; gen-2 operators/beam_search_op.cc + beam_search_decode_op.cc).
+That design can't fly on TPU (SURVEY §7 hard parts): here the beam is a fixed-capacity
+masked top-k loop inside ``lax.scan``/``while_loop`` — all candidates live in [B, K]
+tensors, finished beams are frozen with -inf masking, and the user-callback capability
+becomes a ``constraint_fn`` logits-mask hook (token-constraint masking).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _gather_beams(tree, idx):
+    """Reindex the beam axis (1) of every leaf by idx [B, K_new]."""
+    def g(x):
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+    return jax.tree_util.tree_map(g, tree)
+
+
+def beam_search(init_cell, step_fn: Callable, *, batch_size: int, beam_size: int,
+                max_len: int, vocab_size: int, bos_id: int, eos_id: int,
+                length_penalty: float = 0.0,
+                constraint_fn: Optional[Callable] = None) -> Tuple[jax.Array, jax.Array]:
+    """Generic seq2seq beam decode.
+
+    step_fn(cell, tokens [B*K]) -> (log_probs [B*K, V], new_cell) — one decoder step.
+    init_cell leaves are [B, ...] and get tiled across beams.
+    constraint_fn(logits [B, K, V], step) -> logits — the reference's beam-search
+    callback hook (``BeamSearchControlCallbacks``) as a masking function.
+
+    Returns (tokens [B, K, max_len], scores [B, K]) sorted best-first.
+    """
+    B, K, V = batch_size, beam_size, vocab_size
+    neg_inf = jnp.float32(-1e9)
+
+    cell = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[:, None], (B, K) + x.shape[1:]), init_cell)
+    tokens = jnp.full((B, K, max_len), eos_id, jnp.int32)
+    cur = jnp.full((B, K), bos_id, jnp.int32)
+    # only beam 0 alive initially so identical initial beams don't duplicate
+    log_probs = jnp.concatenate(
+        [jnp.zeros((B, 1)), jnp.full((B, K - 1), neg_inf)], axis=1)
+    finished = jnp.zeros((B, K), jnp.bool_)
+
+    def body(state, t):
+        tokens, cur, log_probs, finished, cell = state
+        flat_cell = jax.tree_util.tree_map(
+            lambda x: x.reshape((B * K,) + x.shape[2:]), cell)
+        logp, new_flat_cell = step_fn(flat_cell, cur.reshape(B * K))
+        logp = logp.reshape(B, K, V)
+        new_cell = jax.tree_util.tree_map(
+            lambda x: x.reshape((B, K) + x.shape[1:]), new_flat_cell)
+        if constraint_fn is not None:
+            logp = constraint_fn(logp, t)
+
+        # finished beams: only allow EOS with prob 1 (score frozen)
+        eos_only = jnp.full((V,), neg_inf).at[eos_id].set(0.0)
+        logp = jnp.where(finished[..., None], eos_only[None, None, :], logp)
+
+        cand = log_probs[..., None] + logp                      # [B, K, V]
+        flat = cand.reshape(B, K * V)
+        top_scores, top_idx = lax.top_k(flat, K)                # [B, K]
+        beam_idx = top_idx // V
+        tok_idx = (top_idx % V).astype(jnp.int32)
+
+        tokens = _gather_beams(tokens, beam_idx)
+        tokens = tokens.at[:, :, t].set(tok_idx)
+        new_cell = _gather_beams(new_cell, beam_idx)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1) | (tok_idx == eos_id)
+        return (tokens, tok_idx, top_scores, finished, new_cell), None
+
+    state = (tokens, cur, log_probs, finished, cell)
+    (tokens, cur, log_probs, finished, cell), _ = lax.scan(
+        body, state, jnp.arange(max_len))
+
+    if length_penalty > 0.0:
+        # GNMT-style normalization over emitted lengths
+        lens = jnp.sum((tokens != eos_id).astype(jnp.float32), axis=-1) + 1.0
+        norm = jnp.power((5.0 + lens) / 6.0, length_penalty)
+        scored = log_probs / norm
+    else:
+        scored = log_probs
+    order = jnp.argsort(-scored, axis=1)
+    tokens = _gather_beams(tokens, order)
+    scored = jnp.take_along_axis(scored, order, axis=1)
+    return tokens, scored
+
+
+def greedy_search(init_cell, step_fn: Callable, *, batch_size: int, max_len: int,
+                  bos_id: int, eos_id: int) -> Tuple[jax.Array, jax.Array]:
+    """One-way (greedy) generation — ref RecurrentGradientMachine::oneWaySearch:1037."""
+    B = batch_size
+
+    def body(state, t):
+        cur, done, cell, score = state
+        logp, cell = step_fn(cell, cur)
+        nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        step_score = jnp.max(logp, axis=-1)
+        nxt = jnp.where(done, eos_id, nxt)
+        score = score + jnp.where(done, 0.0, step_score)
+        done = done | (nxt == eos_id)
+        return (nxt, done, cell, score), nxt
+
+    cur = jnp.full((B,), bos_id, jnp.int32)
+    done = jnp.zeros((B,), jnp.bool_)
+    score = jnp.zeros((B,), jnp.float32)
+    (_, _, _, score), toks = lax.scan(body, (cur, done, init_cell, score),
+                                      jnp.arange(max_len))
+    return jnp.swapaxes(toks, 0, 1), score

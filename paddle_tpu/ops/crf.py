@@ -1,0 +1,103 @@
+"""Linear-chain CRF: forward (log-likelihood) and Viterbi decoding.
+
+Replaces the reference's CPU-only CRF (gserver/layers/LinearChainCRF.cpp, CRFLayer.cpp,
+CRFDecodingLayer.cpp; gen-2 operators/linear_chain_crf_op.cc, crf_decoding_op.cc) with
+masked ``lax.scan`` dynamic programs that run on-device (the reference keeps CRF on
+CPU — SURVEY §7 lists it as a Pallas candidate; the scan form already fuses well).
+
+Transition parameterization follows the reference (LinearChainCRF.cpp): a
+[num_tags + 2, num_tags] matrix whose row 0 holds start weights a_j, row 1 holds end
+weights b_i, and rows 2.. hold pairwise w[i][j] (i prev, j next). We keep
+(start [N], end [N], trans [N, N]) as separate arrays — equivalent content.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.lod import sequence_mask
+
+
+def crf_log_norm(emissions: jax.Array, lengths: jax.Array, start: jax.Array,
+                 end: jax.Array, trans: jax.Array) -> jax.Array:
+    """log Z per sequence. emissions: [B, T, N]."""
+    B, T, N = emissions.shape
+    mask = sequence_mask(lengths, T, emissions.dtype)
+    alpha0 = start[None, :] + emissions[:, 0, :]
+
+    def step(alpha, inp):
+        e_t, m_t = inp
+        # [B, N_prev, 1] + [N_prev, N_next] -> logsumexp over prev
+        scores = alpha[:, :, None] + trans[None, :, :] + e_t[:, None, :]
+        new = jax.scipy.special.logsumexp(scores, axis=1)
+        m = m_t[:, None]
+        return m * new + (1.0 - m) * alpha, None
+
+    es = jnp.swapaxes(emissions, 0, 1)[1:]       # [T-1, B, N]
+    ms = jnp.swapaxes(mask, 0, 1)[1:]            # [T-1, B]
+    alpha, _ = lax.scan(step, alpha0, (es, ms))
+    return jax.scipy.special.logsumexp(alpha + end[None, :], axis=-1)
+
+
+def crf_score(emissions: jax.Array, tags: jax.Array, lengths: jax.Array,
+              start: jax.Array, end: jax.Array, trans: jax.Array) -> jax.Array:
+    """Score of a given tag path per sequence. tags: [B, T] int."""
+    B, T, N = emissions.shape
+    mask = sequence_mask(lengths, T, emissions.dtype)
+    e = jnp.take_along_axis(emissions, tags[..., None], axis=-1)[..., 0]  # [B, T]
+    emit = jnp.sum(e * mask, axis=1)
+    s = start[tags[:, 0]]
+    pair = trans[tags[:, :-1], tags[:, 1:]]       # [B, T-1]
+    pair = jnp.sum(pair * mask[:, 1:], axis=1)
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_tag = jnp.take_along_axis(tags, last_idx[:, None], axis=1)[:, 0]
+    return s + emit + pair + end[last_tag]
+
+
+def crf_loss(emissions, tags, lengths, start, end, trans) -> jax.Array:
+    """Negative log-likelihood per sequence (ref: CRFLayer forward cost)."""
+    return (crf_log_norm(emissions, lengths, start, end, trans)
+            - crf_score(emissions, tags, lengths, start, end, trans))
+
+
+def crf_decode(emissions: jax.Array, lengths: jax.Array, start: jax.Array,
+               end: jax.Array, trans: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Viterbi decode -> (best_tags [B, T], best_score [B])
+    (ref: CRFDecodingLayer.cpp, operators/crf_decoding_op.cc)."""
+    B, T, N = emissions.shape
+    mask = sequence_mask(lengths, T, emissions.dtype)
+    delta0 = start[None, :] + emissions[:, 0, :]
+
+    def fwd(delta, inp):
+        e_t, m_t = inp
+        scores = delta[:, :, None] + trans[None, :, :] + e_t[:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        new = jnp.max(scores, axis=1)
+        m = m_t[:, None]
+        delta_new = m * new + (1.0 - m) * delta
+        # on masked steps, backpointer = identity so backtrace passes through
+        ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+        bp = jnp.where(m_t[:, None] > 0, best_prev, ident)
+        return delta_new, bp
+
+    es = jnp.swapaxes(emissions, 0, 1)[1:]
+    ms = jnp.swapaxes(mask, 0, 1)[1:]
+    delta, bps = lax.scan(fwd, delta0, (es, ms))          # bps: [T-1, B, N]
+    final = delta + end[None, :]
+    best_last = jnp.argmax(final, axis=-1)                 # [B]
+    best_score = jnp.max(final, axis=-1)
+
+    def back(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # processing bps[t] with carry tag_{t+1} yields prev=tag_t and emits tag_{t+1};
+    # so ys = tags[1:] and the final carry is tag_0.
+    tag0, tags_tail = lax.scan(back, best_last, bps, reverse=True)
+    tags = jnp.concatenate([tag0[None, :], tags_tail], axis=0)  # [T, B]
+    tags = jnp.swapaxes(tags, 0, 1)
+    return tags * mask.astype(tags.dtype), best_score

@@ -1,0 +1,98 @@
+"""CTC loss — masked log-space forward algorithm.
+
+Replaces the reference's warp-ctc integration (gserver/layers/WarpCTCLayer.cpp,
+CTCLayer.cpp, vendored warpctc) with an on-device ``lax.scan`` dynamic program: the
+extended label sequence (blanks interleaved) lives in a fixed [B, 2*L+1] tensor,
+per-step transitions are branch-free selects, and variable input/label lengths are
+masked — no CPU round-trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG = -1e30
+
+
+def ctc_loss(log_probs: jax.Array, input_lengths: jax.Array, labels: jax.Array,
+             label_lengths: jax.Array, blank: int = 0) -> jax.Array:
+    """Per-sequence CTC negative log-likelihood.
+
+    log_probs: [B, T, V] log-softmax outputs; labels: [B, L] (padded with any value).
+    """
+    B, T, V = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(S)[None, :] < (2 * label_lengths + 1)[:, None]
+
+    # can we skip from s-2 to s? only when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, ext.dtype), ext[:, :-2]], axis=1)
+    can_skip = (jnp.arange(S)[None, :] % 2 == 1) & (ext != ext_m2)
+
+    emit = jnp.take_along_axis(
+        log_probs[:, :, :], ext[:, None, :].astype(jnp.int32), axis=2)  # [B, T, S]
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit[:, 0, 0])
+    has_label = label_lengths > 0
+    alpha0 = alpha0.at[:, 1].set(jnp.where(has_label, emit[:, 0, 1], NEG))
+    alpha0 = jnp.where(ext_valid, alpha0, NEG)
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m))
+
+    def step(alpha, inp):
+        emit_t, t = inp
+        a_m1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a_m2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        merged = lse(alpha, a_m1)
+        merged = jnp.where(can_skip, lse(merged, a_m2), merged)
+        new = merged + emit_t
+        new = jnp.where(ext_valid, new, NEG)
+        # freeze once past input length
+        active = (t < input_lengths)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    emits = jnp.swapaxes(emit, 0, 1)[1:]  # [T-1, B, S]
+    ts = jnp.arange(1, T)
+    alpha, _ = lax.scan(step, alpha0, (emits, ts))
+
+    # final prob: alpha at positions 2*label_len and 2*label_len - 1
+    idx_last = (2 * label_lengths).astype(jnp.int32)
+    idx_prev = jnp.maximum(idx_last - 1, 0)
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+    ll = lse(a_last, jnp.where(label_lengths > 0, a_prev, NEG))
+    return -ll
+
+
+def ctc_greedy_decode(log_probs: jax.Array, input_lengths: jax.Array,
+                      blank: int = 0):
+    """Best-path decode: argmax per step, collapse repeats, drop blanks.
+
+    Returns (tokens [B, T] padded with blank at tail, lengths [B])."""
+    B, T, V = log_probs.shape
+    path = jnp.argmax(log_probs, axis=-1)  # [B, T]
+    from ..core.lod import sequence_mask
+    valid = sequence_mask(input_lengths, T, jnp.bool_)
+    prev = jnp.concatenate([jnp.full((B, 1), -1, path.dtype), path[:, :-1]], axis=1)
+    keep = valid & (path != blank) & (path != prev)
+    # stable compaction: order = cumsum of keep
+    order = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((B, T), blank, path.dtype)
+    # scatter kept tokens to their compacted slots
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    safe_order = jnp.where(keep, order, T - 1)
+    out = out.at[rows, safe_order].set(jnp.where(keep, path, blank).astype(path.dtype))
+    lengths = jnp.sum(keep.astype(jnp.int32), axis=1)
+    # positions >= length reset to blank (the scatter above may have left junk at T-1)
+    pos = jnp.arange(T)[None, :]
+    out = jnp.where(pos < lengths[:, None], out, blank)
+    return out, lengths

@@ -1,0 +1,139 @@
+"""Metric ops — streaming-friendly building blocks.
+
+Replaces the gen-2 metric operators (operators/accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc, chunk_eval_op.cc) and feeds the evaluator zoo
+(paddle_tpu.trainer.evaluator, analog of gserver/evaluators/). Each returns raw
+counts so evaluators can accumulate across batches exactly like the reference's
+streaming Evaluators (Evaluator.h:42 start/eval/finish protocol).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy(logits_or_pred: jax.Array, labels: jax.Array,
+             weights: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Returns (num_correct, num_total) (ref: operators/accuracy_op.cc;
+    gserver ClassificationErrorEvaluator reports 1-acc)."""
+    pred = (jnp.argmax(logits_or_pred, -1) if logits_or_pred.ndim > labels.ndim
+            else logits_or_pred)
+    correct = (pred == labels).astype(jnp.float32)
+    if weights is not None:
+        return jnp.sum(correct * weights), jnp.sum(weights)
+    return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
+
+
+def top_k_accuracy(logits: jax.Array, labels: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    _, idx = jax.lax.top_k(logits, k)
+    hit = jnp.any(idx == labels[..., None], axis=-1).astype(jnp.float32)
+    return jnp.sum(hit), jnp.asarray(hit.size, jnp.float32)
+
+
+def auc_histogram(probs: jax.Array, labels: jax.Array, num_thresholds: int = 200
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Histogram counts for streaming AUC (ref: operators/auc_op.cc uses
+    thresholded TP/FP accumulation; gserver AucEvaluator).
+
+    Returns (pos_hist, neg_hist) of shape [num_thresholds]: counts of
+    positive/negative examples per probability bin. AUC is computed from the
+    accumulated histograms by the evaluator."""
+    p = jnp.clip(probs, 0.0, 1.0)
+    bin_idx = jnp.minimum((p * num_thresholds).astype(jnp.int32), num_thresholds - 1)
+    pos = jnp.zeros((num_thresholds,)).at[bin_idx].add(labels.astype(jnp.float32))
+    neg = jnp.zeros((num_thresholds,)).at[bin_idx].add(1.0 - labels.astype(jnp.float32))
+    return pos, neg
+
+
+def auc_from_histogram(pos_hist: jax.Array, neg_hist: jax.Array) -> jax.Array:
+    """Trapezoidal AUC over the ROC built from per-bin counts."""
+    # descending threshold: cumulative sums from the top bin
+    tp = jnp.cumsum(pos_hist[::-1])
+    fp = jnp.cumsum(neg_hist[::-1])
+    tot_p = jnp.maximum(tp[-1], 1e-12)
+    tot_n = jnp.maximum(fp[-1], 1e-12)
+    tpr = jnp.concatenate([jnp.zeros((1,)), tp / tot_p])
+    fpr = jnp.concatenate([jnp.zeros((1,)), fp / tot_n])
+    return jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
+
+
+def precision_recall_counts(pred: jax.Array, labels: jax.Array, num_classes: int
+                            ) -> jax.Array:
+    """Per-class [TP, FP, FN] counts (ref: operators/precision_recall_op.cc,
+    gserver PrecisionRecallEvaluator). pred/labels: [B] ints.
+
+    Returns [num_classes, 3]."""
+    onehot_p = jax.nn.one_hot(pred, num_classes)
+    onehot_l = jax.nn.one_hot(labels, num_classes)
+    tp = jnp.sum(onehot_p * onehot_l, axis=0)
+    fp = jnp.sum(onehot_p * (1.0 - onehot_l), axis=0)
+    fn = jnp.sum((1.0 - onehot_p) * onehot_l, axis=0)
+    return jnp.stack([tp, fp, fn], axis=1)
+
+
+def chunk_count(pred_tags: jax.Array, label_tags: jax.Array, lengths: jax.Array,
+                scheme: str = "IOB", num_chunk_types: int = 1
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Chunk (NER span) counting for F1 (ref: gserver ChunkEvaluator.cpp,
+    operators/chunk_eval_op.cc). IOB scheme with tag = chunk_type*2 + {0:B, 1:I}.
+
+    Returns (num_correct_chunks, num_pred_chunks, num_label_chunks)."""
+    from ..core.lod import sequence_mask
+    B, T = pred_tags.shape
+    mask = sequence_mask(lengths, T, jnp.bool_)
+
+    def starts(tags):
+        # B-tag, or I-tag whose previous tag is a different chunk type / not adjacent
+        is_b = (tags % 2) == 0
+        ctype = tags // 2
+        prev = jnp.concatenate([jnp.full((B, 1), -1, tags.dtype), tags[:, :-1]], axis=1)
+        prev_ctype = prev // 2
+        is_i = (tags % 2) == 1
+        broken = is_i & ((prev < 0) | (prev_ctype != ctype))
+        return (is_b | broken) & mask
+
+    ps, ls = starts(pred_tags), starts(label_tags)
+    n_pred = jnp.sum(ps.astype(jnp.float32))
+    n_label = jnp.sum(ls.astype(jnp.float32))
+
+    # correct chunk: both start at same pos with same type, tags agree across the
+    # label chunk's span, and the pred chunk ends where the label chunk ends
+    same = (pred_tags == label_tags) & mask
+    both_start = ps & ls
+
+    def seg_all_equal(start_mask, eq):
+        # running AND of eq, reset at each label-chunk start
+        def step(carry, inp):
+            e_t, s_t = inp
+            run = jnp.where(s_t, e_t, carry & e_t)
+            return run, run
+        eqT = jnp.swapaxes(eq, 0, 1)
+        sT = jnp.swapaxes(start_mask, 0, 1)
+        _, runs = jax.lax.scan(step, jnp.ones((B,), jnp.bool_), (eqT, sT))
+        return jnp.swapaxes(runs, 0, 1)  # [B, T] running-equal within label chunk
+
+    run_eq = seg_all_equal(ls, same)
+    # a label chunk ends where the next position starts a new label chunk or is invalid
+    nxt_start = jnp.concatenate([ls[:, 1:], jnp.ones((B, 1), jnp.bool_)], axis=1)
+    nxt_invalid = jnp.concatenate([~mask[:, 1:], jnp.ones((B, 1), jnp.bool_)], axis=1)
+    chunk_end = mask & (nxt_start | nxt_invalid)
+    # pred must also end its chunk at the same place
+    pnxt_start = jnp.concatenate([ps[:, 1:], jnp.ones((B, 1), jnp.bool_)], axis=1)
+    p_end = mask & (pnxt_start | nxt_invalid)
+    correct = jnp.sum((chunk_end & p_end & run_eq & both_start_propagate(both_start, ls, B, T)).astype(jnp.float32))
+    return correct, n_pred, n_label
+
+
+def both_start_propagate(both_start, label_starts, B, T):
+    """Propagate 'chunk started aligned' from each label-chunk start to its end."""
+    def step(carry, inp):
+        b_t, s_t = inp
+        run = jnp.where(s_t, b_t, carry)
+        return run, run
+    bT = jnp.swapaxes(both_start, 0, 1)
+    sT = jnp.swapaxes(label_starts, 0, 1)
+    _, runs = jax.lax.scan(step, jnp.zeros((B,), jnp.bool_), (bT, sT))
+    return jnp.swapaxes(runs, 0, 1)

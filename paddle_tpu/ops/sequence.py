@@ -1,0 +1,168 @@
+"""Sequence ops over padded LoD batches.
+
+Replaces the reference's sequence-aware layer/op family: SequencePoolLayer
+(gserver/layers/SequencePoolLayer.cpp: max/average/sum/last/first over sequences),
+sequence_expand (operators/seq_expand_op.cc), sequence_concat/slice
+(SequenceConcatLayer.cpp, SequenceSliceLayer.cpp), sequence_conv
+(operators/sequence_conv_op.cc + ContextProjection function/ContextProjectionOp.cpp),
+sequence_reverse, and the first/last-instance layers. All take (data [B, T, ...],
+lengths [B]) in place of LoD offsets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import sequence_mask
+
+
+def _mask(x, lengths, fill=0.0):
+    m = sequence_mask(lengths, x.shape[1], jnp.bool_)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return jnp.where(m, x, fill), m
+
+
+def sequence_pool(x: jax.Array, lengths: jax.Array, pool_type: str = "average") -> jax.Array:
+    """[B, T, D] -> [B, D]. pool_type: average|sum|max|min|sqrt|last|first
+    (ref: SequencePoolLayer.cpp, operators/sequence_pool_op.cc)."""
+    n = jnp.maximum(lengths.astype(x.dtype), 1)
+    shape_n = n.reshape((-1,) + (1,) * (x.ndim - 2))
+    if pool_type in ("average", "avg"):
+        xm, _ = _mask(x, lengths)
+        return jnp.sum(xm, axis=1) / shape_n
+    if pool_type == "sum":
+        xm, _ = _mask(x, lengths)
+        return jnp.sum(xm, axis=1)
+    if pool_type == "sqrt":
+        xm, _ = _mask(x, lengths)
+        return jnp.sum(xm, axis=1) / jnp.sqrt(shape_n)
+    if pool_type == "max":
+        xm, _ = _mask(x, lengths, fill=-jnp.inf)
+        return jnp.max(xm, axis=1)
+    if pool_type == "min":
+        xm, _ = _mask(x, lengths, fill=jnp.inf)
+        return jnp.min(xm, axis=1)
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1
+        )[:, 0]
+    if pool_type == "first":
+        return x[:, 0]
+    raise ValueError(f"unknown pool_type '{pool_type}'")
+
+
+def sequence_last_step(x, lengths):
+    return sequence_pool(x, lengths, "last")
+
+
+def sequence_first_step(x, lengths):
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_expand(x: jax.Array, ref_lengths: jax.Array, max_len: int) -> jax.Array:
+    """Broadcast one vector per sequence across its timesteps:
+    [B, D] -> [B, T, D] masked to ref lengths (ref: seq_expand_op.cc / ExpandLayer)."""
+    out = jnp.broadcast_to(x[:, None, :], (x.shape[0], max_len, x.shape[-1]))
+    m = sequence_mask(ref_lengths, max_len, x.dtype)
+    return out * m[..., None]
+
+
+def sequence_reverse(x: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Reverse each sequence's valid prefix in place, keep padding at the tail
+    (ref: gserver SequenceReverseLayer / operators/sequence_reverse semantics)."""
+    B, T = x.shape[0], x.shape[1]
+    pos = jnp.arange(T)
+    # index j of reversed: maps to length-1-j for j < len else j (identity on padding)
+    idx = jnp.where(pos[None, :] < lengths[:, None],
+                    jnp.maximum(lengths[:, None] - 1 - pos[None, :], 0),
+                    pos[None, :])
+    return jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+
+
+def sequence_slice(x: jax.Array, lengths: jax.Array, offset: jax.Array,
+                   length: jax.Array, max_out: int) -> jax.Array:
+    """Per-sequence subsequence extraction (ref: SequenceSliceLayer.cpp).
+
+    offset/length: [B] per-sequence start and new length; output padded to max_out."""
+    B, T = x.shape[0], x.shape[1]
+    pos = jnp.arange(max_out)
+    src = offset[:, None] + pos[None, :]
+    src = jnp.clip(src, 0, T - 1)
+    out = jnp.take_along_axis(x, src.reshape(src.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+    m = (pos[None, :] < length[:, None])
+    return jnp.where(m.reshape(m.shape + (1,) * (x.ndim - 2)), out, 0.0)
+
+
+def sequence_concat(a: jax.Array, la: jax.Array, b: jax.Array, lb: jax.Array,
+                    max_out: Optional[int] = None):
+    """Concatenate sequences pairwise in time (ref: SequenceConcatLayer.cpp).
+
+    Returns (data [B, max_out, D], lengths la+lb)."""
+    B, Ta = a.shape[0], a.shape[1]
+    Tb = b.shape[1]
+    T = max_out if max_out is not None else Ta + Tb
+    lengths = la + lb
+    pos = jnp.arange(T)
+    in_a = pos[None, :] < la[:, None]
+    idx_a = jnp.clip(pos[None, :], 0, Ta - 1)
+    idx_b = jnp.clip(pos[None, :] - la[:, None], 0, Tb - 1)
+    ga = jnp.take_along_axis(a, idx_a.reshape(idx_a.shape + (1,) * (a.ndim - 2)).astype(jnp.int32), axis=1)
+    gb = jnp.take_along_axis(b, idx_b.reshape(idx_b.shape + (1,) * (b.ndim - 2)).astype(jnp.int32), axis=1)
+    sel = in_a.reshape(in_a.shape + (1,) * (a.ndim - 2))
+    out = jnp.where(sel, ga, gb)
+    valid = pos[None, :] < lengths[:, None]
+    out = jnp.where(valid.reshape(valid.shape + (1,) * (a.ndim - 2)), out, 0.0)
+    return out, lengths
+
+
+def context_projection(x: jax.Array, lengths: jax.Array, context_start: int,
+                       context_length: int, w: Optional[jax.Array] = None) -> jax.Array:
+    """Sliding context-window concat (ref: function/ContextProjectionOp.cpp,
+    gserver ContextProjection; the core of sequence_conv).
+
+    [B, T, D] -> [B, T, context_length*D]; out-of-range steps zero-padded (or taken
+    from trainable boundary weights w [pad_rows, D] like the reference's
+    trainable_padding)."""
+    B, T, D = x.shape
+    valid0 = sequence_mask(lengths, T, x.dtype)
+    cols = []
+    for c in range(context_start, context_start + context_length):
+        if c == 0:
+            cols.append(x * valid0[..., None])
+            continue
+        shifted = jnp.roll(x, -c, axis=1)
+        pos = jnp.arange(T)
+        valid = (pos[None, :] + c >= 0) & (pos[None, :] + c < lengths[:, None])
+        shifted = jnp.where(valid[..., None], shifted, 0.0)
+        if w is not None:
+            # trainable boundary rows: row index within the padding block
+            if c < 0:
+                pad_row = jnp.clip(pos[None, :] + c + (-context_start), 0, w.shape[0] - 1)
+                use_pad = (pos[None, :] + c < 0)
+            else:
+                over = pos[None, :] + c - lengths[:, None]
+                pad_row = jnp.clip((-context_start) + over, 0, w.shape[0] - 1)
+                use_pad = (pos[None, :] + c >= lengths[:, None]) & (pos[None, :] < lengths[:, None])
+            padv = w[pad_row]
+            shifted = jnp.where(use_pad[..., None], padv, shifted)
+        # mask the DESTINATION position too: padding timesteps stay zero even for
+        # negative offsets / trainable pad rows (padded-batch invariant)
+        cols.append(shifted * valid0[..., None])
+    return jnp.concatenate(cols, axis=-1)
+
+
+def sequence_conv(x: jax.Array, lengths: jax.Array, filt: jax.Array,
+                  context_start: int = -1, context_length: int = 3,
+                  b: Optional[jax.Array] = None) -> jax.Array:
+    """Sequence convolution = context projection + matmul
+    (ref: operators/sequence_conv_op.cc). filt: [context_length*D, H]."""
+    ctx = context_projection(x, lengths, context_start, context_length)
+    out = jnp.einsum("btd,dh->bth", ctx, filt)
+    if b is not None:
+        out = out + b
+    m = sequence_mask(lengths, x.shape[1], out.dtype)
+    return out * m[..., None]

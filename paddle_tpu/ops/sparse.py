@@ -1,0 +1,86 @@
+"""Sparse-row gradients (SelectedRows) and embedding update path.
+
+The reference's sparse story: ``SelectedRows`` (framework/selected_rows.h) carries
+{rows, value} for gradients touching few rows of a big table;
+``SparseRowCpuMatrix``/``SparseAutoGrowRowCpuMatrix`` (math/SparseRowMatrix.h) back
+sparse SGD, and the remote path ships only touched rows
+(trainer/RemoteParameterUpdater.h:265 SparseRemoteParameterUpdater,
+pserver getParameterSparse).
+
+TPU-native design (SURVEY §7): embedding tables live sharded on HBM; the "sparse
+gradient" is (ids, grad_rows) pairs and the optimizer applies a row-gathered update
+with scatter-add HLO — no pserver. For tables larger than HBM the host-offload
+variant keeps the table in host memory and streams touched rows (left for the
+multi-host milestone).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class SelectedRows:
+    """Sparse gradient: values [K, D] at row indices rows [K] of a [N, D] table."""
+
+    rows: jax.Array
+    values: jax.Array
+    height: int  # static: number of rows of the dense table
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros((self.height, self.values.shape[-1]), self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+
+def embedding_grad_rows(ids: jax.Array, out_grad: jax.Array, height: int
+                        ) -> SelectedRows:
+    """Build the SelectedRows gradient of an embedding lookup: one row per lookup
+    (duplicate ids intentionally kept — scatter-add merges them, matching
+    SelectedRows semantics of repeated rows)."""
+    flat_ids = ids.reshape(-1)
+    flat_g = out_grad.reshape(-1, out_grad.shape[-1])
+    return SelectedRows(flat_ids, flat_g, height)
+
+
+def sgd_sparse_update(table: jax.Array, grad: SelectedRows, lr) -> jax.Array:
+    """Row-sparse SGD (ref: operators/sgd_op.cc SelectedRows branch)."""
+    return table.at[grad.rows].add(-lr * grad.values)
+
+
+def adagrad_sparse_update(table: jax.Array, moment: jax.Array, grad: SelectedRows,
+                          lr, eps: float = 1e-6) -> Tuple[jax.Array, jax.Array]:
+    """Row-sparse Adagrad (ref: operators/adagrad_op.cc sparse kernel): merge
+    duplicate rows first, then update each touched row once.
+
+    Duplicate-row merge goes through a dense scatter-add (static shapes rule out a
+    dynamic unique()); the per-row gather/sets after it are idempotent across
+    duplicates, so each touched row is updated exactly once with the merged grad."""
+    merged = grad.to_dense()                     # [N, D]; sums duplicate rows
+    g_rows = merged[grad.rows]                   # [K, D] merged grad per touched row
+    new_m_rows = moment[grad.rows] + jnp.square(g_rows)
+    moment = moment.at[grad.rows].set(new_m_rows)
+    step = -lr * g_rows / (jnp.sqrt(new_m_rows) + eps)
+    table = table.at[grad.rows].set(table[grad.rows] + step)
+    return table, moment
+
+
+def lookup_table(table: jax.Array, ids: jax.Array,
+                 padding_idx: int = None) -> jax.Array:
+    """Embedding lookup (ref: operators/lookup_table_op.cc). Forward for both the
+    dense-autodiff path and the manual sparse path."""
+    out = jnp.take(table, ids, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return out

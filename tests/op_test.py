@@ -1,0 +1,50 @@
+"""Numeric-gradient checking harness.
+
+Port of the reference's test backbone (SURVEY §4.1): central-difference numeric
+gradients vs analytic gradients — gen-2 ``op_test.py:get_numeric_gradient`` (:80) and
+gen-1 ``LayerGradUtil`` perturbation machinery. Here the analytic side is jax.grad;
+the check still matters because many ops are hand-written dynamic programs (CRF, CTC,
+masked scans) where a subtle masking bug produces a *valid* but *wrong* gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def numeric_grad(f: Callable, args: Sequence[np.ndarray], wrt: int,
+                 eps: float = 1e-3) -> np.ndarray:
+    """Central differences d f / d args[wrt]; f returns a scalar."""
+    args = [np.asarray(a, dtype=np.float64 if np.issubdtype(np.asarray(a).dtype, np.floating) else None)
+            for a in args]
+    x = np.array(args[wrt], dtype=np.float64)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = float(f(*[a if j != wrt else x.astype(np.float32) for j, a in enumerate(args)]))
+        flat[i] = orig - eps
+        fm = float(f(*[a if j != wrt else x.astype(np.float32) for j, a in enumerate(args)]))
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def check_grad(f: Callable, args: Sequence[np.ndarray], wrt: int = 0,
+               eps: float = 1e-3, rtol: float = 5e-2, atol: float = 2e-3):
+    """Assert analytic jax.grad matches central differences.
+
+    Tolerances are loose like the reference's (op_test.py uses max-relative-error
+    thresholds ~0.005-0.05) because eps-discretization and f32 round-off interact.
+    """
+    f32_args = [jnp.asarray(a) for a in args]
+    ana = jax.grad(lambda *xs: f(*xs), argnums=wrt)(*f32_args)
+    num = numeric_grad(f, args, wrt, eps)
+    np.testing.assert_allclose(np.asarray(ana), num, rtol=rtol, atol=atol,
+                               err_msg=f"gradient mismatch wrt arg {wrt}")

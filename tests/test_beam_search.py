@@ -1,0 +1,87 @@
+"""Beam-search tests: known-distribution decoding (analog of
+test_RecurrentGradientMachine generation tests + beam_search_op tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import beam_search as bs
+
+
+def _fixed_step(table):
+    """Decoder whose next-token log-probs depend only on current token."""
+    def step(cell, tokens):
+        logp = jnp.log(table[tokens] + 1e-9)
+        return logp, cell
+    return step
+
+
+def test_greedy_follows_argmax_chain():
+    # vocab 4, token i -> deterministic next token (i+1) % 3, eos=3 after token 2
+    V = 4
+    table = np.full((V, V), 1e-6, np.float32)
+    table[0, 1] = 1.0
+    table[1, 2] = 1.0
+    table[2, 3] = 1.0  # -> eos
+    table[3, 3] = 1.0
+    table /= table.sum(-1, keepdims=True)
+    toks, score = bs.greedy_search({}, _fixed_step(jnp.asarray(table)),
+                                   batch_size=2, max_len=5, bos_id=0, eos_id=3)
+    np.testing.assert_array_equal(np.asarray(toks[0]), [1, 2, 3, 3, 3])
+
+
+def test_beam_finds_higher_prob_path():
+    # greedy takes token 1 first (p=.6) but the 2-step path through 2 is better:
+    # p(1)*best_after_1 = .6*.4 = .24 < p(2)*best_after_2 = .4*.9 = .36
+    V = 4
+    eos = 3
+    table = np.full((V, V), 1e-9, np.float32)
+    table[0, 1] = 0.6
+    table[0, 2] = 0.4
+    table[1, eos] = 0.4
+    table[1, 1] = 0.6  # continuing costs more later
+    table[1, 2] = 1e-9
+    table[2, eos] = 0.9
+    table[2, 1] = 0.1
+    table[eos, eos] = 1.0
+    table /= table.sum(-1, keepdims=True)
+    toks, scores = bs.beam_search(
+        {}, _fixed_step(jnp.asarray(table)), batch_size=1, beam_size=3, max_len=4,
+        vocab_size=V, bos_id=0, eos_id=eos)
+    # best beam should start with 2 then eos
+    np.testing.assert_array_equal(np.asarray(toks[0, 0, :2]), [2, eos])
+    # scores sorted descending
+    s = np.asarray(scores[0])
+    assert np.all(np.diff(s) <= 1e-5)
+
+
+def test_beam_constraint_fn_masks_tokens():
+    V = 4
+    eos = 3
+    table = np.full((V, V), 0.25, np.float32)
+
+    def forbid_token_1(logp, step):
+        return logp.at[..., 1].set(-1e9)
+
+    toks, _ = bs.beam_search(
+        {}, _fixed_step(jnp.asarray(table)), batch_size=1, beam_size=2, max_len=4,
+        vocab_size=V, bos_id=0, eos_id=eos, constraint_fn=forbid_token_1)
+    assert not np.any(np.asarray(toks) == 1)
+
+
+def test_beam_state_gather():
+    """Recurrent state must follow its beam when beams are reordered."""
+    V, eos = 5, 4
+
+    def step(cell, tokens):
+        # state accumulates the token history sum; logp prefers token = state%3 + 1
+        new_cell = {"acc": cell["acc"] + tokens}
+        logp = jax.nn.log_softmax(
+            jax.nn.one_hot((new_cell["acc"] % 3) + 1, V) * 5.0, -1)
+        return logp, new_cell
+
+    init = {"acc": jnp.zeros((2,), jnp.int32)}
+    toks, scores = bs.beam_search(
+        init, step, batch_size=2, beam_size=2, max_len=3, vocab_size=V,
+        bos_id=0, eos_id=eos)
+    assert toks.shape == (2, 2, 3)

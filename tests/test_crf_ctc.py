@@ -1,0 +1,129 @@
+"""CRF and CTC tests: brute-force equivalence on tiny cases + gradient checks
+(analog of gserver/tests/test_CRFLayerGrad.cpp, test_LinearChainCRF.cpp,
+test_WarpCTCLayer.cpp)."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import crf, ctc
+from op_test import check_grad
+
+
+def brute_force_log_norm(em, start, end, trans, length):
+    """Enumerate all tag paths (tiny N, T)."""
+    N = em.shape[-1]
+    scores = []
+    for path in itertools.product(range(N), repeat=length):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + em[t, path[t]]
+        s += end[path[-1]]
+        scores.append(s)
+    m = max(scores)
+    return m + np.log(sum(np.exp(np.array(scores) - m)))
+
+
+def test_crf_log_norm_matches_brute_force(np_rng):
+    N, T = 3, 4
+    em = np_rng.randn(2, T, N).astype(np.float32)
+    start = np_rng.randn(N).astype(np.float32)
+    end = np_rng.randn(N).astype(np.float32)
+    trans = np_rng.randn(N, N).astype(np.float32)
+    lengths = np.array([4, 2], np.int32)
+    logz = crf.crf_log_norm(jnp.asarray(em), jnp.asarray(lengths), start, end, trans)
+    for b, L in enumerate(lengths):
+        expect = brute_force_log_norm(em[b], start, end, trans, L)
+        np.testing.assert_allclose(float(logz[b]), expect, rtol=1e-4)
+
+
+def test_crf_decode_matches_brute_force(np_rng):
+    N, T = 3, 4
+    em = np_rng.randn(1, T, N).astype(np.float32)
+    start = np_rng.randn(N).astype(np.float32)
+    end = np_rng.randn(N).astype(np.float32)
+    trans = np_rng.randn(N, N).astype(np.float32)
+    lengths = np.array([T], np.int32)
+    tags, score = crf.crf_decode(jnp.asarray(em), jnp.asarray(lengths), start, end, trans)
+    # brute force best path
+    best, best_s = None, -1e30
+    for path in itertools.product(range(N), repeat=T):
+        s = start[path[0]] + em[0, 0, path[0]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + em[0, t, path[t]]
+        s += end[path[-1]]
+        if s > best_s:
+            best, best_s = path, s
+    np.testing.assert_array_equal(np.asarray(tags[0]), np.array(best))
+    np.testing.assert_allclose(float(score[0]), best_s, rtol=1e-4)
+
+
+def test_crf_loss_grad(np_rng):
+    N, T = 3, 3
+    em = np_rng.randn(2, T, N).astype(np.float32)
+    start = np_rng.randn(N).astype(np.float32)
+    end = np_rng.randn(N).astype(np.float32)
+    trans = np_rng.randn(N, N).astype(np.float32)
+    tags = jnp.asarray(np_rng.randint(0, N, (2, T)).astype(np.int32))
+    lengths = jnp.array([3, 2], jnp.int32)
+
+    def f(e, s, en, tr):
+        return jnp.sum(crf.crf_loss(e, tags, lengths, s, en, tr))
+
+    check_grad(f, [em, start, end, trans], wrt=0)
+    check_grad(f, [em, start, end, trans], wrt=3)
+
+
+def brute_force_ctc(logp, label, blank=0):
+    """Sum prob over all alignments, tiny T/V."""
+    T, V = logp.shape
+    total = 0.0
+    for path in itertools.product(range(V), repeat=T):
+        # collapse
+        collapsed = []
+        prev = None
+        for p in path:
+            if p != blank and p != prev:
+                collapsed.append(p)
+            prev = p
+        if collapsed == list(label):
+            total += np.exp(sum(logp[t, path[t]] for t in range(T)))
+    return -np.log(total)
+
+
+def test_ctc_matches_brute_force(np_rng):
+    T, V = 4, 3
+    logits = np_rng.randn(1, T, V).astype(np.float32)
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))
+    labels = np.array([[1, 2]], np.int32)
+    loss = ctc.ctc_loss(jnp.asarray(logp), jnp.array([T]), jnp.asarray(labels),
+                        jnp.array([2]))
+    expect = brute_force_ctc(logp[0], [1, 2])
+    np.testing.assert_allclose(float(loss[0]), expect, rtol=1e-4)
+
+
+def test_ctc_grad(np_rng):
+    T, V = 4, 3
+    logits = np_rng.randn(2, T, V).astype(np.float32) * 0.5
+    labels = jnp.asarray(np.array([[1, 2], [2, 0]], np.int32))
+    in_len = jnp.array([4, 3])
+    lab_len = jnp.array([2, 1])
+
+    def f(lg):
+        lp = jax.nn.log_softmax(lg, -1)
+        return jnp.sum(ctc.ctc_loss(lp, in_len, labels, lab_len))
+
+    check_grad(f, [logits], wrt=0)
+
+
+def test_ctc_greedy_decode():
+    # path: [1, 1, 0, 2, 2] -> collapse -> [1, 2]
+    V = 3
+    logp = jnp.full((1, 5, V), -10.0)
+    path = [1, 1, 0, 2, 2]
+    logp = logp.at[0, jnp.arange(5), jnp.array(path)].set(0.0)
+    toks, lens = ctc.ctc_greedy_decode(logp, jnp.array([5]))
+    assert int(lens[0]) == 2
+    np.testing.assert_array_equal(np.asarray(toks[0, :2]), [1, 2])

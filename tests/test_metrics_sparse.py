@@ -1,0 +1,99 @@
+"""Metrics ops + SelectedRows sparse path tests (analog of operators/
+accuracy_op/auc_op/precision_recall tests and selected_rows functor tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import metrics, sparse
+
+
+def test_accuracy():
+    logits = jnp.asarray(np.array([[1, 2, 0], [5, 1, 1], [0, 1, 9]], np.float32))
+    labels = jnp.asarray(np.array([1, 0, 1], np.int32))
+    correct, total = metrics.accuracy(logits, labels)
+    assert float(correct) == 2.0 and float(total) == 3.0
+    c5, _ = metrics.top_k_accuracy(logits, labels, 2)
+    assert float(c5) == 3.0
+
+
+def test_auc_streaming_matches_sklearn_style(np_rng):
+    probs = np_rng.rand(500).astype(np.float32)
+    labels = (np_rng.rand(500) < probs).astype(np.float32)  # correlated -> auc > .5
+    # accumulate in two batches like a streaming evaluator
+    p1, n1 = metrics.auc_histogram(jnp.asarray(probs[:250]), jnp.asarray(labels[:250]))
+    p2, n2 = metrics.auc_histogram(jnp.asarray(probs[250:]), jnp.asarray(labels[250:]))
+    auc = float(metrics.auc_from_histogram(p1 + p2, n1 + n2))
+
+    # exact pairwise AUC
+    pos = probs[labels == 1]
+    neg = probs[labels == 0]
+    exact = np.mean((pos[:, None] > neg[None, :]) + 0.5 * (pos[:, None] == neg[None, :]))
+    assert abs(auc - exact) < 0.02, (auc, exact)
+
+
+def test_precision_recall_counts():
+    pred = jnp.asarray(np.array([0, 0, 1, 1, 2], np.int32))
+    lab = jnp.asarray(np.array([0, 1, 1, 1, 0], np.int32))
+    c = np.asarray(metrics.precision_recall_counts(pred, lab, 3))
+    # class 0: tp=1 fp=1 fn=1; class 1: tp=2 fp=0 fn=1; class 2: tp=0 fp=1 fn=0
+    np.testing.assert_array_equal(c, [[1, 1, 1], [2, 0, 1], [0, 1, 0]])
+
+
+def test_chunk_count_iob():
+    # tags: type0 -> B=0, I=1. seq: [B I O(pad sentinel via len)] compare spans
+    # pred:  B I B   label: B I B  -> 2 chunks each, 2 correct
+    pred = jnp.asarray(np.array([[0, 1, 0]], np.int32))
+    lab = jnp.asarray(np.array([[0, 1, 0]], np.int32))
+    lengths = jnp.array([3])
+    correct, n_pred, n_lab = metrics.chunk_count(pred, lab, lengths)
+    assert (float(n_pred), float(n_lab)) == (2.0, 2.0)
+    assert float(correct) == 2.0
+    # boundary mismatch: pred merges into one chunk [B I I] vs label [B I B]
+    pred2 = jnp.asarray(np.array([[0, 1, 1]], np.int32))
+    correct2, n_pred2, n_lab2 = metrics.chunk_count(pred2, lab, lengths)
+    assert float(n_pred2) == 1.0 and float(n_lab2) == 2.0
+    assert float(correct2) == 0.0
+
+
+def test_selected_rows_roundtrip_and_updates():
+    table = jnp.zeros((10, 4))
+    ids = jnp.asarray(np.array([[1, 3], [1, 5]], np.int32))
+    g = jnp.ones((2, 2, 4))
+    sr = sparse.embedding_grad_rows(ids, g, 10)
+    dense = np.asarray(sr.to_dense())
+    assert dense[1].sum() == 8.0  # id 1 hit twice
+    assert dense[3].sum() == 4.0 and dense[5].sum() == 4.0
+    assert dense[0].sum() == 0.0
+
+    t2 = sparse.sgd_sparse_update(table, sr, 0.5)
+    np.testing.assert_allclose(np.asarray(t2[1]), -1.0 * np.ones(4))
+
+    moment = jnp.zeros((10, 4))
+    t3, m3 = sparse.adagrad_sparse_update(table, moment, sr, 0.5)
+    # duplicate rows merged BEFORE squaring: id 1 grad = 1+1 = 2 -> moment = 4
+    np.testing.assert_allclose(np.asarray(m3)[1], 4.0)
+    # and the table row updated exactly once with the merged grad
+    np.testing.assert_allclose(np.asarray(t3)[1], -0.5 * 2.0 / (2.0 + 1e-6), rtol=1e-5)
+
+
+def test_sparse_matches_dense_sgd():
+    """Equivalence: sparse embedding update == dense autodiff update
+    (analog of test_CompareSparse.cpp dense-vs-sparse training)."""
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(8, 3).astype(np.float32))
+    ids = jnp.asarray(np.array([1, 2, 2, 7], np.int32))
+    target = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+
+    def loss(t):
+        emb = sparse.lookup_table(t, ids)
+        return 0.5 * jnp.sum(jnp.square(emb - target))
+
+    dense_grad = jax.grad(loss)(table)
+    dense_new = table - 0.1 * dense_grad
+
+    emb = sparse.lookup_table(table, ids)
+    out_grad = emb - target
+    sr = sparse.embedding_grad_rows(ids, out_grad, 8)
+    sparse_new = sparse.sgd_sparse_update(table, sr, 0.1)
+    np.testing.assert_allclose(np.asarray(dense_new), np.asarray(sparse_new), rtol=1e-5)

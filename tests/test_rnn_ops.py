@@ -1,0 +1,79 @@
+"""RNN op tests: masking semantics + gradient checks (analog of
+gserver/tests/test_LayerGrad.cpp LSTM/GRU cases and test_RecurrentLayer.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import rnn
+from op_test import check_grad
+
+
+def _lstm_params(np_rng, D, H):
+    w = np_rng.randn(D, 4 * H).astype(np.float32) * 0.3
+    u = np_rng.randn(H, 4 * H).astype(np.float32) * 0.3
+    b = np_rng.randn(4 * H).astype(np.float32) * 0.1
+    return w, u, b
+
+
+def test_lstm_masking_freezes_state(np_rng):
+    D, H = 3, 4
+    w, u, b = _lstm_params(np_rng, D, H)
+    x = np_rng.randn(2, 6, D).astype(np.float32)
+    lengths = jnp.array([6, 3], jnp.int32)
+    out, final = rnn.lstm(jnp.asarray(x), lengths, w, u, b)
+    # outputs at padded steps are zero
+    np.testing.assert_array_equal(np.asarray(out[1, 3:]), 0.0)
+    # final state of short seq equals state at its last valid step
+    out_full, final_short = rnn.lstm(jnp.asarray(x[1:2, :3]), jnp.array([3]), w, u, b)
+    np.testing.assert_allclose(np.asarray(final.h[1]), np.asarray(final_short.h[0]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(final.c[1]), np.asarray(final_short.c[0]),
+                               rtol=1e-5)
+
+
+def test_lstm_grad(np_rng):
+    D, H = 2, 3
+    w, u, b = _lstm_params(np_rng, D, H)
+    x = np_rng.randn(2, 4, D).astype(np.float32)
+    lengths = np.array([4, 2], np.int32)
+
+    def f(xx, ww, uu):
+        out, _ = rnn.lstm(jnp.asarray(xx), jnp.asarray(lengths), ww, uu, b)
+        return jnp.sum(out * out)
+
+    check_grad(f, [x, w, u], wrt=0)
+    check_grad(f, [x, w, u], wrt=1)
+    check_grad(f, [x, w, u], wrt=2)
+
+
+def test_gru_masking_and_grad(np_rng):
+    D, H = 2, 3
+    w = np_rng.randn(D, 3 * H).astype(np.float32) * 0.3
+    u = np_rng.randn(H, 3 * H).astype(np.float32) * 0.3
+    x = np_rng.randn(2, 5, D).astype(np.float32)
+    lengths = np.array([5, 2], np.int32)
+    out, h = rnn.gru(jnp.asarray(x), jnp.asarray(lengths), w, u)
+    np.testing.assert_array_equal(np.asarray(out[1, 2:]), 0.0)
+
+    def f(xx, ww):
+        o, _ = rnn.gru(jnp.asarray(xx), jnp.asarray(lengths), ww, u)
+        return jnp.sum(jnp.square(o))
+
+    check_grad(f, [x, w], wrt=0)
+    check_grad(f, [x, w], wrt=1)
+
+
+def test_bidirectional_concat(np_rng):
+    D, H = 3, 4
+    w, u, b = _lstm_params(np_rng, D, H)
+    w2, u2, b2 = _lstm_params(np_rng, D, H)
+    x = jnp.asarray(np_rng.randn(2, 5, D).astype(np.float32))
+    lengths = jnp.array([5, 3], jnp.int32)
+    out = rnn.bidirectional(rnn.lstm, x, lengths,
+                            dict(w=w, u=u, b=b), dict(w=w2, u=u2, b=b2))
+    assert out.shape == (2, 5, 2 * H)
+    # reverse direction of short seq must equal running the truncated seq reversed
+    out_b, _ = rnn.lstm(x[1:2, :3], jnp.array([3]), w2, u2, b2, reverse=True)
+    np.testing.assert_allclose(np.asarray(out[1, :3, H:]), np.asarray(out_b[0]),
+                               rtol=1e-5, atol=1e-6)

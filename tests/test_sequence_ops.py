@@ -1,0 +1,108 @@
+"""Sequence-op semantics tests (analog of gserver sequence layer tests in
+test_LayerGrad.cpp and test_SeqSliceLayerGrad.cpp)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import sequence as seq
+from op_test import check_grad
+
+
+def _batch(np_rng, B=2, T=5, D=3):
+    x = np_rng.randn(B, T, D).astype(np.float32)
+    lengths = np.array([5, 3], np.int32)
+    return jnp.asarray(x), jnp.asarray(lengths), x
+
+
+def test_sequence_pool_types(np_rng):
+    x, lengths, xn = _batch(np_rng)
+    avg = seq.sequence_pool(x, lengths, "average")
+    np.testing.assert_allclose(np.asarray(avg[1]), xn[1, :3].mean(0), rtol=1e-5)
+    mx = seq.sequence_pool(x, lengths, "max")
+    np.testing.assert_allclose(np.asarray(mx[1]), xn[1, :3].max(0), rtol=1e-5)
+    last = seq.sequence_pool(x, lengths, "last")
+    np.testing.assert_allclose(np.asarray(last[1]), xn[1, 2], rtol=1e-5)
+    first = seq.sequence_pool(x, lengths, "first")
+    np.testing.assert_allclose(np.asarray(first[1]), xn[1, 0], rtol=1e-5)
+    sqrt = seq.sequence_pool(x, lengths, "sqrt")
+    np.testing.assert_allclose(np.asarray(sqrt[1]), xn[1, :3].sum(0) / np.sqrt(3),
+                               rtol=1e-5)
+
+
+def test_sequence_pool_grads(np_rng):
+    x, lengths, xn = _batch(np_rng)
+
+    for ptype in ("average", "sum", "max", "sqrt"):
+        def f(xx):
+            return jnp.sum(jnp.square(seq.sequence_pool(jnp.asarray(xx), lengths, ptype)))
+        check_grad(f, [xn], wrt=0)
+
+
+def test_sequence_reverse(np_rng):
+    x, lengths, xn = _batch(np_rng)
+    r = seq.sequence_reverse(x, lengths)
+    np.testing.assert_allclose(np.asarray(r[1, :3]), xn[1, :3][::-1], rtol=1e-6)
+    # padding untouched positions remain from identity mapping
+    np.testing.assert_allclose(np.asarray(r[0]), xn[0][::-1], rtol=1e-6)
+
+
+def test_sequence_expand():
+    v = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    lengths = jnp.asarray(np.array([2, 4], np.int32))
+    out = seq.sequence_expand(v, lengths, max_len=5)
+    assert out.shape == (2, 5, 3)
+    np.testing.assert_array_equal(np.asarray(out[0, 0]), np.asarray(v[0]))
+    np.testing.assert_array_equal(np.asarray(out[0, 2:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(out[1, 3]), np.asarray(v[1]))
+
+
+def test_sequence_slice(np_rng):
+    x, lengths, xn = _batch(np_rng)
+    out = seq.sequence_slice(x, lengths, jnp.array([1, 0]), jnp.array([2, 3]), max_out=4)
+    np.testing.assert_allclose(np.asarray(out[0, :2]), xn[0, 1:3], rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out[0, 2:]), 0.0)
+    np.testing.assert_allclose(np.asarray(out[1, :3]), xn[1, :3], rtol=1e-6)
+
+
+def test_sequence_concat(np_rng):
+    a = jnp.asarray(np_rng.randn(2, 3, 2).astype(np.float32))
+    b = jnp.asarray(np_rng.randn(2, 3, 2).astype(np.float32))
+    la = jnp.array([2, 3])
+    lb = jnp.array([3, 1])
+    out, lengths = seq.sequence_concat(a, la, b, lb)
+    np.testing.assert_array_equal(np.asarray(lengths), [5, 4])
+    np.testing.assert_allclose(np.asarray(out[0, :2]), np.asarray(a[0, :2]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[0, 2:5]), np.asarray(b[0, :3]), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out[0, 5:]), 0.0)
+
+
+def test_context_projection_identity_window(np_rng):
+    x, lengths, xn = _batch(np_rng)
+    out = seq.context_projection(x, lengths, 0, 1)
+    np.testing.assert_allclose(np.asarray(out), xn * np.asarray(
+        (np.arange(5)[None, :] < np.asarray(lengths)[:, None])[..., None]), rtol=1e-6)
+
+
+def test_context_projection_negative_offset_no_padding_leak(np_rng):
+    x, lengths, xn = _batch(np_rng)  # lengths [5, 3]
+    out = seq.context_projection(x, lengths, -1, 1)
+    # destination padding timesteps must be zero even for negative offsets
+    np.testing.assert_array_equal(np.asarray(out[1, 3:]), 0.0)
+    # valid region: position t holds x[t-1]
+    np.testing.assert_allclose(np.asarray(out[1, 1:3]), xn[1, 0:2], rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out[1, 0]), 0.0)
+
+
+def test_sequence_conv_shapes_and_grad(np_rng):
+    x, lengths, xn = _batch(np_rng, D=3)
+    filt = np_rng.randn(9, 4).astype(np.float32) * 0.3
+
+    out = seq.sequence_conv(x, lengths, jnp.asarray(filt))
+    assert out.shape == (2, 5, 4)
+    # padded outputs masked
+    np.testing.assert_array_equal(np.asarray(out[1, 3:]), 0.0)
+
+    def f(xx, ff):
+        return jnp.sum(jnp.square(seq.sequence_conv(jnp.asarray(xx), lengths, ff)))
+    check_grad(f, [xn, filt], wrt=0)
+    check_grad(f, [xn, filt], wrt=1)
