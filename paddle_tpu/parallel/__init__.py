@@ -1,0 +1,42 @@
+"""Distributed / parallel execution — SPMD over a jax.sharding.Mesh.
+
+This package replaces ALL of the reference's parallelism machinery with the
+TPU-native SPMD design (SURVEY.md §2.5):
+
+* ``MultiGradientMachine`` ring data-parallel (gserver/gradientmachines/
+  MultiGradientMachine.h:44-97)         -> :mod:`data_parallel` (batch sharded over the
+  ``data`` mesh axis; XLA inserts ``psum`` over ICI).
+* pserver sharded params + RemoteParameterUpdater (pserver/ParameterServer2.h,
+  trainer/RemoteParameterUpdater.h)     -> collective DP; optimizer state sharded with
+  ZeRO-style ``reduce_scatter`` when requested.
+* ``ParallelNeuralNetwork`` per-layer device placement (--parallel_nn)
+                                        -> :mod:`tensor_parallel` sharding annotations +
+  :mod:`pipeline` stage partitioning over a ``pipe`` mesh axis.
+* NCCL ops (operators/nccl_op.cc:19-148) -> :mod:`collectives` named XLA collectives.
+* (modern capability extension, no 2017 analog) :mod:`ring_attention` — sequence-dim
+  sharding with blockwise attention over a ``seq`` mesh axis via ``ppermute``.
+"""
+
+from .mesh import MeshSpec, make_mesh, local_mesh, mesh_axis_size
+from .sharding import (replicate, shard, shard_batch, shard_params,
+                       with_sharding_constraint, ShardingRules)
+from .collectives import (all_reduce, all_gather, reduce_scatter, broadcast,
+                          all_to_all, permute_ring, axis_index)
+from .data_parallel import DataParallel
+from .tensor_parallel import ColumnParallelLinear, RowParallelLinear, ShardedEmbedding
+from .ring_attention import (ring_attention, blockwise_attention,
+                             ring_self_attention, ulysses_attention)
+from .pipeline import PipelineStage, pipeline_spmd
+
+__all__ = [
+    "MeshSpec", "make_mesh", "local_mesh", "mesh_axis_size",
+    "replicate", "shard", "shard_batch", "shard_params",
+    "with_sharding_constraint", "ShardingRules",
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
+    "permute_ring", "axis_index",
+    "DataParallel",
+    "ColumnParallelLinear", "RowParallelLinear", "ShardedEmbedding",
+    "ring_attention", "blockwise_attention", "ring_self_attention",
+    "ulysses_attention",
+    "PipelineStage", "pipeline_spmd",
+]

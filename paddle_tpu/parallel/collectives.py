@@ -1,0 +1,79 @@
+"""Named XLA collectives — the framework's communication primitive set.
+
+Replaces the reference's three communication backends with one: XLA collectives
+over ICI/DCN (SURVEY.md §5 'Distributed communication backend'):
+
+* NCCL operator family — ncclAllReduce/ncclReduce/ncclBcast
+  (operators/nccl_op.cc:66,93,119)        -> all_reduce / reduce-to-root / broadcast
+* MultiGradientMachine software ring allreduce
+  (MultiGradientMachine.h:61-83)           -> all_reduce (XLA picks the ring/tree)
+* pserver grad scatter + param gather
+  (pserver/ParameterClient2.cpp)           -> reduce_scatter + all_gather
+
+These are thin wrappers over ``jax.lax`` primitives so framework code reads in
+terms of collective names; inside ``shard_map`` the axis_name binds to a mesh axis
+and XLA emits the ICI collective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce(x, axis_name: str, op: str = "sum"):
+    """Sum/mean/max over a mesh axis (ncclAllReduce analog, nccl_op.cc:66)."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduction {op}")
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """Concatenate shards from every device along ``axis``."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    """Sum then scatter shards — the ZeRO grad-shard primitive."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x, axis_name: str, root: int = 0):
+    """Every device gets root's value (ncclBcast analog, nccl_op.cc:119)."""
+    idx = lax.axis_index(axis_name)
+    n = lax.psum(1, axis_name)
+    mask = (idx == root).astype(x.dtype)
+    # zero out non-root shards then sum: O(allreduce) but shape-stable.
+    return lax.psum(x * mask, axis_name) if n > 1 else x
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    """Transpose shard ownership — the Ulysses/sequence<->head exchange primitive."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def permute_ring(x, axis_name: str, shift: int = 1):
+    """Pass each shard to the next device on the axis ring (collective-permute).
+
+    The explicit building block of ring attention and pipelined collectives —
+    the TPU-native version of the hand-written device ring in
+    MultiGradientMachine.h:61-83.
+    """
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    """This device's coordinate on a mesh axis (trainer_id analog, utils/Flags.h)."""
+    return lax.axis_index(axis_name)

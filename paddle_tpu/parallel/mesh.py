@@ -1,0 +1,81 @@
+"""Device-mesh construction.
+
+The reference enumerates GPUs and spreads ``TrainerThread``s over them
+(gserver/gradientmachines/MultiGradientMachine.h:44-97) and reaches other hosts
+through pserver RPC. TPU-native: one logical ``jax.sharding.Mesh`` spans every chip
+in the job (ICI within a slice, DCN across slices); parallelism strategies are just
+named mesh axes.
+
+Canonical axis names used across the framework:
+  ``data``  — batch sharding (DP)           ``model`` — tensor/model parallel (TP)
+  ``pipe``  — pipeline stages (PP)          ``seq``   — sequence/context parallel (SP)
+  ``expert``— expert parallel (EP, reserved)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+# Axis ordering: innermost (fastest-varying over devices) LAST so that the most
+# communication-heavy axis (model/seq) lands on nearest-neighbour ICI links.
+CANONICAL_ORDER = ("pipe", "data", "expert", "seq", "model")
+
+
+@dataclass
+class MeshSpec:
+    """Declarative mesh request: axis name -> size. Size -1 means 'the rest'."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        axes = {k: v for k, v in self.axes.items() if v != 1 or k == "data"}
+        if not axes:
+            axes = {"data": -1}
+        wild = [k for k, v in axes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis, got {wild}")
+        known = int(np.prod([v for v in axes.values() if v != -1]))
+        if wild:
+            if n_devices % known:
+                raise ValueError(f"{n_devices} devices not divisible by {known}")
+            axes[wild[0]] = n_devices // known
+        total = int(np.prod(list(axes.values())))
+        if total > n_devices or n_devices % total:
+            raise ValueError(f"mesh {axes} needs {total} devices, have {n_devices}")
+        return axes
+
+
+def make_mesh(spec: Optional[MeshSpec] = None, devices: Optional[Sequence] = None,
+              **axes: int) -> Mesh:
+    """Build a Mesh from a spec or kwargs: ``make_mesh(data=4, model=2)``.
+
+    Axes are laid out in CANONICAL_ORDER so the model axis maps to adjacent
+    devices (nearest-neighbour ICI) and pipe to the outermost dimension.
+    """
+    if spec is None:
+        spec = MeshSpec(dict(axes))
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    resolved = spec.resolve(len(devices))
+    names = tuple(sorted(resolved, key=lambda a: CANONICAL_ORDER.index(a)
+                         if a in CANONICAL_ORDER else len(CANONICAL_ORDER)))
+    shape = tuple(resolved[a] for a in names)
+    n = int(np.prod(shape))
+    arr = np.array(devices[:n]).reshape(shape)   # a sub-mesh is allowed
+    return Mesh(arr, names)
+
+
+def local_mesh(**axes: int) -> Mesh:
+    """Mesh over this process's addressable devices (single-host path)."""
+    return make_mesh(devices=jax.local_devices(), **axes)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)

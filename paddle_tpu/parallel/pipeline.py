@@ -1,0 +1,102 @@
+"""Pipeline parallelism over a ``pipe`` mesh axis (GPipe-style SPMD).
+
+The reference pipelines by placing whole layers on devices and streaming
+batches through per-device threads (ParallelNeuralNetwork.h:23-34, TaskType
+fwd/bwd queues). TPU-native: all stages run the SAME jitted SPMD program; stage
+parameters are stacked on a leading axis sharded over ``pipe``, microbatch
+activations hop stage->stage via ``ppermute`` over ICI, and the schedule is a
+``lax.fori_loop`` of (n_microbatches + n_stages - 1) ticks. Autodiff flows
+through ppermute, so the same program trains (XLA overlaps the transfers —
+recovering the reference's thread-pipelined overlap, SURVEY §2.5 row
+'Pipeline-ish overlap').
+
+Constraint inherited from SPMD: every stage must share one activation shape
+(equal-width trunk), the usual homogeneous-transformer-stack case.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.module import Module
+
+
+class PipelineStage(Module):
+    """Repeats one stage Module across pipeline stages with stacked params.
+
+    ``init`` produces params with a leading [n_stages] axis on every leaf;
+    shard that axis over ``pipe`` and run via :func:`pipeline_spmd`.
+    """
+
+    def __init__(self, make_stage: Callable[[], Module], n_stages: int):
+        super().__init__()
+        self.n_stages = n_stages
+        self.stage = make_stage()
+
+    def init(self, rng):
+        keys = jax.random.split(rng, self.n_stages)
+        per_stage = [self.stage.init(k) for k in keys]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
+
+    def __call__(self, params, x, **kw):
+        """Reference (non-pipelined) execution: fold over stages sequentially."""
+        def body(x, stage_params):
+            return self.stage(stage_params, x, **kw), None
+        out, _ = lax.scan(body, x, params)
+        return out
+
+
+def pipeline_spmd(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
+                  axis: str = "pipe"):
+    """Build fn(stacked_params, x) running stage_fn through the pipe ring.
+
+    stage_fn(stage_params, mb) -> mb', same shape. ``x`` is [B, ...]; it is
+    split into ``n_microbatches`` along dim 0 (B % n_microbatches == 0).
+    Returns the full output batch, replicated over the pipe axis.
+    """
+    n_stages = mesh.shape[axis]
+
+    def local(params, x):
+        # params leaves arrive [1, ...] (this stage's slice); drop the axis.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage_id = lax.axis_index(axis)
+        mb = x.reshape(n_microbatches, x.shape[0] // n_microbatches, *x.shape[1:])
+        # activations become device-varying over 'pipe' after the first stage_fn;
+        # cast the loop carry up front so the fori_loop carry type is stable
+        state = lax.pcast(jnp.zeros_like(mb[0]), axis, to="varying")
+        out_buf = lax.pcast(jnp.zeros_like(mb), axis, to="varying")
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        total = n_microbatches + n_stages - 1
+
+        def tick(t, carry):
+            state, out_buf = carry
+            # stage 0 injects microbatch t (garbage-in after the last one;
+            # results of those ticks are never collected)
+            inj = mb[jnp.minimum(t, n_microbatches - 1)]
+            inp = jnp.where(stage_id == 0, inj, state)
+            out = stage_fn(params, inp)
+            # last stage owns microbatch t-(n_stages-1) at tick t
+            done_idx = t - (n_stages - 1)
+            is_done = jnp.logical_and(stage_id == n_stages - 1, done_idx >= 0)
+            write_at = jnp.clip(done_idx, 0, n_microbatches - 1)
+            upd = jnp.where(is_done, out, out_buf[write_at])
+            out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, write_at, 0)
+            state = lax.ppermute(out, axis, fwd)
+            return state, out_buf
+
+        state, out_buf = lax.fori_loop(0, total, tick, (state, out_buf))
+        # replicate the collected outputs (held by the last stage) to all stages
+        mask = (stage_id == n_stages - 1).astype(out_buf.dtype)
+        out_buf = lax.psum(out_buf * mask, axis)
+        return out_buf.reshape(x.shape[0], *out_buf.shape[2:])
+
+    pspec = P(axis)   # prefix spec: applies to every leaf of the params pytree
+    xspec = P()
+    return jax.jit(jax.shard_map(local, mesh=mesh, in_specs=(pspec, xspec),
+                                 out_specs=xspec))

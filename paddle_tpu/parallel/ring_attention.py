@@ -1,0 +1,160 @@
+"""Sequence/context parallelism: ring attention over a ``seq`` mesh axis.
+
+The 2017 reference's longest-sequence story is padding-free LoD batching
+(SURVEY.md §5 long-context) — there is no sequence-dim sharding to port. This
+module provides the modern first-class capability the TPU build is required to
+have: sequences sharded over a mesh axis, attention computed exactly via a ring
+of ``ppermute`` steps with online-softmax (flash-style) accumulation, so each
+chip only ever holds 1/N of the KV cache and the KV blocks ride the ICI ring.
+
+Layout: q/k/v are [batch, time_local, heads, head_dim] inside ``shard_map`` over
+the ``seq`` axis; time_local = T_global / n_shards.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _online_update(o, l, m, scores, v):
+    """One flash-attention accumulation step.
+
+    o [B,T,H,D] running numerator; l [B,H,T] running denominator; m [B,H,T]
+    running max; scores [B,H,T,S]; v [B,S,H,D].
+    """
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    p = jnp.exp(scores - m_new[..., None])             # [B,H,T,S]
+    corr = jnp.exp(m - m_new)                          # [B,H,T]
+    l = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhts,bshd->bthd", p, v)
+    o = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o, l, m_new
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        block_size: int = 512, causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Single-device memory-efficient attention: scan over KV blocks.
+
+    Never materialises the [T, S] score matrix beyond one [T, block] tile —
+    the host-memory analog of what the Pallas flash kernel does in VMEM.
+    q,k,v: [B, T, H, D] -> [B, T, H, D].
+    """
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    scale = scale if scale is not None else D ** -0.5
+    nblk = -(-S // block_size)
+    pad = nblk * block_size - S
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(B, nblk, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, nblk, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(T)
+
+    def body(carry, blk):
+        o, l, m, i = carry
+        kblk, vblk = blk
+        scores = jnp.einsum("bthd,bshd->bhts", q, kblk) * scale
+        k_pos = i * block_size + jnp.arange(block_size)
+        valid = k_pos < S
+        mask = valid[None, :]
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        scores = jnp.where(mask[None, None], scores, _NEG)
+        o, l, m = _online_update(o, l, m, scores, vblk)
+        return (o, l, m, i + 1), None
+
+    # derive accumulator initials from q so they carry q's device-varying type
+    # (required for the scan carry when running inside shard_map)
+    o0 = (q * 0).astype(jnp.float32)
+    l0 = (q[..., 0] * 0).astype(jnp.float32).transpose(0, 2, 1)
+    m0 = l0 + _NEG
+    (o, l, m, _), _ = lax.scan(body, (o0, l0, m0, 0), (kb, vb))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+                   causal: bool = False, scale: Optional[float] = None) -> jax.Array:
+    """Exact attention with KV rotating around the ``axis_name`` ring.
+
+    Call inside shard_map with q/k/v time-sharded: [B, T_local, H, D]. Each of
+    the n ring steps computes attention of the local Q block against the
+    currently-held KV block, then passes KV to the neighbour (ppermute over
+    ICI). Online softmax keeps the result exact.
+    """
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = jnp.arange(T)
+    q_pos = my * T + t_local
+
+    # derive accumulator initials from q so the fori_loop carry keeps q's
+    # device-varying type under shard_map's varying-axes check
+    o = (q * 0).astype(jnp.float32)
+    l = (q[..., 0] * 0).astype(jnp.float32).transpose(0, 2, 1)
+    m = l + _NEG
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        o, l, m, k, v = carry
+        src = (my - i) % n                       # whose KV block we hold now
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+        if causal:
+            k_pos = src * T + t_local
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG)
+        o, l, m = _online_update(o, l, m, scores, v)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return o, l, m, k, v
+
+    o, l, m, k, v = lax.fori_loop(0, n, body, (o, l, m, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
+                        causal: bool = False):
+    """Host-level wrapper: shard_map ring_attention over the mesh's seq axis.
+
+    q/k/v: [B, T_global, H, D] (replicated or already seq-sharded on dim 1).
+    """
+    spec = P(None, seq_axis, None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def ulysses_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
+                      causal: bool = False):
+    """DeepSpeed-Ulysses-style sequence parallelism: all_to_all re-shards
+    time-sharded q/k/v to head-sharded, runs full attention locally over the
+    whole sequence, then all_to_alls back. Complements ring attention when
+    heads >= shards: two a2a's instead of n ppermute steps.
+    """
+    spec = P(None, seq_axis, None, None)
+
+    def local(q, k, v):
+        # [B, T/n, H, D] -> a2a -> [B, T, H/n, D]
+        q = lax.all_to_all(q, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+        k = lax.all_to_all(k, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+        v = lax.all_to_all(v, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+        o = blockwise_attention(q, k, v, block_size=max(q.shape[1] // 4, 128),
+                                causal=causal)
+        return lax.all_to_all(o, seq_axis, split_axis=1, concat_axis=2, tiled=True)
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return fn(q, k, v)

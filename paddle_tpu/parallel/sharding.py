@@ -1,0 +1,118 @@
+"""Sharding rules: map parameter paths / batch tensors to NamedShardings.
+
+The reference decides placement imperatively (per-layer ``device`` field,
+proto/ModelConfig.proto:362, executed by ParallelNeuralNetwork.h:23-34; parameter
+blocks hashed to pservers, ParameterClient2.cpp). TPU-native: placement is a pure
+function from a parameter's *path* to a PartitionSpec; XLA's SPMD partitioner does
+the rest.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+def shard(mesh: Mesh, *axes: Optional[str]) -> NamedSharding:
+    """NamedSharding with one mesh axis (or None) per tensor dim."""
+    return NamedSharding(mesh, P(*axes))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Put a host batch onto the mesh, sharding dim 0 of every leaf over ``axis``.
+
+    The analog of MultiGradientMachine's batch split across TrainerThreads
+    (MultiGradientMachine.h:44-60), but done by sharding, not slicing.
+    """
+    if axis not in mesh.shape:
+        sh = replicate(mesh)
+        return jax.device_put(batch, sh)
+
+    def put(x):
+        nd = getattr(x, "ndim", 0)
+        spec = P(axis, *([None] * (nd - 1))) if nd >= 1 else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+class ShardingRules:
+    """Ordered (path-regex -> PartitionSpec) table for parameter pytrees.
+
+    Example (megatron-style 2D for a transformer block)::
+
+        rules = ShardingRules([
+            (r".*/attn/.*proj_qkv/w$", P(None, "model")),   # column parallel
+            (r".*/attn/.*proj_out/w$", P("model", None)),   # row parallel
+            (r".*/embed/table$",       P("model", None)),   # vocab-sharded
+            (r".*",                    P()),                # replicate the rest
+        ])
+        params = rules.apply(mesh, params)
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]]):
+        self.rules: List[Tuple[re.Pattern, P]] = [(re.compile(pat), spec)
+                                                  for pat, spec in rules]
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self.rules:
+            if pat.fullmatch(path) or pat.match(path):
+                return spec
+        return P()
+
+    def apply(self, mesh: Mesh, params):
+        """device_put every leaf per its matched spec."""
+        flat = _flatten_with_paths(params)
+        out = {}
+        for path, leaf in flat:
+            sh = NamedSharding(mesh, self.spec_for(path))
+            out[path] = jax.device_put(leaf, sh)
+        return _unflatten_paths(out)
+
+    def shardings(self, mesh: Mesh, params):
+        """A pytree of NamedShardings matching ``params`` (for jit in_shardings)."""
+        flat = _flatten_with_paths(params)
+        out = {p: NamedSharding(mesh, self.spec_for(p)) for p, _ in flat}
+        return _unflatten_paths(out)
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Place a params pytree on the mesh (replicated unless rules say otherwise)."""
+    if rules is None:
+        return jax.device_put(params, replicate(mesh))
+    return rules.apply(mesh, params)
+
+
+def with_sharding_constraint(x, mesh: Mesh, *axes: Optional[str]):
+    """In-jit resharding hint (the layer-boundary layout conversion point — the
+    analog of MKLDNN's convertWeightsFromPaddle boundary, SURVEY §8.3)."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*axes)))
+
+
+# -- path-dict helpers ----------------------------------------------------------
+
+def _flatten_with_paths(tree, prefix: str = "") -> List[Tuple[str, Any]]:
+    out = []
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.extend(_flatten_with_paths(v, f"{prefix}/{k}" if prefix else str(k)))
+    else:
+        out.append((prefix, tree))
+    return out
+
+
+def _unflatten_paths(flat: Dict[str, Any]):
+    root: Dict[str, Any] = {}
+    for path, leaf in flat.items():
+        keys = path.split("/")
+        node = root
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = leaf
+    return root

@@ -1,0 +1,150 @@
+"""Tensor (model) parallel layers — the ParallelNeuralNetwork replacement.
+
+The reference's model parallelism puts whole layers on different devices
+(--parallel_nn, per-layer ``device``, ParallelNeuralNetwork.h:23-34). TPU-native
+model parallelism shards *within* the layer over the ``model`` mesh axis so the
+matmul itself runs on all chips (megatron-style), which is what the MXU + ICI
+topology wants:
+
+* ColumnParallelLinear: W [in, out] sharded on out — output activations carry the
+  ``model`` shard; no communication on forward.
+* RowParallelLinear:    W [in, out] sharded on in — partial products all-reduced
+  (psum over ICI) to finish the contraction.
+* ShardedEmbedding:     vocab-sharded table; each chip looks up its vocab slice and
+  the results are summed (the sparse 'which pserver owns this row' hash of
+  SparseParameterDistribution.cpp becomes a static shard + masked gather).
+
+These are Modules (nn/module.py) whose __call__ takes the mesh implicitly from the
+enclosing pjit: they express layout via with_sharding_constraint, and the
+column->row pair composes into an MLP with exactly one psum, matching the classic
+2-collective-per-block transformer recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn.initializer import Initializer, gen1_default
+from ..nn.module import Module
+
+
+def _constrain(x, spec: Optional[P]):
+    """Apply a sharding constraint if running under a mesh context."""
+    if spec is None:
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        # no mesh context (single-device tests) — constraint is advisory only
+        return x
+
+
+class ColumnParallelLinear(Module):
+    """y = act(x @ W + b); W sharded [None, 'model'] -> y sharded on features."""
+
+    def __init__(self, in_dim: int, out_dim: int, act=None,
+                 init: Optional[Initializer] = None, bias: bool = True,
+                 axis: str = "model"):
+        super().__init__()
+        self.axis = axis
+        self.act = act
+        self.w = self.param("w", (in_dim, out_dim), init or gen1_default())
+        self.has_bias = bias
+        if bias:
+            self.b = self.param("b", (out_dim,))
+
+    def partition_specs(self):
+        specs = {"w": P(None, self.axis)}
+        if self.has_bias:
+            specs["b"] = P(self.axis)
+        return specs
+
+    def __call__(self, params, x, **kw):
+        w = _constrain(params["w"], P(None, self.axis))
+        y = x @ w
+        if self.has_bias:
+            y = y + params["b"]
+        y = _constrain(y, P(None, self.axis))
+        if self.act is not None:
+            y = self.act(y)
+        return y
+
+
+class RowParallelLinear(Module):
+    """y = x @ W + b; W sharded ['model', None]; XLA inserts the psum."""
+
+    def __init__(self, in_dim: int, out_dim: int, act=None,
+                 init: Optional[Initializer] = None, bias: bool = True,
+                 axis: str = "model"):
+        super().__init__()
+        self.axis = axis
+        self.act = act
+        self.w = self.param("w", (in_dim, out_dim), init or gen1_default())
+        self.has_bias = bias
+        if bias:
+            self.b = self.param("b", (out_dim,))
+
+    def partition_specs(self):
+        specs = {"w": P(self.axis, None)}
+        if self.has_bias:
+            specs["b"] = P()
+        return specs
+
+    def __call__(self, params, x, **kw):
+        # incoming x is feature-sharded (from a column-parallel predecessor)
+        x = _constrain(x, P(None, self.axis))
+        w = _constrain(params["w"], P(self.axis, None))
+        y = x @ w                      # partial sums; SPMD partitioner psums over ICI
+        y = _constrain(y, P())         # replicated output
+        if self.has_bias:
+            y = y + params["b"]
+        if self.act is not None:
+            y = self.act(y)
+        return y
+
+
+class ShardedEmbedding(Module):
+    """Embedding with the table sharded over 'model' on the vocab dim.
+
+    The capability analog of the sparse-row pserver tables
+    (math/SparseRowMatrix.h + getParameterSparse, ParameterServer2.h:510): a table
+    too big for one chip's HBM lives sharded; lookups become a masked local gather
+    + psum. Falls back to a plain gather when unsharded.
+    """
+
+    def __init__(self, vocab_size: int, dim: int,
+                 init: Optional[Initializer] = None, axis: str = "model"):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.axis = axis
+        self.table = self.param("table", (vocab_size, dim), init or gen1_default())
+
+    def partition_specs(self):
+        return {"table": P(self.axis, None)}
+
+    def __call__(self, params, ids, **kw):
+        table = _constrain(params["table"], P(self.axis, None))
+        return jnp.take(table, ids, axis=0)
+
+
+def collect_tp_rules(module: Module, prefix: str = ""):
+    """Walk a module tree collecting (path-regex, spec) rules from any layer that
+    defines partition_specs() — feed to ShardingRules for placement."""
+    rules = []
+    module._assign_paths(prefix)
+
+    def walk(m: Module, path: str):
+        if hasattr(m, "partition_specs"):
+            for name, spec in m.partition_specs().items():
+                pat = f"{path}/{name}" if path else name
+                rules.append((pat + "$", spec))
+        for cname, child in m.sublayers().items():
+            walk(child, f"{path}/{cname}" if path else cname)
+
+    walk(module, prefix)
+    return rules
