@@ -1,0 +1,125 @@
+// Host buddy allocator — re-provision of paddle/memory's BuddyAllocator
+// (reference: memory/detail/buddy_allocator.cc over system_allocator.cc,
+// wired by memory/memory.cc:30-66). On TPU the device HBM is managed by
+// PJRT/XLA; this arena manages *host* staging memory for the feeder path
+// (pinned-buffer analog) so batch assembly doesn't churn malloc.
+//
+// Classic power-of-two buddy over one contiguous arena; offsets returned, the
+// Python side views them into a shared bytearray/mmap.
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <set>
+#include <vector>
+
+namespace {
+
+struct Buddy {
+  std::mutex mu;
+  uint64_t total = 0;
+  uint64_t min_block = 0;
+  int levels = 0;  // level 0 = whole arena; level L blocks of total>>L
+  // free lists per level: set of offsets
+  std::vector<std::set<uint64_t>> free_lists;
+  // allocated offset -> level
+  std::map<uint64_t, int> allocated;
+  uint64_t in_use = 0;
+};
+
+int level_for(Buddy* b, uint64_t size) {
+  uint64_t block = b->total;
+  int lvl = 0;
+  while (lvl < b->levels - 1 && block / 2 >= size && block / 2 >= b->min_block) {
+    block /= 2;
+    lvl++;
+  }
+  return lvl;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pta_create(uint64_t total, uint64_t min_block) {
+  if (total == 0 || (total & (total - 1)) != 0) return nullptr;   // pow2 only
+  if (min_block == 0 || (min_block & (min_block - 1)) != 0) return nullptr;
+  auto* b = new Buddy();
+  b->total = total;
+  b->min_block = min_block;
+  b->levels = 1;
+  uint64_t s = total;
+  while (s > min_block) {
+    s /= 2;
+    b->levels++;
+  }
+  b->free_lists.resize(b->levels);
+  b->free_lists[0].insert(0);
+  return b;
+}
+
+void pta_destroy(void* h) { delete static_cast<Buddy*>(h); }
+
+// Returns offset, or UINT64_MAX on OOM.
+uint64_t pta_alloc(void* h, uint64_t size) {
+  auto* b = static_cast<Buddy*>(h);
+  std::lock_guard<std::mutex> g(b->mu);
+  if (size == 0 || size > b->total) return UINT64_MAX;
+  int want = level_for(b, size);
+  int lvl = want;
+  while (lvl >= 0 && b->free_lists[lvl].empty()) lvl--;
+  if (lvl < 0) return UINT64_MAX;
+  // split down to the wanted level
+  while (lvl < want) {
+    uint64_t off = *b->free_lists[lvl].begin();
+    b->free_lists[lvl].erase(b->free_lists[lvl].begin());
+    uint64_t half = b->total >> (lvl + 1);
+    b->free_lists[lvl + 1].insert(off);
+    b->free_lists[lvl + 1].insert(off + half);
+    lvl++;
+  }
+  uint64_t off = *b->free_lists[want].begin();
+  b->free_lists[want].erase(b->free_lists[want].begin());
+  b->allocated[off] = want;
+  b->in_use += b->total >> want;
+  return off;
+}
+
+// Free + coalesce with buddy (buddy_allocator.cc merge path).
+int pta_free(void* h, uint64_t off) {
+  auto* b = static_cast<Buddy*>(h);
+  std::lock_guard<std::mutex> g(b->mu);
+  auto it = b->allocated.find(off);
+  if (it == b->allocated.end()) return -1;
+  int lvl = it->second;
+  b->allocated.erase(it);
+  b->in_use -= b->total >> lvl;
+  while (lvl > 0) {
+    uint64_t block = b->total >> lvl;
+    uint64_t buddy = off ^ block;
+    auto& fl = b->free_lists[lvl];
+    auto bit = fl.find(buddy);
+    if (bit == fl.end()) break;
+    fl.erase(bit);
+    off = off < buddy ? off : buddy;
+    lvl--;
+  }
+  b->free_lists[lvl].insert(off);
+  return 0;
+}
+
+void pta_stats(void* h, uint64_t* total, uint64_t* in_use, uint64_t* largest_free) {
+  auto* b = static_cast<Buddy*>(h);
+  std::lock_guard<std::mutex> g(b->mu);
+  *total = b->total;
+  *in_use = b->in_use;
+  *largest_free = 0;
+  for (int lvl = 0; lvl < b->levels; lvl++) {
+    if (!b->free_lists[lvl].empty()) {
+      *largest_free = b->total >> lvl;
+      break;
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,203 @@
+// Standalone optimizer library with a C ABI and serializable state — the
+// re-provision of paddle/optimizer (reference: optimizer.h C API
+// paddle_create_optimizer/paddle_update_parameter, sgd_optimizer.cc,
+// adagrad/adadelta/adam_optimizer.cc, lr_policy.h const/linear,
+// serialization.h), which the Go pserver called through cgo
+// (go/pserver/optimizer.go). Here it backs host-side embedding/optimizer
+// offload paths (huge sparse tables kept out of HBM) and gives checkpointable
+// optimizer state independent of the device runtime.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum OptType { SGD = 0, MOMENTUM = 1, ADAGRAD = 2, ADADELTA = 3, ADAM = 4 };
+enum LrPolicy { LR_CONST = 0, LR_LINEAR = 1 };
+
+struct Opt {
+  int type = SGD;
+  int lr_policy = LR_CONST;
+  double lr = 0.01;
+  double lr_decay_a = 0, lr_decay_b = 0;  // linear: max(lr - a*step, b)
+  double mu = 0.9, rho = 0.95, eps = 1e-6;
+  double beta1 = 0.9, beta2 = 0.999;
+  int64_t num_steps = 0;
+  size_t n = 0;
+  std::vector<float> param;
+  std::vector<float> s1;  // velocity / accum / m / accum_g
+  std::vector<float> s2;  // v / accum_d
+};
+
+double cur_lr(Opt* o) {
+  if (o->lr_policy == LR_LINEAR)
+    return std::fmax(o->lr - o->lr_decay_a * (double)o->num_steps, o->lr_decay_b);
+  return o->lr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// type: 0 sgd, 1 momentum, 2 adagrad, 3 adadelta, 4 adam.
+// lr_policy: 0 const, 1 linear(lr - a*step, floor b).
+void* pto_create(int type, const float* param_init, uint64_t n, double lr,
+                 int lr_policy, double decay_a, double decay_b, double mu,
+                 double rho, double eps, double beta1, double beta2) {
+  auto* o = new Opt();
+  o->type = type;
+  o->lr = lr;
+  o->lr_policy = lr_policy;
+  o->lr_decay_a = decay_a;
+  o->lr_decay_b = decay_b;
+  o->mu = mu;
+  o->rho = rho;
+  o->eps = eps;
+  o->beta1 = beta1;
+  o->beta2 = beta2;
+  o->n = n;
+  o->param.assign(param_init, param_init + n);
+  if (type != SGD) o->s1.assign(n, 0.f);
+  if (type == ADADELTA || type == ADAM) o->s2.assign(n, 0.f);
+  return o;
+}
+
+void pto_destroy(void* h) { delete static_cast<Opt*>(h); }
+
+// One SGD step with gradient `grad` (paddle_update_parameter analog).
+int pto_update(void* h, const float* grad, uint64_t n) {
+  auto* o = static_cast<Opt*>(h);
+  if (n != o->n) return -1;
+  o->num_steps++;
+  const double lr = cur_lr(o);
+  float* p = o->param.data();
+  switch (o->type) {
+    case SGD:
+      for (size_t i = 0; i < n; i++) p[i] -= (float)(lr * grad[i]);
+      break;
+    case MOMENTUM: {
+      float* v = o->s1.data();
+      for (size_t i = 0; i < n; i++) {
+        v[i] = (float)(o->mu * v[i] + grad[i]);
+        p[i] -= (float)(lr * v[i]);
+      }
+      break;
+    }
+    case ADAGRAD: {
+      float* a = o->s1.data();
+      for (size_t i = 0; i < n; i++) {
+        a[i] += grad[i] * grad[i];
+        p[i] -= (float)(lr * grad[i] / (std::sqrt((double)a[i]) + o->eps));
+      }
+      break;
+    }
+    case ADADELTA: {
+      float* ag = o->s1.data();
+      float* ad = o->s2.data();
+      for (size_t i = 0; i < n; i++) {
+        ag[i] = (float)(o->rho * ag[i] + (1 - o->rho) * grad[i] * grad[i]);
+        double dx = std::sqrt(((double)ad[i] + o->eps) / ((double)ag[i] + o->eps)) * grad[i];
+        ad[i] = (float)(o->rho * ad[i] + (1 - o->rho) * dx * dx);
+        p[i] -= (float)(lr * dx);
+      }
+      break;
+    }
+    case ADAM: {
+      float* m = o->s1.data();
+      float* v = o->s2.data();
+      double b1p = 1 - std::pow(o->beta1, (double)o->num_steps);
+      double b2p = 1 - std::pow(o->beta2, (double)o->num_steps);
+      for (size_t i = 0; i < n; i++) {
+        m[i] = (float)(o->beta1 * m[i] + (1 - o->beta1) * grad[i]);
+        v[i] = (float)(o->beta2 * v[i] + (1 - o->beta2) * grad[i] * grad[i]);
+        double mh = m[i] / b1p, vh = v[i] / b2p;
+        p[i] -= (float)(lr * mh / (std::sqrt(vh) + o->eps));
+      }
+      break;
+    }
+    default:
+      return -2;
+  }
+  return 0;
+}
+
+// Sparse row update: rows[i] indexes a [num_rows, width] view of param.
+int pto_update_rows(void* h, const int* rows, const float* grad,
+                    uint64_t n_rows, uint64_t width) {
+  auto* o = static_cast<Opt*>(h);
+  if (o->type != SGD && o->type != ADAGRAD) return -2;  // row-local types only
+  o->num_steps++;
+  const double lr = cur_lr(o);
+  float* p = o->param.data();
+  for (size_t r = 0; r < n_rows; r++) {
+    size_t base = (size_t)rows[r] * width;
+    if (base + width > o->n) return -1;
+    const float* g = grad + r * width;
+    if (o->type == SGD) {
+      for (size_t i = 0; i < width; i++) p[base + i] -= (float)(lr * g[i]);
+    } else {
+      float* a = o->s1.data();
+      for (size_t i = 0; i < width; i++) {
+        a[base + i] += g[i] * g[i];
+        p[base + i] -= (float)(lr * g[i] / (std::sqrt((double)a[base + i]) + o->eps));
+      }
+    }
+  }
+  return 0;
+}
+
+const float* pto_get_param(void* h, uint64_t* n) {
+  auto* o = static_cast<Opt*>(h);
+  *n = o->n;
+  return o->param.data();
+}
+
+// State serialization (serialization.h / OptimizerConfig.proto analog):
+// [type i32][num_steps i64][n u64][param f32*n][len1 u64][s1][len2 u64][s2]
+uint64_t pto_state_size(void* h) {
+  auto* o = static_cast<Opt*>(h);
+  return 4 + 8 + 8 + 4 * o->n + 8 + 4 * o->s1.size() + 8 + 4 * o->s2.size();
+}
+
+int pto_serialize(void* h, char* buf, uint64_t buflen) {
+  auto* o = static_cast<Opt*>(h);
+  if (buflen < pto_state_size(h)) return -1;
+  char* q = buf;
+  auto put = [&](const void* src, size_t len) { memcpy(q, src, len); q += len; };
+  int32_t ty = o->type;
+  uint64_t n = o->n, l1 = o->s1.size(), l2 = o->s2.size();
+  put(&ty, 4);
+  put(&o->num_steps, 8);
+  put(&n, 8);
+  put(o->param.data(), 4 * n);
+  put(&l1, 8);
+  put(o->s1.data(), 4 * l1);
+  put(&l2, 8);
+  put(o->s2.data(), 4 * l2);
+  return 0;
+}
+
+int pto_deserialize(void* h, const char* buf, uint64_t buflen) {
+  auto* o = static_cast<Opt*>(h);
+  const char* q = buf;
+  const char* end = buf + buflen;
+  auto get = [&](void* dst, size_t len) -> bool {
+    if (q + len > end) return false;
+    memcpy(dst, q, len);
+    q += len;
+    return true;
+  };
+  int32_t ty;
+  uint64_t n, l1, l2;
+  if (!get(&ty, 4) || !get(&o->num_steps, 8) || !get(&n, 8)) return -1;
+  if (ty != o->type || n != o->n) return -2;
+  if (!get(o->param.data(), 4 * n)) return -1;
+  if (!get(&l1, 8) || l1 != o->s1.size() || !get(o->s1.data(), 4 * l1)) return -1;
+  if (!get(&l2, 8) || l2 != o->s2.size() || !get(o->s2.data(), 4 * l2)) return -1;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,113 @@
+// Chunked record file format — re-provision of the recordio chunks the Go
+// master shards (reference: go/master/service.go partitions RecordIO chunks;
+// proto DataFormat stream, SURVEY.md §8.2) and the binary data path of
+// ProtoDataProvider. Format:
+//   file  := magic(u32) { record }*
+//   record:= len(u32) crc32(u32) payload[len]
+// CRC verified on read (the Go pserver checkpoint discipline,
+// go/pserver/service.go:119-126, applied to data files).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50545231;  // "PTR1"
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  int64_t count = 0;
+};
+
+struct Reader {
+  FILE* f = nullptr;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptr_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  uint32_t m = kMagic;
+  fwrite(&m, 4, 1, f);
+  auto* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int ptr_writer_write(void* h, const void* data, int len) {
+  auto* w = static_cast<Writer*>(h);
+  uint32_t l = (uint32_t)len;
+  uint32_t c = crc32(static_cast<const uint8_t*>(data), len);
+  if (fwrite(&l, 4, 1, w->f) != 1) return -1;
+  if (fwrite(&c, 4, 1, w->f) != 1) return -1;
+  if (len > 0 && fwrite(data, 1, len, w->f) != (size_t)len) return -1;
+  w->count++;
+  return 0;
+}
+
+int64_t ptr_writer_close(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  int64_t n = w->count;
+  fclose(w->f);
+  delete w;
+  return n;
+}
+
+void* ptr_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  uint32_t m = 0;
+  if (fread(&m, 4, 1, f) != 1 || m != kMagic) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// Returns payload length (>=0), -1 on clean EOF, -2 on corruption (bad CRC or
+// truncated record). buf==nullptr => peek length only (seek back).
+int ptr_reader_next(void* h, void* buf, int buflen) {
+  auto* r = static_cast<Reader*>(h);
+  long pos = ftell(r->f);
+  uint32_t len = 0, crc = 0;
+  if (fread(&len, 4, 1, r->f) != 1) return -1;
+  if (fread(&crc, 4, 1, r->f) != 1) return -2;
+  if (buf == nullptr || (int)len > buflen) {
+    fseek(r->f, pos, SEEK_SET);
+    return (int)len;
+  }
+  if (len > 0 && fread(buf, 1, len, r->f) != len) return -2;
+  if (crc32(static_cast<uint8_t*>(buf), len) != crc) return -2;
+  return (int)len;
+}
+
+void ptr_reader_close(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

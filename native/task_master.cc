@@ -1,0 +1,228 @@
+// Task-queue data master — C++ re-provision of the Go master's semantics
+// (reference: go/master/service.go — three-queue todo/pending/done dispatch
+// :63-89, timeout requeue :198-200, failureMax discard :311-321, state
+// snapshot/recovery :166-227). Drives fault-tolerant data sharding for
+// multi-host TPU training: trainers are stateless task consumers; a dead
+// trainer's pending task times out and is re-dispatched.
+//
+// C ABI for ctypes (paddle_tpu/runtime/master.py).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Task {
+  int id = 0;
+  std::string payload;   // typically a chunk path [+ byte range]
+  int failures = 0;
+  double deadline = 0;   // valid while pending
+};
+
+struct Master {
+  std::mutex mu;
+  std::deque<Task> todo;
+  std::map<int, Task> pending;  // id -> task
+  std::vector<Task> done;
+  std::vector<Task> discarded;
+  double timeout_s = 60.0;
+  int failure_max = 3;
+  int next_id = 0;
+  int epoch = 0;  // bumped when todo refills from done (pass boundary)
+};
+
+double now_unused() { return 0; }
+
+}  // namespace
+
+extern "C" {
+
+void* ptm_create(double timeout_s, int failure_max) {
+  auto* m = new Master();
+  m->timeout_s = timeout_s;
+  m->failure_max = failure_max;
+  return m;
+}
+
+void ptm_destroy(void* h) { delete static_cast<Master*>(h); }
+
+// SetDataset (service.go:280): one task per chunk payload.
+void ptm_set_dataset(void* h, const char** payloads, int n) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->todo.clear();
+  m->pending.clear();
+  m->done.clear();
+  m->discarded.clear();
+  for (int i = 0; i < n; i++) {
+    Task t;
+    t.id = m->next_id++;
+    t.payload = payloads[i];
+    m->todo.push_back(t);
+  }
+}
+
+// GetTask (service.go:366 GetTask): todo -> pending with deadline.
+// Returns task id >= 0, -1 if nothing available, -2 if pass finished
+// (todo+pending empty). `now` is caller-supplied monotonic seconds.
+int ptm_get_task(void* h, double now, char* buf, int buflen) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  if (m->todo.empty()) return m->pending.empty() ? -2 : -1;
+  Task t = m->todo.front();
+  m->todo.pop_front();
+  t.deadline = now + m->timeout_s;
+  snprintf(buf, buflen, "%s", t.payload.c_str());
+  int id = t.id;
+  m->pending[id] = std::move(t);
+  return id;
+}
+
+// TaskFinished (service.go:450): pending -> done. The pass boundary is
+// surfaced to clients (get_task returns -2, Go's ErrPassAfter analog);
+// ptm_new_pass() then refills todo for the next pass.
+int ptm_task_finished(void* h, int task_id) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto it = m->pending.find(task_id);
+  if (it == m->pending.end()) return -1;
+  it->second.failures = 0;
+  m->done.push_back(it->second);
+  m->pending.erase(it);
+  return 0;
+}
+
+// Start the next pass: refill todo from done (service.go pass cycling).
+int ptm_new_pass(void* h) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  if (!m->todo.empty() || !m->pending.empty()) return -1;  // pass not finished
+  for (auto& t : m->done) m->todo.push_back(t);
+  m->done.clear();
+  m->epoch++;
+  return 0;
+}
+
+// TaskFailed (service.go:475) + failureMax discard (:311-321).
+int ptm_task_failed(void* h, int task_id) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto it = m->pending.find(task_id);
+  if (it == m->pending.end()) return -1;
+  Task t = it->second;
+  m->pending.erase(it);
+  t.failures++;
+  if (t.failures >= m->failure_max) {
+    m->discarded.push_back(t);
+    return 1;  // discarded
+  }
+  m->todo.push_back(t);
+  return 0;
+}
+
+// Timeout check (service.go:198-200 checkTimeoutFunc): requeue overdue
+// pending tasks (counts as a failure). Returns number requeued/discarded.
+int ptm_tick(void* h, double now) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  int n = 0;
+  for (auto it = m->pending.begin(); it != m->pending.end();) {
+    if (it->second.deadline <= now) {
+      Task t = it->second;
+      it = m->pending.erase(it);
+      t.failures++;
+      if (t.failures >= m->failure_max)
+        m->discarded.push_back(t);
+      else
+        m->todo.push_back(t);
+      n++;
+    } else {
+      ++it;
+    }
+  }
+  return n;
+}
+
+void ptm_stats(void* h, int* todo, int* pending, int* done, int* discarded,
+               int* epoch) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  *todo = (int)m->todo.size();
+  *pending = (int)m->pending.size();
+  *done = (int)m->done.size();
+  *discarded = (int)m->discarded.size();
+  *epoch = m->epoch;
+}
+
+// Snapshot/restore (service.go:166-227: etcd snapshot -> here a local file;
+// the multi-host deployment points it at shared storage).
+// Format v2: header line, then per task a "tag id failures len\n" line
+// followed by exactly len raw payload bytes + '\n' — length-prefixed so empty
+// payloads and payloads containing whitespace/newlines survive the roundtrip.
+int ptm_snapshot(void* h, const char* path) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fprintf(f, "ptm_snapshot_v2 %d %d\n", m->next_id, m->epoch);
+  auto dump = [&](const char* tag, const Task& t) {
+    fprintf(f, "%s %d %d %zu\n", tag, t.id, t.failures, t.payload.size());
+    fwrite(t.payload.data(), 1, t.payload.size(), f);
+    fputc('\n', f);
+  };
+  for (auto& t : m->todo) dump("todo", t);
+  // pending tasks snapshot as todo: after recovery they must be re-dispatched
+  for (auto& kv : m->pending) dump("todo", kv.second);
+  for (auto& t : m->done) dump("done", t);
+  for (auto& t : m->discarded) dump("disc", t);
+  fclose(f);
+  return 0;
+}
+
+int ptm_restore(void* h, const char* path) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  FILE* f = fopen(path, "r");
+  if (!f) return -1;
+  char header[64];
+  int next_id = 0, epoch = 0;
+  if (fscanf(f, "%63s %d %d", header, &next_id, &epoch) != 3 ||
+      strcmp(header, "ptm_snapshot_v2") != 0 || fgetc(f) != '\n') {
+    fclose(f);
+    return -2;
+  }
+  m->todo.clear();
+  m->pending.clear();
+  m->done.clear();
+  m->discarded.clear();
+  m->next_id = next_id;
+  m->epoch = epoch;
+  char tag[8];
+  int id, failures;
+  size_t len;
+  while (fscanf(f, "%7s %d %d %zu", tag, &id, &failures, &len) == 4) {
+    if (fgetc(f) != '\n') { fclose(f); return -3; }
+    Task t;
+    t.id = id;
+    t.failures = failures;
+    t.payload.resize(len);
+    if (len > 0 && fread(&t.payload[0], 1, len, f) != len) {
+      fclose(f);
+      return -3;
+    }
+    if (fgetc(f) != '\n') { fclose(f); return -3; }
+    if (strcmp(tag, "todo") == 0) m->todo.push_back(t);
+    else if (strcmp(tag, "done") == 0) m->done.push_back(t);
+    else m->discarded.push_back(t);
+  }
+  fclose(f);
+  return 0;
+}
+
+}  // extern "C"

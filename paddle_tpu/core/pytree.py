@@ -1,0 +1,46 @@
+"""Path-keyed pytree codec shared by sharding rules and checkpoints.
+
+One canonical mapping between nested params structures and flat
+``{"a/b/w": leaf}`` dicts (lists/tuples encode as ``@i`` segments), so
+placement rules (parallel/sharding.py) and serialization
+(trainer/checkpoint.py) agree on path names.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Tuple
+
+_IDX = re.compile(r"@\d+")
+
+
+def flatten_path_tree(tree, prefix: str = "") -> List[Tuple[str, Any]]:
+    out: List[Tuple[str, Any]] = []
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.extend(flatten_path_tree(v, f"{prefix}/{k}" if prefix else str(k)))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.extend(flatten_path_tree(v, f"{prefix}/@{i}" if prefix else f"@{i}"))
+    else:
+        out.append((prefix, tree))
+    return out
+
+
+def unflatten_path_tree(flat: Dict[str, Any]):
+    root: Dict[str, Any] = {}
+    for path, leaf in flat.items():
+        keys = path.split("/")
+        node = root
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = leaf
+
+    def fix(node):
+        if isinstance(node, dict):
+            if node and all(_IDX.fullmatch(k) for k in node):
+                return [fix(node[f"@{i}"]) for i in range(len(node))]
+            return {k: fix(v) for k, v in node.items()}
+        return node
+
+    return fix(root)

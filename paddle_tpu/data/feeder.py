@@ -1,0 +1,134 @@
+"""DataFeeder: row-tuples -> device-ready arrays under the slot-type taxonomy.
+
+The reference's canonical feature types (SURVEY.md §8.2: proto/DataFormat.proto
+SlotType; PyDataProvider2.py input_types; LayerGradUtil.h:23-34):
+dense / index / sparse-binary / sparse-value, each optionally (nested) sequence.
+The converter to engine buffers is DataProviderConverter
+(py_paddle/dataprovider_converter.py:247) + DataFeeder (v2/data_feeder.py:112).
+
+TPU-native: the target layout is static-shaped —
+* DenseSlot  -> float [B, dim]
+* IndexSlot  -> int32 [B]
+* SeqSlot    -> SeqBatch (padded [B, T(bucketed), ...] + lengths)  — LoD analog
+* SparseSlot -> padded COO per row: (ids [B, K], vals [B, K], mask) with K the
+  bucketed max-nnz; embedding-sum consumes it directly (SelectedRows analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import SeqBatch, bucket_length, pack_sequences
+
+
+@dataclass
+class DenseSlot:
+    dim: int
+    dtype: Any = np.float32
+
+
+@dataclass
+class IndexSlot:
+    dtype: Any = np.int32
+
+
+@dataclass
+class SeqSlot:
+    """A variable-length sequence of scalars (ids) or vectors.
+
+    elem_dim None -> id sequence (int32); else vector sequence [len, elem_dim].
+    nested=True accepts list-of-list-of-elem (sub-sequences are flattened and
+    the inner offsets kept in SeqBatch.lod, LoDTensor level-2 analog).
+    """
+    elem_dim: Optional[int] = None
+    nested: bool = False
+    dtype: Any = None
+
+    @property
+    def np_dtype(self):
+        if self.dtype is not None:
+            return self.dtype
+        return np.int32 if self.elem_dim is None else np.float32
+
+
+@dataclass
+class SparseSlot:
+    """Sparse row features: sample = list of ids or list of (id, value)."""
+    dim: int
+    with_values: bool = False
+
+
+class DataFeeder:
+    """feed(rows) -> tuple of arrays, one per slot.
+
+    rows: list of sample tuples, sample[i] belongs to slots[i].
+    """
+
+    def __init__(self, slots: Sequence[Any]):
+        self.slots = list(slots)
+
+    def __call__(self, rows: Sequence[Tuple]) -> Tuple:
+        return self.feed(rows)
+
+    def feed(self, rows: Sequence[Tuple]) -> Tuple:
+        cols = list(zip(*rows))
+        if len(cols) != len(self.slots):
+            raise ValueError(f"sample width {len(cols)} != #slots {len(self.slots)}")
+        return tuple(self._convert(slot, col) for slot, col in zip(self.slots, cols))
+
+    # ------------------------------------------------------------------
+    def _convert(self, slot, col):
+        if isinstance(slot, DenseSlot):
+            arr = np.asarray(col, dtype=slot.dtype).reshape(len(col), slot.dim)
+            return jnp.asarray(arr)
+        if isinstance(slot, IndexSlot):
+            return jnp.asarray(np.asarray(col, dtype=slot.dtype).reshape(len(col)))
+        if isinstance(slot, SeqSlot):
+            return self._convert_seq(slot, col)
+        if isinstance(slot, SparseSlot):
+            return self._convert_sparse(slot, col)
+        raise TypeError(f"unknown slot {slot!r}")
+
+    def _convert_seq(self, slot: SeqSlot, col) -> SeqBatch:
+        if slot.nested:
+            # flatten sub-sequences; record inner offsets as LoD level
+            flat, lod = [], []
+            for sample in col:
+                offs = [0]
+                items: List = []
+                for sub in sample:
+                    items.extend(sub)
+                    offs.append(len(items))
+                flat.append(np.asarray(items, dtype=slot.np_dtype))
+                lod.append(tuple(offs))
+            sb = pack_sequences(flat)
+            return SeqBatch(sb.data, sb.lengths, tuple(lod))
+        seqs = [np.asarray(s, dtype=slot.np_dtype) for s in col]
+        return pack_sequences(seqs)
+
+    def _convert_sparse(self, slot: SparseSlot, col):
+        if slot.with_values:
+            ids_list = [[int(i) for i, _ in s] for s in col]
+            val_list = [[float(v) for _, v in s] for s in col]
+        else:
+            ids_list = [[int(i) for i in s] for s in col]
+            val_list = [[1.0] * len(s) for s in col]
+        k = bucket_length(max(1, max((len(s) for s in ids_list), default=1)),
+                          buckets=(4, 8, 16, 32, 64, 128, 256))
+        B = len(col)
+        ids = np.zeros((B, k), np.int32)
+        vals = np.zeros((B, k), np.float32)
+        for r, (ii, vv) in enumerate(zip(ids_list, val_list)):
+            n = min(len(ii), k)
+            ids[r, :n] = ii[:n]
+            vals[r, :n] = vv[:n]
+        return jnp.asarray(ids), jnp.asarray(vals)
+
+
+def to_lod_batch(seqs, max_len: Optional[int] = None) -> SeqBatch:
+    """Convenience: list of sequences -> SeqBatch (bucketed padding)."""
+    return pack_sequences([np.asarray(s) for s in seqs], max_len=max_len)

@@ -1,0 +1,37 @@
+"""Double-buffered host->device prefetch.
+
+The reference overlaps batch production with training via a dedicated thread and
+a two-slot queue (``DoubleBuffer``, gserver/dataproviders/DataProvider.h:249,
+enabled per-provider). TPU-native: the same thread structure, but the payload is
+already-converted jax arrays, so a device transfer can be in flight while the
+previous step computes (jax dispatch is async; this hides the *host* conversion
+cost too).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+
+class DoubleBuffer:
+    """Wrap a batch iterable; a worker thread keeps ``depth`` batches ready.
+
+    Usage::
+        for batch in DoubleBuffer(lambda: feeder_batches(), depth=2):
+            step(*batch)
+    """
+
+    def __init__(self, batches: Callable[[], Iterable[Any]], depth: int = 2,
+                 transform: Optional[Callable[[Any], Any]] = None):
+        self.batches = batches
+        self.depth = depth
+        self.transform = transform
+
+    def __iter__(self) -> Iterator[Any]:
+        from .reader import buffered, map_readers
+        creator = self.batches
+        if self.transform is not None:
+            # transform runs on the worker thread, overlapping host conversion
+            # with device compute
+            creator = map_readers(self.transform, creator)
+        return iter(buffered(creator, self.depth)())

@@ -1,0 +1,22 @@
+"""fluid — the Program-IR front end (gen-2 analog, SURVEY.md §2.2/§2.4).
+
+Build a Program of ops via ``layers``, differentiate with
+``backward.append_backward`` (or optimizer.minimize), and run it with
+``Executor`` — which compiles each block to a single cached XLA computation.
+"""
+
+from . import backward, io, layers, optimizer, registry
+from .backward import append_backward
+from .executor import Executor, Scope, global_scope
+from .framework import (Block, Operator, Program, Variable,
+                        default_main_program, default_startup_program,
+                        program_guard, reset_default_programs)
+from .optimizer import AdamOptimizer, MomentumOptimizer, SGDOptimizer
+from .registry import OpRegistry
+
+__all__ = ["layers", "backward", "io", "optimizer", "registry",
+           "append_backward", "Executor", "Scope", "global_scope",
+           "Program", "Block", "Operator", "Variable",
+           "default_main_program", "default_startup_program", "program_guard",
+           "reset_default_programs",
+           "SGDOptimizer", "MomentumOptimizer", "AdamOptimizer", "OpRegistry"]

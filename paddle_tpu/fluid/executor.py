@@ -1,0 +1,169 @@
+"""Executor: run a Program's blocks as ONE compiled XLA computation.
+
+Reference: framework/executor.cc:87 ``Executor::Run`` creates vars then
+interprets ops sequentially (:120-124). TPU-native redesign (SURVEY.md §7): the
+op list is *traced* through the registry's jax computes into a single function,
+jitted and cached keyed on (program fingerprint, feed shapes/dtypes) — the
+shape-keyed executable cache that makes repeated `run` calls free of Python op
+dispatch. Feed/fetch (feed_op.cc/fetch_op.cc) become function inputs/outputs.
+
+Autodiff: a block may contain one ``autodiff_grad`` op (appended by
+backward.append_backward). During tracing it replays the forward prefix as a
+closure over the parameter leaves and calls jax.grad — XLA CSE merges the
+replayed forward with the primal one, recovering the classic single
+forward+backward graph (replacing backward.cc:414 AppendBackward's explicit
+grad-op emission).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import Block, Program, Variable
+from .registry import OpRegistry
+
+
+class Scope:
+    """Runtime variable store (scope.h analog); persistables live here across
+    run() calls. Child scopes see parent vars."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.vars: Dict[str, Any] = {}
+
+    def set(self, name: str, value):
+        self.vars[name] = value
+
+    def get(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        raise KeyError(name)
+
+    def has(self, name: str) -> bool:
+        try:
+            self.get(name)
+            return True
+        except KeyError:
+            return False
+
+    def new_child(self) -> "Scope":
+        return Scope(self)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _trace_ops(ops, env: Dict[str, Any]):
+    """Symbolically run an op list over env (name -> traced array)."""
+    for op in ops:
+        if op.type == "autodiff_grad":
+            _trace_autodiff(op, ops, env)
+            continue
+        compute = OpRegistry.get(op.type)
+        ins = {k: [env[n] for n in vs] for k, vs in op.inputs.items()}
+        outs = compute(ins, op.attrs)
+        for k, names in op.outputs.items():
+            vals = outs[k]
+            for n, v in zip(names, vals):
+                env[n] = v
+    return env
+
+
+def _trace_autodiff(op, ops, env):
+    loss_name = op.attrs["loss"]
+    param_names = list(op.attrs["params"])
+    n_fwd = op.attrs["num_fwd_ops"]
+    init_env = op.attrs["_init_env"]  # captured block-entry env
+
+    def replay(param_vals):
+        env2 = dict(init_env)
+        for name, val in zip(param_names, param_vals):
+            env2[name] = val
+        _trace_ops(ops[:n_fwd], env2)
+        return env2[loss_name]
+
+    grads = jax.grad(replay)([env[n] for n in param_names])
+    for name, g in zip(param_names, grads):
+        env[name + "@GRAD"] = g
+
+
+class Executor:
+    """exe.run(program, feed=..., fetch_list=...) (fluid/executor.py:7-20)."""
+
+    def __init__(self, place=None, scope: Optional[Scope] = None):
+        self.place = place
+        self.scope = scope if scope is not None else global_scope()
+        self._cache: Dict[Tuple, Any] = {}
+        self._step = 0   # feeds the implicit '__step__' var (stochastic ops)
+
+    # ------------------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            use_cache: bool = True) -> List[np.ndarray]:
+        from .framework import default_main_program
+        program = program or default_main_program()
+        feed = {k: jnp.asarray(v) for k, v in (feed or {}).items()}
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in (fetch_list or [])]
+        block = program.global_block()
+        if "__step__" in block.vars and "__step__" not in feed:
+            feed["__step__"] = jnp.asarray(self._step, jnp.int32)
+            self._step += 1
+
+        # vars the block reads from the scope (persistables created earlier)
+        persist_in = [name for name, v in block.vars.items()
+                      if v.persistable and self.scope.has(name)]
+        # persistable vars written by ops (optimizer updates) to sync back
+        written = [n for op in block.ops for n in op.output_vars()
+                   if n in block.vars and block.vars[n].persistable]
+        written = list(dict.fromkeys(written))
+
+        key = (id(program), len(block.ops), tuple(fetch_names),
+               tuple(persist_in),
+               tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed.items())))
+        fn = self._cache.get(key) if use_cache else None
+        if fn is None:
+            fn = self._build(program, block, list(feed), persist_in,
+                             fetch_names, written)
+            if use_cache:
+                self._cache[key] = fn
+        persist_vals = [self.scope.get(n) for n in persist_in]
+        fetches, new_persist = fn(feed, persist_vals)
+        for n, v in zip(written, new_persist):
+            self.scope.set(n, v)
+        return [np.asarray(v) for v in fetches]
+
+    # ------------------------------------------------------------------
+    def _build(self, program: Program, block: Block, feed_names, persist_in,
+               fetch_names, written):
+        has_host_ops = any(op.type == "fill_init" for op in block.ops)
+
+        def raw(feed: Dict[str, Any], persist_vals: List[Any]):
+            env: Dict[str, Any] = {}
+            env.update(feed)
+            env.update(dict(zip(persist_in, persist_vals)))
+            # stash block-entry env for autodiff replay
+            entry_env = dict(env)
+            for op in block.ops:
+                if op.type == "autodiff_grad":
+                    op.attrs["_init_env"] = entry_env
+            _trace_ops(block.ops, env)
+            fetches = [env[n] for n in fetch_names]
+            new_persist = [env.get(n) for n in written]
+            return fetches, new_persist
+
+        if has_host_ops:
+            return raw  # startup programs run eagerly (host-side initializers)
+        return jax.jit(raw)

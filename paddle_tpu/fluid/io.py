@@ -1,0 +1,36 @@
+"""save/load persistables (fluid/io.py + save_op.cc/load_op.cc analog) —
+reuses the CRC-checked tar format of trainer/checkpoint.py."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..trainer.checkpoint import from_tar, to_tar
+from .executor import Executor, Scope, global_scope
+from .framework import Program, default_main_program
+
+
+def _persistable_names(program: Program):
+    return [name for name, v in program.global_block().vars.items()
+            if v.persistable]
+
+
+def save_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None):
+    program = main_program or default_main_program()
+    scope = executor.scope
+    os.makedirs(dirname, exist_ok=True)
+    tree = {n: scope.get(n) for n in _persistable_names(program)
+            if scope.has(n)}
+    with open(os.path.join(dirname, "persistables.tar"), "wb") as f:
+        to_tar(f, tree)
+
+
+def load_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None):
+    import jax.numpy as jnp
+    with open(os.path.join(dirname, "persistables.tar"), "rb") as f:
+        tree = from_tar(f)
+    for name, arr in tree.items():
+        executor.scope.set(name, jnp.asarray(arr))

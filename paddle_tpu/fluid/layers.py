@@ -1,0 +1,256 @@
+"""Op-emitting layer builders (fluid/layers.py analog).
+
+Each function appends OpDescs+VarDescs to the default main program and returns
+the output Variable — the same builder pattern as python/paddle/v2/fluid/
+layers.py (fc:18, embedding:90, data:179, conv2d:638). Parameter creation goes
+through ``_create_parameter`` which also appends the init op to the startup
+program (fluid initializer semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..nn import initializer as I
+from .framework import (Program, Variable, default_main_program,
+                        default_startup_program)
+
+_seed_counter = [0]
+
+
+def _next_seed() -> int:
+    _seed_counter[0] += 1
+    return _seed_counter[0]
+
+
+def _block():
+    return default_main_program().global_block()
+
+
+def _create_parameter(name_hint: str, shape, dtype="float32",
+                      init: Optional[I.Initializer] = None) -> Variable:
+    main = default_main_program()
+    name = main.unique_name(name_hint)
+    v = main.global_block().create_var(name=name, shape=shape, dtype=dtype,
+                                       persistable=True)
+    sb = default_startup_program().global_block()
+    sv = sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True)
+    sb.append_op("fill_init", inputs={}, outputs={"Out": [name]},
+                 attrs={"shape": tuple(shape), "dtype": dtype,
+                        "init": init or I.gen1_default(), "seed": _next_seed()})
+    return v
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0) -> Variable:
+    """Feed slot (layers.py data:179); shape excludes the batch dim."""
+    return _block().create_var(name=name, shape=(-1,) + tuple(shape),
+                               dtype=dtype, is_data=True, lod_level=lod_level)
+
+
+def fc(input: Variable, size: int, act: Optional[str] = None,
+       bias_attr: bool = True, param_init=None) -> Variable:
+    # reference fc semantics (num_flatten_dims=1): everything after the batch
+    # dim is flattened into the contraction, weight is [prod(rest), size]
+    b = _block()
+    in_dim = int(np.prod(input.shape[1:]))
+    w = _create_parameter("fc_w", (in_dim, size), input.dtype, param_init)
+    out = b.create_var(shape=(input.shape[0], size), dtype=input.dtype)
+    b.append_op("mul", {"X": [input.name], "Y": [w.name]},
+                {"Out": [out.name]}, {"x_num_col_dims": 1})
+    if bias_attr:
+        bias = _create_parameter("fc_b", (size,), input.dtype, I.zeros)
+        out2 = b.create_var(shape=out.shape, dtype=out.dtype)
+        b.append_op("elementwise_add", {"X": [out.name], "Y": [bias.name]},
+                    {"Out": [out2.name]})
+        out = out2
+    if act:
+        out = activation(out, act)
+    return out
+
+
+def embedding(input: Variable, size: Sequence[int], param_init=None) -> Variable:
+    b = _block()
+    w = _create_parameter("embedding_w", tuple(size), "float32",
+                          param_init or I.normal(0.0, 0.01))
+    out = b.create_var(shape=input.shape + (size[1],), dtype="float32")
+    b.append_op("lookup_table", {"W": [w.name], "Ids": [input.name]},
+                {"Out": [out.name]})
+    return out
+
+
+def activation(input: Variable, act: str) -> Variable:
+    b = _block()
+    out = b.create_var(shape=input.shape, dtype=input.dtype)
+    b.append_op(act, {"X": [input.name]}, {"Out": [out.name]})
+    return out
+
+
+def relu(x):
+    return activation(x, "relu")
+
+
+def sigmoid(x):
+    return activation(x, "sigmoid")
+
+
+def tanh(x):
+    return activation(x, "tanh")
+
+
+def softmax(x):
+    return activation(x, "softmax")
+
+
+def _binary(op_type: str, x: Variable, y: Variable) -> Variable:
+    b = _block()
+    out = b.create_var(shape=x.shape, dtype=x.dtype)
+    b.append_op(op_type, {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]})
+    return out
+
+
+def elementwise_add(x, y):
+    return _binary("elementwise_add", x, y)
+
+
+def elementwise_sub(x, y):
+    return _binary("elementwise_sub", x, y)
+
+
+def elementwise_mul(x, y):
+    return _binary("elementwise_mul", x, y)
+
+
+def elementwise_div(x, y):
+    return _binary("elementwise_div", x, y)
+
+
+def matmul(x: Variable, y: Variable, transpose_x=False, transpose_y=False) -> Variable:
+    b = _block()
+    out = b.create_var(shape=x.shape[:-1] + (y.shape[-1],), dtype=x.dtype)
+    b.append_op("matmul", {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]},
+                {"transpose_X": transpose_x, "transpose_Y": transpose_y})
+    return out
+
+
+def cross_entropy(input: Variable, label: Variable,
+                  soft_label: bool = False) -> Variable:
+    b = _block()
+    out = b.create_var(shape=(input.shape[0], 1), dtype=input.dtype)
+    b.append_op("cross_entropy", {"X": [input.name], "Label": [label.name]},
+                {"Y": [out.name]}, {"soft_label": soft_label})
+    return out
+
+
+def softmax_with_cross_entropy(logits: Variable, label: Variable) -> Variable:
+    b = _block()
+    loss = b.create_var(shape=(logits.shape[0], 1), dtype=logits.dtype)
+    soft = b.create_var(shape=logits.shape, dtype=logits.dtype)
+    b.append_op("softmax_with_cross_entropy",
+                {"Logits": [logits.name], "Label": [label.name]},
+                {"Loss": [loss.name], "Softmax": [soft.name]})
+    return loss
+
+
+def mean(x: Variable) -> Variable:
+    b = _block()
+    out = b.create_var(shape=(), dtype=x.dtype)
+    b.append_op("mean", {"X": [x.name]}, {"Out": [out.name]})
+    return out
+
+
+def sums(xs: List[Variable]) -> Variable:
+    b = _block()
+    out = b.create_var(shape=xs[0].shape, dtype=xs[0].dtype)
+    b.append_op("sum", {"X": [v.name for v in xs]}, {"Out": [out.name]})
+    return out
+
+
+def reshape(x: Variable, shape: Sequence[int]) -> Variable:
+    b = _block()
+    out = b.create_var(shape=tuple(shape), dtype=x.dtype)
+    b.append_op("reshape", {"X": [x.name]}, {"Out": [out.name]},
+                {"shape": tuple(shape)})
+    return out
+
+
+def concat(xs: List[Variable], axis: int = 0) -> Variable:
+    b = _block()
+    shape = list(xs[0].shape)
+    shape[axis] = sum(v.shape[axis] for v in xs)
+    out = b.create_var(shape=tuple(shape), dtype=xs[0].dtype)
+    b.append_op("concat", {"X": [v.name for v in xs]}, {"Out": [out.name]},
+                {"axis": axis})
+    return out
+
+
+def _ensure_step_var() -> str:
+    """Implicit int32 step counter the Executor feeds and increments each run
+    — gives stochastic ops a fresh key per batch (the reference reseeds
+    per-batch via its global RNG)."""
+    b = _block()
+    if not b.has_var("__step__"):
+        b.create_var(name="__step__", shape=(), dtype="int32", is_data=True)
+    return "__step__"
+
+
+def dropout(x: Variable, dropout_prob: float, is_test: bool = False) -> Variable:
+    b = _block()
+    out = b.create_var(shape=x.shape, dtype=x.dtype)
+    inputs = {"X": [x.name]}
+    if not is_test:
+        inputs["Step"] = [_ensure_step_var()]
+    b.append_op("dropout", inputs, {"Out": [out.name]},
+                {"dropout_prob": dropout_prob, "is_test": is_test,
+                 "seed": _next_seed()})
+    return out
+
+
+def conv2d(input: Variable, num_filters: int, filter_size: int, stride=1,
+           padding=0, groups: int = 1, act: Optional[str] = None,
+           bias_attr: bool = True) -> Variable:
+    b = _block()
+    cin = input.shape[-1]
+    k = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
+    w = _create_parameter("conv2d_w", k + (cin // groups, num_filters),
+                          input.dtype, I.msra())
+    out = b.create_var(shape=(-1, -1, -1, num_filters), dtype=input.dtype)
+    b.append_op("conv2d", {"Input": [input.name], "Filter": [w.name]},
+                {"Out": [out.name]},
+                {"strides": stride, "paddings": padding, "groups": groups})
+    if bias_attr:
+        bias = _create_parameter("conv2d_b", (num_filters,), input.dtype, I.zeros)
+        out2 = b.create_var(shape=out.shape, dtype=out.dtype)
+        b.append_op("elementwise_add", {"X": [out.name], "Y": [bias.name]},
+                    {"Out": [out2.name]})
+        out = out2
+    if act:
+        out = activation(out, act)
+    return out
+
+
+def pool2d(input: Variable, pool_size: int = 2, pool_type: str = "max",
+           pool_stride=None, pool_padding=0,
+           global_pooling: bool = False) -> Variable:
+    b = _block()
+    out_shape = ((-1, input.shape[-1]) if global_pooling
+                 else (-1, -1, -1, input.shape[-1]))
+    out = b.create_var(shape=out_shape, dtype=input.dtype)
+    b.append_op("pool2d", {"X": [input.name]}, {"Out": [out.name]},
+                {"ksize": pool_size, "pooling_type": pool_type,
+                 "strides": pool_stride, "paddings": pool_padding,
+                 "global_pooling": global_pooling})
+    return out
+
+
+def accuracy(input: Variable, label: Variable) -> Variable:
+    b = _block()
+    acc = b.create_var(shape=(), dtype="float32")
+    cor = b.create_var(shape=(), dtype="float32")
+    tot = b.create_var(shape=(), dtype="float32")
+    b.append_op("accuracy", {"Out": [input.name], "Label": [label.name]},
+                {"Accuracy": [acc.name], "Correct": [cor.name],
+                 "Total": [tot.name]})
+    return acc

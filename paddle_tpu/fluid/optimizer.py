@@ -1,0 +1,121 @@
+"""Program-level optimizers: minimize() appends backward + update ops.
+
+Reference: fluid/optimizer.py — SGD/Momentum/Adam emit optimizer OpDescs plus
+learning-rate and accumulator variables into the program
+(operators/{sgd,momentum,adam}_op.cc compute the updates). Same structure here;
+the update ops run inside the executor's single compiled computation, so the
+whole train step (fwd+bwd+update) is one XLA program — the fusion the reference
+could not get from per-op dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..nn import initializer as I
+from .backward import append_backward
+from .framework import (Program, Variable, default_main_program,
+                        default_startup_program)
+
+
+class Optimizer:
+    def __init__(self, learning_rate: float = 0.01):
+        self.learning_rate = learning_rate
+        self._lr_var: Optional[Variable] = None
+
+    # -- helpers -----------------------------------------------------------
+    def _ensure_lr(self, program: Program) -> Variable:
+        if self._lr_var is not None:
+            return self._lr_var
+        b = program.global_block()
+        name = program.unique_name("learning_rate")
+        v = b.create_var(name=name, shape=(), dtype="float32", persistable=True)
+        sb = default_startup_program().global_block()
+        sb.create_var(name=name, shape=(), dtype="float32", persistable=True)
+        sb.append_op("fill_init", {}, {"Out": [name]},
+                     {"shape": (), "dtype": "float32",
+                      "init": I.constant(self.learning_rate), "seed": 0})
+        self._lr_var = v
+        return v
+
+    def _accumulator(self, program: Program, param: Variable, suffix: str,
+                     shape=None, value: float = 0.0) -> Variable:
+        b = program.global_block()
+        name = f"{param.name}@{suffix}"
+        shape = tuple(param.shape if shape is None else shape)
+        v = b.create_var(name=name, shape=shape, dtype=param.dtype,
+                         persistable=True)
+        sb = default_startup_program().global_block()
+        sb.create_var(name=name, shape=shape, dtype=param.dtype,
+                      persistable=True)
+        sb.append_op("fill_init", {}, {"Out": [name]},
+                     {"shape": shape, "dtype": param.dtype,
+                      "init": I.constant(value), "seed": 0})
+        return v
+
+    def _append_update(self, program, param, grad, lr):
+        raise NotImplementedError
+
+    # -- public ------------------------------------------------------------
+    def minimize(self, loss: Variable,
+                 program: Optional[Program] = None) -> List[Tuple]:
+        program = program or default_main_program()
+        pg = append_backward(loss, program=program)
+        lr = self._ensure_lr(program)
+        for param, grad in pg:
+            self._append_update(program, param, grad, lr)
+        return pg
+
+
+class SGDOptimizer(Optimizer):
+    def _append_update(self, program, param, grad, lr):
+        program.global_block().append_op(
+            "sgd",
+            {"Param": [param.name], "Grad": [grad.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [param.name]})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum: float = 0.9,
+                 use_nesterov: bool = False):
+        super().__init__(learning_rate)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _append_update(self, program, param, grad, lr):
+        vel = self._accumulator(program, param, "velocity")
+        program.global_block().append_op(
+            "momentum",
+            {"Param": [param.name], "Grad": [grad.name],
+             "Velocity": [vel.name], "LearningRate": [lr.name]},
+            {"ParamOut": [param.name], "VelocityOut": [vel.name]},
+            {"mu": self.momentum, "use_nesterov": self.use_nesterov})
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _append_update(self, program, param, grad, lr):
+        m1 = self._accumulator(program, param, "moment1")
+        m2 = self._accumulator(program, param, "moment2")
+        b1p = self._accumulator(program, param, "beta1_pow", shape=(),
+                                value=self.beta1)
+        b2p = self._accumulator(program, param, "beta2_pow", shape=(),
+                                value=self.beta2)
+        program.global_block().append_op(
+            "adam",
+            {"Param": [param.name], "Grad": [grad.name],
+             "Moment1": [m1.name], "Moment2": [m2.name],
+             "Beta1Pow": [b1p.name], "Beta2Pow": [b2p.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [param.name], "Moment1Out": [m1.name],
+             "Moment2Out": [m2.name], "Beta1PowOut": [b1p.name],
+             "Beta2PowOut": [b2p.name]},
+            {"beta1": self.beta1, "beta2": self.beta2,
+             "epsilon": self.epsilon})

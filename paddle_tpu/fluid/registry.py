@@ -1,0 +1,344 @@
+"""Operator registry: op type -> jax-traceable compute function.
+
+The analog of OpRegistry + OperatorWithKernel (framework/op_registry.h:129-233,
+operator.h:375): each op is a pure function from input arrays + attrs to output
+arrays. There is no per-op CPU/GPU kernel pair and no hand-written grad op —
+XLA lowers one compute to every backend, and JAX autodiff differentiates
+through the whole traced block (replacing the grad-op registry +
+backward.cc:343 MakeOpGrad machinery).
+
+Compute signature::
+
+    def compute(inputs: Dict[str, List[Array]], attrs: Dict) -> Dict[str, List[Array]]
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+
+class OpRegistry:
+    _ops: Dict[str, Callable] = {}
+
+    @classmethod
+    def register(cls, op_type: str):
+        def deco(fn):
+            cls._ops[op_type] = fn
+            return fn
+        return deco
+
+    @classmethod
+    def has(cls, op_type: str) -> bool:
+        return op_type in cls._ops
+
+    @classmethod
+    def get(cls, op_type: str) -> Callable:
+        return cls._ops[op_type]
+
+    @classmethod
+    def registered(cls) -> List[str]:
+        return sorted(cls._ops)
+
+
+def _x(ins, key="X"):
+    return ins[key][0]
+
+
+# ---------------------------------------------------------------- basic math --
+
+@OpRegistry.register("elementwise_add")
+def _add(ins, attrs):
+    x, y = _x(ins), _x(ins, "Y")
+    return {"Out": [x + y]}
+
+
+@OpRegistry.register("elementwise_sub")
+def _sub(ins, attrs):
+    return {"Out": [_x(ins) - _x(ins, "Y")]}
+
+
+@OpRegistry.register("elementwise_mul")
+def _emul(ins, attrs):
+    return {"Out": [_x(ins) * _x(ins, "Y")]}
+
+
+@OpRegistry.register("elementwise_div")
+def _ediv(ins, attrs):
+    return {"Out": [_x(ins) / _x(ins, "Y")]}
+
+
+@OpRegistry.register("mul")
+def _mul(ins, attrs):
+    """X [b.., M] x Y [M, N] with num_col_dims flattening (operators/mul_op.cc)."""
+    from ..ops.math import mul as mul_op
+    return {"Out": [mul_op(_x(ins), _x(ins, "Y"),
+                           x_num_col_dims=attrs.get("x_num_col_dims", 1),
+                           y_num_col_dims=attrs.get("y_num_col_dims", 1))]}
+
+
+@OpRegistry.register("matmul")
+def _matmul(ins, attrs):
+    from ..ops.math import matmul
+    return {"Out": [matmul(_x(ins), _x(ins, "Y"),
+                           transpose_x=attrs.get("transpose_X", False),
+                           transpose_y=attrs.get("transpose_Y", False))]}
+
+
+@OpRegistry.register("scale")
+def _scale(ins, attrs):
+    return {"Out": [_x(ins) * attrs.get("scale", 1.0) + attrs.get("bias", 0.0)]}
+
+
+@OpRegistry.register("mean")
+def _mean(ins, attrs):
+    return {"Out": [jnp.mean(_x(ins))]}
+
+
+@OpRegistry.register("sum")
+def _sum(ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@OpRegistry.register("reduce_sum")
+def _rsum(ins, attrs):
+    return {"Out": [jnp.sum(_x(ins), axis=attrs.get("dim"),
+                            keepdims=attrs.get("keep_dim", False))]}
+
+
+@OpRegistry.register("reshape")
+def _reshape(ins, attrs):
+    return {"Out": [jnp.reshape(_x(ins), attrs["shape"])]}
+
+
+@OpRegistry.register("transpose")
+def _transpose(ins, attrs):
+    return {"Out": [jnp.transpose(_x(ins), attrs.get("axis"))]}
+
+
+@OpRegistry.register("concat")
+def _concat(ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@OpRegistry.register("split")
+def _split(ins, attrs):
+    from ..ops.math import split as split_op
+    outs = split_op(_x(ins), attrs["num_or_sections"], attrs.get("axis", 0))
+    return {"Out": list(outs)}
+
+
+@OpRegistry.register("cast")
+def _cast(ins, attrs):
+    return {"Out": [_x(ins).astype(attrs["dtype"])]}
+
+
+@OpRegistry.register("clip")
+def _clip(ins, attrs):
+    return {"Out": [jnp.clip(_x(ins), attrs["min"], attrs["max"])]}
+
+
+# -------------------------------------------------------------- activations ---
+
+for _name in ("sigmoid", "tanh", "relu", "softmax", "log_softmax", "gelu",
+              "leaky_relu", "elu", "softsign", "square", "sqrt", "abs_act",
+              "exponential", "brelu", "soft_shrink", "hard_shrink",
+              "thresholded_relu", "stanh", "softrelu", "hard_sigmoid",
+              "swish", "reciprocal", "log"):
+    def _make(name=_name):
+        from ..ops import activations as A
+        fn = getattr(A, name)
+
+        def compute(ins, attrs, _fn=fn):
+            return {"Out": [_fn(_x(ins), **attrs)]}
+        return compute
+    OpRegistry._ops[_name] = _make()
+OpRegistry._ops["abs"] = OpRegistry._ops["abs_act"]
+
+
+# -------------------------------------------------------------------- fills ---
+
+@OpRegistry.register("fill_constant")
+def _fill(ins, attrs):
+    return {"Out": [jnp.full(attrs["shape"], attrs["value"],
+                             dtype=attrs.get("dtype", "float32"))]}
+
+
+@OpRegistry.register("fill_init")
+def _fill_init(ins, attrs):
+    """Startup-program parameter init: attr 'init' is a host callable
+    (initializer), attr 'seed' the fold-in key — runs host-side once."""
+    init = attrs["init"]
+    key = jax.random.PRNGKey(attrs.get("seed", 0))
+    return {"Out": [init(key, attrs["shape"],
+                         jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+@OpRegistry.register("gaussian_random")
+def _gauss(ins, attrs):
+    key = jax.random.PRNGKey(attrs.get("seed", 0))
+    return {"Out": [attrs.get("mean", 0.0) + attrs.get("std", 1.0)
+                    * jax.random.normal(key, attrs["shape"])]}
+
+
+@OpRegistry.register("uniform_random")
+def _unif(ins, attrs):
+    key = jax.random.PRNGKey(attrs.get("seed", 0))
+    return {"Out": [jax.random.uniform(key, attrs["shape"],
+                                       minval=attrs.get("min", -1.0),
+                                       maxval=attrs.get("max", 1.0))]}
+
+
+@OpRegistry.register("dropout")
+def _dropout(ins, attrs):
+    from ..ops.random import dropout as drop
+    rate = attrs.get("dropout_prob", 0.5)
+    if not attrs.get("is_test", True):
+        key = jax.random.PRNGKey(attrs.get("seed", 0))
+        if "Step" in ins:  # fresh mask per executor run
+            key = jax.random.fold_in(key, ins["Step"][0])
+        out = drop(_x(ins), rate, key, train=True)
+    else:
+        out = _x(ins)
+    return {"Out": [out]}
+
+
+# ------------------------------------------------------------------- layers ---
+
+@OpRegistry.register("lookup_table")
+def _lookup(ins, attrs):
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    return {"Out": [jnp.take(w, ids, axis=0)]}
+
+
+@OpRegistry.register("conv2d")
+def _conv2d(ins, attrs):
+    from ..ops.conv import conv2d
+    return {"Out": [conv2d(ins["Input"][0], ins["Filter"][0],
+                           stride=attrs.get("strides", 1),
+                           padding=attrs.get("paddings", 0),
+                           dilation=attrs.get("dilations", 1),
+                           groups=attrs.get("groups", 1))]}
+
+
+@OpRegistry.register("pool2d")
+def _pool2d(ins, attrs):
+    from ..ops import pool as P
+    fn = P.max_pool2d if attrs.get("pooling_type", "max") == "max" else P.avg_pool2d
+    if attrs.get("global_pooling", False):
+        g = (P.global_max_pool2d if attrs.get("pooling_type", "max") == "max"
+             else P.global_avg_pool2d)
+        return {"Out": [g(_x(ins))]}
+    return {"Out": [fn(_x(ins), attrs.get("ksize", 2),
+                       attrs.get("strides"), attrs.get("paddings", 0))]}
+
+
+@OpRegistry.register("batch_norm_infer")
+def _bn_infer(ins, attrs):
+    from ..ops.norm import batch_norm
+    out = batch_norm(_x(ins), ins["Scale"][0], ins["Bias"][0],
+                     mean=ins["Mean"][0], var=ins["Variance"][0],
+                     eps=attrs.get("epsilon", 1e-5))
+    return {"Out": [out if not isinstance(out, tuple) else out[0]]}
+
+
+@OpRegistry.register("layer_norm")
+def _ln(ins, attrs):
+    from ..ops.norm import layer_norm
+    return {"Out": [layer_norm(_x(ins), ins["Scale"][0], ins["Bias"][0],
+                               eps=attrs.get("epsilon", 1e-5))]}
+
+
+# ------------------------------------------------------------------- losses ---
+
+@OpRegistry.register("cross_entropy")
+def _ce(ins, attrs):
+    from ..ops.loss import cross_entropy
+    return {"Y": [cross_entropy(_x(ins), ins["Label"][0],
+                                soft_label=attrs.get("soft_label", False))]}
+
+
+@OpRegistry.register("softmax_with_cross_entropy")
+def _sce(ins, attrs):
+    from ..ops.loss import softmax_with_cross_entropy
+    logits = ins["Logits"][0]
+    return {"Loss": [softmax_with_cross_entropy(logits, ins["Label"][0])],
+            "Softmax": [jax.nn.softmax(logits, -1)]}
+
+
+@OpRegistry.register("sigmoid_cross_entropy_with_logits")
+def _sigce(ins, attrs):
+    from ..ops.loss import sigmoid_cross_entropy_with_logits
+    return {"Out": [sigmoid_cross_entropy_with_logits(_x(ins), ins["Label"][0])]}
+
+
+@OpRegistry.register("square_error")
+def _sqerr(ins, attrs):
+    from ..ops.loss import square_error
+    return {"Out": [square_error(_x(ins), ins["Label"][0])]}
+
+
+# ------------------------------------------------------------------ metrics ---
+
+@OpRegistry.register("accuracy")
+def _acc(ins, attrs):
+    from ..ops.metrics import accuracy
+    correct, total = accuracy(_x(ins, "Out"), ins["Label"][0])
+    return {"Accuracy": [correct / total], "Correct": [correct],
+            "Total": [total]}
+
+
+@OpRegistry.register("top_k")
+def _topk(ins, attrs):
+    vals, idx = jax.lax.top_k(_x(ins), attrs["k"])
+    return {"Out": [vals], "Indices": [idx]}
+
+
+# ---------------------------------------------------------------- optimizer ---
+
+@OpRegistry.register("sgd")
+def _sgd(ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [p - lr * g]}
+
+
+@OpRegistry.register("momentum")
+def _momentum(ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0]
+    mu = attrs.get("mu", 0.9)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@OpRegistry.register("adam")
+def _adam(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    mhat = m_new / (1 - b1p)
+    vhat = v_new / (1 - b2p)
+    return {"ParamOut": [p - lr * mhat / (jnp.sqrt(vhat) + eps)],
+            "Moment1Out": [m_new], "Moment2Out": [v_new],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@OpRegistry.register("autodiff_grad")
+def _autodiff_stub(ins, attrs):
+    raise RuntimeError("autodiff_grad is lowered by the executor, not run directly")

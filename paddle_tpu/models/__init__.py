@@ -1,3 +1,14 @@
-from .mlp import MnistMLP
+"""Model zoo — mirrors the reference's demo/benchmark/book model families
+(SURVEY.md §2.4 v1_api_demo + benchmark/paddle + fluid/tests/book)."""
 
-__all__ = ["MnistMLP"]
+from .embeddings import DeepFM, Recommender, Word2Vec
+from .image import LeNet, ResNet, SmallNet, VGG, resnet50
+from .mlp import MnistMLP
+from .seq2seq import AttentionSeq2Seq
+from .tagger import BiLSTMCRFTagger, LinearCRFTagger
+from .text_cls import BiLSTMTextCls, ConvTextCls, LSTMTextCls
+
+__all__ = ["MnistMLP", "LeNet", "SmallNet", "VGG", "ResNet", "resnet50",
+           "LSTMTextCls", "BiLSTMTextCls", "ConvTextCls",
+           "AttentionSeq2Seq", "LinearCRFTagger", "BiLSTMCRFTagger",
+           "Word2Vec", "Recommender", "DeepFM"]

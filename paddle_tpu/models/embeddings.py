@@ -1,0 +1,124 @@
+"""Word2vec + recommender + DeepFM — the embedding-heavy book models.
+
+Analogs:
+* word2vec      — ``fluid/tests/book/test_word2vec.py`` (n-gram context ->
+  next-word softmax over shared embeddings) and the imikolov dataset.
+* recommender   — ``fluid/tests/book/test_recommender_system.py`` (movielens:
+  user/movie feature towers -> cosine/fc -> rating regression).
+* DeepFM (CTR)  — the sparse wide&deep capability carried by the reference's
+  sparse-row embeddings + pserver path (SURVEY §2.5 sparse/embedding-parallel);
+  the standard Criteo CTR model shape.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.lod import SeqBatch
+from ..ops import loss as L
+
+
+class Word2Vec(nn.Module):
+    """N-gram neural LM: concat context embeddings -> hidden -> softmax."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 32, context: int = 4,
+                 hidden: int = 128):
+        super().__init__()
+        self.context = context
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        self.fc1 = nn.Linear(context * embed_dim, hidden, act="relu")
+        self.out = nn.Linear(hidden, vocab_size)
+
+    def __call__(self, params, context_ids, **kw):
+        """context_ids [B, context] -> logits [B, V]."""
+        e = self.embed(params["embed"], context_ids)       # [B, C, E]
+        h = e.reshape(e.shape[0], -1)
+        return self.out(params["out"], self.fc1(params["fc1"], h))
+
+    def loss(self, params, context_ids, target_ids):
+        return jnp.mean(L.softmax_with_cross_entropy(self(params, context_ids),
+                                                     target_ids))
+
+
+class Recommender(nn.Module):
+    """Two-tower movielens regressor (book test_recommender_system schema):
+    user tower (id/gender/age/job embeddings) x movie tower (id emb + category
+    pooled) -> fc -> rating."""
+
+    def __init__(self, n_users: int, n_movies: int, n_categories: int,
+                 n_jobs: int, n_ages: int, dim: int = 32):
+        super().__init__()
+        self.uid = nn.Embedding(n_users, dim)
+        self.gender = nn.Embedding(2, dim // 2)
+        self.age = nn.Embedding(n_ages, dim // 2)
+        self.job = nn.Embedding(n_jobs, dim // 2)
+        self.user_fc = nn.Linear(dim + 3 * (dim // 2), dim, act="tanh")
+        self.mid = nn.Embedding(n_movies, dim)
+        self.cat = nn.Embedding(n_categories, dim // 2)
+        self.movie_fc = nn.Linear(dim + dim // 2, dim, act="tanh")
+        self.head = nn.Linear(2 * dim, 1)
+
+    def __call__(self, params, uid, gender, age, job, mid, cat_ids, cat_vals,
+                 **kw):
+        """cat_ids/cat_vals: padded sparse category slot [B, K]."""
+        u = jnp.concatenate([
+            self.uid(params["uid"], uid),
+            self.gender(params["gender"], gender),
+            self.age(params["age"], age),
+            self.job(params["job"], job)], axis=-1)
+        u = self.user_fc(params["user_fc"], u)
+        cat_e = self.cat(params["cat"], cat_ids)            # [B, K, D/2]
+        denom = jnp.maximum(cat_vals.sum(-1, keepdims=True), 1.0)
+        cat_pooled = (cat_e * cat_vals[..., None]).sum(1) / denom
+        m = jnp.concatenate([self.mid(params["mid"], mid), cat_pooled], axis=-1)
+        m = self.movie_fc(params["movie_fc"], m)
+        return self.head(params["head"], jnp.concatenate([u, m], axis=-1))[..., 0]
+
+    def loss(self, params, uid, gender, age, job, mid, cat_ids, cat_vals, rating):
+        pred = self(params, uid, gender, age, job, mid, cat_ids, cat_vals)
+        return jnp.mean((pred - rating) ** 2)
+
+
+class DeepFM(nn.Module):
+    """Factorization machine + deep tower over hashed sparse fields.
+
+    first-order: sum of per-field weights; second-order: FM pairwise via the
+    (sum^2 - sum-of-squares)/2 identity — one embedding gather feeds both FM
+    and the MLP, all dense MXU work after the gather.
+    """
+
+    def __init__(self, hash_size: int, num_fields: int, dense_dim: int,
+                 factor: int = 8, hidden: Sequence[int] = (64, 32)):
+        super().__init__()
+        self.w1 = nn.Embedding(hash_size, 1)               # first-order weights
+        self.v = nn.Embedding(hash_size, factor)           # FM factors
+        self.dense_w = nn.Linear(dense_dim, 1, bias=False)
+        dims = [num_fields * factor + dense_dim] + list(hidden)
+        self.deep = [nn.Linear(dims[i], dims[i + 1], act="relu")
+                     for i in range(len(hidden))]
+        self.deep_out = nn.Linear(dims[-1], 1)
+
+    def __call__(self, params, dense, field_ids, **kw):
+        """dense [B, dense_dim]; field_ids [B, num_fields] hashed ids."""
+        lin = self.w1(params["w1"], field_ids)[..., 0].sum(-1, keepdims=True)
+        lin = lin + self.dense_w(params["dense_w"], dense)
+        vi = self.v(params["v"], field_ids)                # [B, F, k]
+        fm = 0.5 * (jnp.square(vi.sum(1)) - jnp.square(vi).sum(1)).sum(
+            -1, keepdims=True)
+        h = jnp.concatenate([vi.reshape(vi.shape[0], -1), dense], axis=-1)
+        for i, layer in enumerate(self.deep):
+            h = layer(params[f"deep_{i}"], h)
+        deep = self.deep_out(params["deep_out"], h)
+        return (lin + fm + deep)[..., 0]                   # logit
+
+    def loss(self, params, dense, field_ids, labels):
+        logit = self(params, dense, field_ids)
+        return jnp.mean(L.sigmoid_cross_entropy_with_logits(
+            logit, labels.astype(jnp.float32)))
+
+    def predict_proba(self, params, dense, field_ids):
+        return jax.nn.sigmoid(self(params, dense, field_ids))

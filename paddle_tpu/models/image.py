@@ -1,0 +1,208 @@
+"""Image classification models — the benchmark/image family.
+
+Analogs:
+* LeNet       — ``v1_api_demo/mnist/light_mnist.py`` (conv mnist demo)
+* VGG-16      — ``benchmark/paddle/image/vgg.py`` + networks.py vgg_16_network:468
+* ResNet-N    — ``benchmark/paddle/image/resnet.py`` (layer_num 50/101/152)
+* SmallNet    — ``benchmark/paddle/image/smallnet_mnist_cifar.py`` (cifar-quick)
+
+TPU-first: NHWC layout (XLA's preferred conv layout on TPU), BatchNorm running
+stats via the Module 'stats' convention, bottleneck convs sized to keep the MXU
+busy. Channel counts stay multiples of 8/128 where it matters.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+from ..ops import pool as P
+
+
+class LeNet(nn.Module):
+    """conv-pool x2 + fc — light_mnist analog. Input [B, 28, 28, 1]."""
+
+    def __init__(self, classes: int = 10):
+        super().__init__()
+        self.c1 = nn.Conv2D(1, 20, 5, act="relu")
+        self.c2 = nn.Conv2D(20, 50, 5, act="relu")
+        self.fc1 = nn.Linear(4 * 4 * 50, 500, act="relu")
+        self.fc2 = nn.Linear(500, classes)
+
+    def __call__(self, params, x, **kw):
+        h = P.max_pool2d(self.c1(params["c1"], x), 2, 2)
+        h = P.max_pool2d(self.c2(params["c2"], h), 2, 2)
+        h = h.reshape(h.shape[0], -1)
+        return self.fc2(params["fc2"], self.fc1(params["fc1"], h))
+
+    def loss(self, params, x, labels):
+        return jnp.mean(L.softmax_with_cross_entropy(self(params, x), labels))
+
+
+class SmallNet(nn.Module):
+    """cifar-quick: 3x(conv-pool) + fc (smallnet_mnist_cifar.py). [B,32,32,3]."""
+
+    def __init__(self, classes: int = 10):
+        super().__init__()
+        self.c1 = nn.Conv2D(3, 32, 5, padding=2, act="relu")
+        self.c2 = nn.Conv2D(32, 32, 5, padding=2, act="relu")
+        self.c3 = nn.Conv2D(32, 64, 5, padding=2, act="relu")
+        self.fc1 = nn.Linear(4 * 4 * 64, 64, act="relu")
+        self.fc2 = nn.Linear(64, classes)
+
+    def __call__(self, params, x, **kw):
+        h = P.max_pool2d(self.c1(params["c1"], x), 2, 2)
+        h = P.max_pool2d(self.c2(params["c2"], h), 2, 2)
+        h = P.max_pool2d(self.c3(params["c3"], h), 2, 2)
+        h = h.reshape(h.shape[0], -1)
+        return self.fc2(params["fc2"], self.fc1(params["fc1"], h))
+
+    def loss(self, params, x, labels):
+        return jnp.mean(L.softmax_with_cross_entropy(self(params, x), labels))
+
+
+class _ConvBN(nn.Module):
+    def __init__(self, cin, cout, k, stride=1, padding=0, act=None):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                              bias=False)
+        self.bn = nn.BatchNorm(cout)
+        self.act = act
+
+    def __call__(self, params, x, train=False, mutable=None, **kw):
+        h = self.conv(params["conv"], x)
+        h = self.bn(params["bn"], h, train=train, mutable=mutable)
+        return self.act(h) if self.act else h
+
+
+class VGG(nn.Module):
+    """VGG-16 (vgg.py cfg [2,2,3,3,3] conv blocks + 2x512 fc)."""
+
+    def __init__(self, classes: int = 10, in_ch: int = 3, width_mult: float = 1.0):
+        super().__init__()
+        cfg = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+        c = in_ch
+        for i, (n, ch) in enumerate(cfg):
+            ch = max(8, int(ch * width_mult))
+            for j in range(n):
+                setattr(self, f"b{i}_{j}", _ConvBN(c, ch, 3, padding=1,
+                                                   act=jax.nn.relu))
+                c = ch
+        self.cfg = [n for n, _ in cfg]
+        self.fc1 = nn.Linear(c, 512, act="relu")
+        self.fc2 = nn.Linear(512, 512, act="relu")
+        self.out = nn.Linear(512, classes)
+
+    def __call__(self, params, x, train=False, mutable=None, **kw):
+        h = x
+        for i, n in enumerate(self.cfg):
+            for j in range(n):
+                m = getattr(self, f"b{i}_{j}")
+                h = m(params[f"b{i}_{j}"], h, train=train, mutable=mutable)
+            h = P.max_pool2d(h, 2, 2)
+        h = P.global_avg_pool2d(h)
+        h = self.fc1(params["fc1"], h)
+        h = self.fc2(params["fc2"], h)
+        return self.out(params["out"], h)
+
+    def loss(self, params, x, labels, train=False, mutable=None):
+        logits = self(params, x, train=train, mutable=mutable)
+        return jnp.mean(L.softmax_with_cross_entropy(logits, labels))
+
+
+class _Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 with projection shortcut (resnet.py bottleneck)."""
+
+    def __init__(self, cin, planes, stride=1):
+        super().__init__()
+        cout = planes * 4
+        self.a = _ConvBN(cin, planes, 1, act=jax.nn.relu)
+        self.b = _ConvBN(planes, planes, 3, stride=stride, padding=1,
+                         act=jax.nn.relu)
+        self.c = _ConvBN(planes, cout, 1)
+        self.proj = (None if (cin == cout and stride == 1)
+                     else _ConvBN(cin, cout, 1, stride=stride))
+
+    def __call__(self, params, x, train=False, mutable=None, **kw):
+        h = self.a(params["a"], x, train=train, mutable=mutable)
+        h = self.b(params["b"], h, train=train, mutable=mutable)
+        h = self.c(params["c"], h, train=train, mutable=mutable)
+        s = (x if self.proj is None
+             else self.proj(params["proj"], x, train=train, mutable=mutable))
+        return jax.nn.relu(h + s)
+
+
+class _BasicBlock(nn.Module):
+    def __init__(self, cin, planes, stride=1):
+        super().__init__()
+        self.a = _ConvBN(cin, planes, 3, stride=stride, padding=1,
+                         act=jax.nn.relu)
+        self.b = _ConvBN(planes, planes, 3, padding=1)
+        self.proj = (None if (cin == planes and stride == 1)
+                     else _ConvBN(cin, planes, 1, stride=stride))
+
+    def __call__(self, params, x, train=False, mutable=None, **kw):
+        h = self.a(params["a"], x, train=train, mutable=mutable)
+        h = self.b(params["b"], h, train=train, mutable=mutable)
+        s = (x if self.proj is None
+             else self.proj(params["proj"], x, train=train, mutable=mutable))
+        return jax.nn.relu(h + s)
+
+
+_RESNET_CFG = {
+    18: (_BasicBlock, [2, 2, 2, 2], 1),
+    34: (_BasicBlock, [3, 4, 6, 3], 1),
+    50: (_Bottleneck, [3, 4, 6, 3], 4),
+    101: (_Bottleneck, [3, 4, 23, 3], 4),
+    152: (_Bottleneck, [3, 8, 36, 3], 4),
+}
+
+
+class ResNet(nn.Module):
+    """ResNet-N for ImageNet-shaped input (resnet.py layer_num param).
+
+    width_mult shrinks channels for tiny tests; stem `small_input=True` swaps
+    the 7x7/s2+pool stem for 3x3/s1 (cifar-style).
+    """
+
+    def __init__(self, depth: int = 50, classes: int = 1000, in_ch: int = 3,
+                 width_mult: float = 1.0, small_input: bool = False):
+        super().__init__()
+        block, counts, expansion = _RESNET_CFG[depth]
+        w = lambda ch: max(8, int(ch * width_mult))
+        self.small_input = small_input
+        self.stem = (_ConvBN(in_ch, w(64), 3, stride=1, padding=1, act=jax.nn.relu)
+                     if small_input else
+                     _ConvBN(in_ch, w(64), 7, stride=2, padding=3, act=jax.nn.relu))
+        c = w(64)
+        self.layer_names: List[str] = []
+        for li, (planes, n) in enumerate(zip([64, 128, 256, 512], counts)):
+            for bi in range(n):
+                stride = 2 if (bi == 0 and li > 0) else 1
+                blk = block(c, w(planes), stride)
+                name = f"layer{li}_{bi}"
+                setattr(self, name, blk)
+                self.layer_names.append(name)
+                c = w(planes) * expansion
+        self.head = nn.Linear(c, classes)
+
+    def __call__(self, params, x, train=False, mutable=None, **kw):
+        h = self.stem(params["stem"], x, train=train, mutable=mutable)
+        if not self.small_input:
+            h = P.max_pool2d(h, 3, 2, padding=1)
+        for name in self.layer_names:
+            h = getattr(self, name)(params[name], h, train=train, mutable=mutable)
+        h = P.global_avg_pool2d(h)
+        return self.head(params["head"], h)
+
+    def loss(self, params, x, labels, train=False, mutable=None):
+        logits = self(params, x, train=train, mutable=mutable)
+        return jnp.mean(L.softmax_with_cross_entropy(logits, labels))
+
+
+def resnet50(classes: int = 1000, **kw) -> ResNet:
+    return ResNet(50, classes, **kw)

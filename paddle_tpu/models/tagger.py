@@ -1,0 +1,91 @@
+"""Sequence tagging — BiLSTM-CRF and linear-CRF.
+
+Analogs of ``v1_api_demo/sequence_tagging/`` (linear_crf.py, rnn_crf.py) and the
+CRF layer pair (gserver/layers/CRFLayer.cpp + LinearChainCRF.cpp; gen-2
+operators/linear_chain_crf_op.cc + crf_decoding_op.cc). The conll05 SRL demo
+(demo/semantic_role_labeling) uses the same shape.
+
+Forward-backward and Viterbi run as lax.scan over time with masked steps
+(ops/crf.py) — the dynamic program stays on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.lod import SeqBatch
+from ..nn.initializer import uniform, zeros
+from ..ops import crf as CRF
+from ..ops import rnn as R
+
+
+class _CRFHead(nn.Module):
+    def __init__(self, num_tags: int):
+        super().__init__()
+        self.num_tags = num_tags
+        self.param("start", (num_tags,), uniform(-0.05, 0.05))
+        self.param("end", (num_tags,), uniform(-0.05, 0.05))
+        self.param("trans", (num_tags, num_tags), uniform(-0.05, 0.05))
+
+    def loss(self, params, emissions, tags, lengths):
+        return jnp.mean(CRF.crf_loss(emissions, tags, lengths, params["start"],
+                                     params["end"], params["trans"]))
+
+    def decode(self, params, emissions, lengths):
+        return CRF.crf_decode(emissions, lengths, params["start"],
+                              params["end"], params["trans"])
+
+
+class LinearCRFTagger(nn.Module):
+    """embedding(+context window) -> linear -> CRF (linear_crf.py analog)."""
+
+    def __init__(self, vocab_size: int, num_tags: int, embed_dim: int = 64):
+        super().__init__()
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        self.fc = nn.Linear(embed_dim, num_tags)
+        self.crf = _CRFHead(num_tags)
+
+    def emissions(self, params, batch: SeqBatch):
+        x = self.embed(params["embed"], batch.data)
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, batch: SeqBatch, tags: SeqBatch):
+        e = self.emissions(params, batch)
+        return self.crf.loss(params["crf"], e, tags.data, batch.lengths)
+
+    def decode(self, params, batch: SeqBatch):
+        e = self.emissions(params, batch)
+        return self.crf.decode(params["crf"], e, batch.lengths)
+
+
+class BiLSTMCRFTagger(nn.Module):
+    """embedding -> BiLSTM -> linear -> CRF (rnn_crf.py analog)."""
+
+    def __init__(self, vocab_size: int, num_tags: int, embed_dim: int = 64,
+                 hidden: int = 64):
+        super().__init__()
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        for d in ("f", "b"):
+            self.param(f"w_{d}", (embed_dim, 4 * hidden), uniform(-0.08, 0.08))
+            self.param(f"u_{d}", (hidden, 4 * hidden), uniform(-0.08, 0.08))
+            self.param(f"bias_{d}", (4 * hidden,), zeros)
+        self.fc = nn.Linear(2 * hidden, num_tags)
+        self.crf = _CRFHead(num_tags)
+
+    def emissions(self, params, batch: SeqBatch):
+        x = self.embed(params["embed"], batch.data)
+        hf, _ = R.lstm(x, batch.lengths, params["w_f"], params["u_f"],
+                       params["bias_f"], forget_bias=1.0)
+        hb, _ = R.lstm(x, batch.lengths, params["w_b"], params["u_b"],
+                       params["bias_b"], reverse=True, forget_bias=1.0)
+        return self.fc(params["fc"], jnp.concatenate([hf, hb], axis=-1))
+
+    def loss(self, params, batch: SeqBatch, tags: SeqBatch):
+        e = self.emissions(params, batch)
+        return self.crf.loss(params["crf"], e, tags.data, batch.lengths)
+
+    def decode(self, params, batch: SeqBatch):
+        e = self.emissions(params, batch)
+        return self.crf.decode(params["crf"], e, batch.lengths)

@@ -1,0 +1,110 @@
+"""Text classification models — the quick_start / rnn-benchmark family.
+
+Analogs of the reference's text-classification configs:
+* LSTM net: ``benchmark/paddle/rnn/rnn.py`` (IMDB LSTM — the published
+  LSTM baseline, benchmark/README.md:115-134) and
+  ``v1_api_demo/quick_start/trainer_config.lstm.py``.
+* CNN net:  ``trainer_config_helpers/networks.py`` text_conv_pool +
+  ``quick_start/trainer_config.cnn.py``.
+* BiLSTM:   ``networks.py`` bidirectional_lstm (:553ff).
+
+TPU-first notes: the input-to-hidden projection for all 4 LSTM gates is one
+[B*T, D]x[D, 4H] matmul (MXU-sized), only the recurrence runs in a lax.scan;
+padding is masked LoD-style (ops/rnn.py), so ragged batches cost one bucket's
+padding, not a recompile.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.lod import SeqBatch
+from ..nn.initializer import uniform, zeros
+from ..ops import loss as L
+from ..ops import rnn as R
+from ..ops import sequence as S
+
+
+class LSTMTextCls(nn.Module):
+    """embedding -> (stacked) LSTM -> max-pool over time -> softmax."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 128, hidden: int = 128,
+                 classes: int = 2, num_layers: int = 1):
+        super().__init__()
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        self.num_layers = num_layers
+        dims = [embed_dim] + [hidden] * num_layers
+        for i in range(num_layers):
+            self.param(f"w{i}", (dims[i], 4 * hidden), uniform(-0.08, 0.08))
+            self.param(f"u{i}", (hidden, 4 * hidden), uniform(-0.08, 0.08))
+            self.param(f"b{i}", (4 * hidden,), zeros)
+        self.fc = nn.Linear(hidden, classes)
+
+    def __call__(self, params, batch: SeqBatch, **kw):
+        x = self.embed(params["embed"], batch.data)         # [B, T, E]
+        h = x
+        for i in range(self.num_layers):
+            h, _ = R.lstm(h, batch.lengths, params[f"w{i}"], params[f"u{i}"],
+                          params[f"b{i}"], forget_bias=1.0)
+        pooled = S.sequence_pool(h, batch.lengths, "max")
+        return self.fc(params["fc"], pooled)                # logits
+
+    def loss(self, params, batch: SeqBatch, labels):
+        return jnp.mean(L.softmax_with_cross_entropy(self(params, batch), labels))
+
+
+class BiLSTMTextCls(nn.Module):
+    """networks.py bidirectional_lstm analog: fwd+bwd LSTM, concat last states."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 128, hidden: int = 128,
+                 classes: int = 2):
+        super().__init__()
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        for d in ("f", "b"):
+            self.param(f"w_{d}", (embed_dim, 4 * hidden), uniform(-0.08, 0.08))
+            self.param(f"u_{d}", (hidden, 4 * hidden), uniform(-0.08, 0.08))
+            self.param(f"bias_{d}", (4 * hidden,), zeros)
+        self.fc = nn.Linear(2 * hidden, classes)
+
+    def __call__(self, params, batch: SeqBatch, **kw):
+        x = self.embed(params["embed"], batch.data)
+        hf, _ = R.lstm(x, batch.lengths, params["w_f"], params["u_f"],
+                       params["bias_f"], forget_bias=1.0)
+        hb, _ = R.lstm(x, batch.lengths, params["w_b"], params["u_b"],
+                       params["bias_b"], reverse=True, forget_bias=1.0)
+        h = jnp.concatenate([S.sequence_last_step(hf, batch.lengths),
+                             S.sequence_first_step(hb, batch.lengths)], axis=-1)
+        return self.fc(params["fc"], h)
+
+    def loss(self, params, batch: SeqBatch, labels):
+        return jnp.mean(L.softmax_with_cross_entropy(self(params, batch), labels))
+
+
+class ConvTextCls(nn.Module):
+    """sequence_conv + max pool (networks.py text_conv_pool / CNN quick start)."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 128, num_filters: int = 128,
+                 context_len: int = 3, classes: int = 2):
+        super().__init__()
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        self.context_len = context_len
+        self.param("conv_w", (context_len * embed_dim, num_filters),
+                   uniform(-0.08, 0.08))
+        self.param("conv_b", (num_filters,), zeros)
+        self.fc = nn.Linear(num_filters, classes)
+
+    def __call__(self, params, batch: SeqBatch, **kw):
+        x = self.embed(params["embed"], batch.data)
+        h = S.sequence_conv(x, batch.lengths, params["conv_w"],
+                            context_start=-(self.context_len // 2),
+                            context_length=self.context_len)
+        h = jax.nn.relu(h + params["conv_b"])
+        pooled = S.sequence_pool(h, batch.lengths, "max")
+        return self.fc(params["fc"], pooled)
+
+    def loss(self, params, batch: SeqBatch, labels):
+        return jnp.mean(L.softmax_with_cross_entropy(self(params, batch), labels))

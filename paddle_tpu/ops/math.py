@@ -34,9 +34,10 @@ def mul(x: jax.Array, y: jax.Array, *, x_num_col_dims: int = 1,
         y_num_col_dims: int = 1) -> jax.Array:
     """Flattening matmul (ref: operators/mul_op.cc): collapse x's leading
     ``x_num_col_dims`` dims to rows and the rest to cols, similarly for y."""
+    import math as _math
     xs, ys = x.shape, y.shape
-    xm = x.reshape((int(jnp.prod(jnp.array(xs[:x_num_col_dims]))), -1))
-    ym = y.reshape((int(jnp.prod(jnp.array(ys[:y_num_col_dims]))), -1))
+    xm = x.reshape((_math.prod(xs[:x_num_col_dims]), -1))
+    ym = y.reshape((_math.prod(ys[:y_num_col_dims]), -1))
     out = jnp.matmul(xm, ym)
     return out.reshape(xs[:x_num_col_dims] + ys[y_num_col_dims:])
 

@@ -1,0 +1,157 @@
+"""Pallas TPU kernels for the hot ops XLA doesn't fuse optimally.
+
+The reference hand-writes CUDA for its hot ops (fused LSTM cells
+cuda/src/hl_cuda_lstm.cu, attention-era building blocks); the TPU analog is a
+Pallas kernel that keeps the whole inner loop in VMEM next to the MXU/VPU
+(/opt/skills/guides/pallas_guide.md).
+
+* :func:`flash_attention` — blockwise-softmax attention: Q tiles stream over
+  KV tiles entirely in VMEM; the [T, T] score matrix never touches HBM. This
+  is the single biggest HBM-bandwidth win for long sequences and the kernel
+  under ring attention's per-chip step.
+
+Kernels run with ``interpret=True`` off-TPU so the same code is testable on the
+CPU mesh (tests/test_pallas.py); numerics match the jnp reference path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
+               causal: bool, seq_len: int, true_len: int):
+    """One (batch*head, q-block) program: stream KV tiles, online softmax.
+
+    q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, block_q, D].
+    """
+    _, block_q, d = q_ref.shape
+    qi = pl.program_id(1)
+    q = q_ref[0] * scale
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    n_k = seq_len // block_k
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        valid = k_pos < true_len            # mask padded keys
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_new = acc * corr + pv
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused attention. q/k/v: [B, T, H, D] -> [B, T, H, D].
+
+    T is padded to a block multiple internally; padded keys are masked in the
+    kernel. Differentiable: the VJP recomputes attention via the dense jnp
+    path (a dedicated backward kernel is future work — forward is where the
+    [T, T] HBM blowup lives).
+    """
+    D = q.shape[-1]
+    scale_v = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash(q, k, v, causal, scale_v, block_q, block_k, bool(interpret))
+
+
+def _attention_reference(q, k, v, causal, scale):
+    s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, T, H, D = q.shape
+    import math
+    blk_q = min(block_q, max(8, T))
+    blk_k = min(block_k, max(8, T))
+    # padded length must tile exactly under BOTH block sizes (the kernel
+    # iterates seq_len // block_k tiles)
+    step = math.lcm(blk_q, blk_k)
+    Tp = -(-T // step) * step
+    pad = Tp - T
+
+    # [B, T, H, D] -> [B*H, T, D]
+    def to_bh(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    kernel = functools.partial(_fa_kernel, block_k=blk_k, scale=scale,
+                               causal=causal, seq_len=Tp, true_len=T)
+    grid = (B * H, Tp // blk_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, Tp, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Tp, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+        interpret=interpret,
+    )(qb, kb, vb)
+    out = out[:, :T]
+    return jnp.moveaxis(out.reshape(B, H, T, D), 1, 2)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _attention_reference(q, k, v, causal,
+                                                          scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)

@@ -10,7 +10,7 @@ the rest.
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -95,24 +95,6 @@ def with_sharding_constraint(x, mesh: Mesh, *axes: Optional[str]):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*axes)))
 
 
-# -- path-dict helpers ----------------------------------------------------------
-
-def _flatten_with_paths(tree, prefix: str = "") -> List[Tuple[str, Any]]:
-    out = []
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            out.extend(_flatten_with_paths(v, f"{prefix}/{k}" if prefix else str(k)))
-    else:
-        out.append((prefix, tree))
-    return out
-
-
-def _unflatten_paths(flat: Dict[str, Any]):
-    root: Dict[str, Any] = {}
-    for path, leaf in flat.items():
-        keys = path.split("/")
-        node = root
-        for k in keys[:-1]:
-            node = node.setdefault(k, {})
-        node[keys[-1]] = leaf
-    return root
+# -- path-dict helpers: the canonical codec shared with trainer/checkpoint -----
+from ..core.pytree import flatten_path_tree as _flatten_with_paths  # noqa: E402
+from ..core.pytree import unflatten_path_tree as _unflatten_paths  # noqa: E402

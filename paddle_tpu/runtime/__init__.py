@@ -1,0 +1,21 @@
+"""Native host runtime bindings (ctypes over native/libpaddle_tpu_host.so).
+
+The C++ components the TPU build re-provides natively (SURVEY.md §2 'every
+C++/CUDA/Go row'):
+* :mod:`master`   — task-queue data master (go/master/service.go semantics)
+* :mod:`recordio` — CRC-checked chunked record files (recordio / DataFormat)
+* :mod:`arena`    — host buddy allocator (paddle/memory BuddyAllocator)
+
+The library auto-builds from source on first import when a toolchain is
+available (make -C native), mirroring how the reference builds vendored
+externals at configure time.
+"""
+
+from .lib import load_library, native_available
+from .master import TaskMaster
+from .recordio import RecordReader, RecordWriter
+from .arena import HostArena
+from .optimizer import HostOptimizer
+
+__all__ = ["load_library", "native_available", "TaskMaster",
+           "RecordReader", "RecordWriter", "HostArena", "HostOptimizer"]

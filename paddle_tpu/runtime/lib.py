@@ -1,0 +1,84 @@
+"""Load (building if needed) the native host-runtime library."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO = os.path.join(_NATIVE_DIR, "libpaddle_tpu_host.so")
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-j4"],
+                               check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        _configure(lib)
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def _configure(lib: ctypes.CDLL):
+    c = ctypes
+    # task master
+    lib.ptm_create.restype = c.c_void_p
+    lib.ptm_create.argtypes = [c.c_double, c.c_int]
+    lib.ptm_destroy.argtypes = [c.c_void_p]
+    lib.ptm_set_dataset.argtypes = [c.c_void_p, c.POINTER(c.c_char_p), c.c_int]
+    lib.ptm_get_task.restype = c.c_int
+    lib.ptm_get_task.argtypes = [c.c_void_p, c.c_double, c.c_char_p, c.c_int]
+    lib.ptm_task_finished.argtypes = [c.c_void_p, c.c_int]
+    lib.ptm_new_pass.restype = c.c_int
+    lib.ptm_new_pass.argtypes = [c.c_void_p]
+    lib.ptm_task_failed.argtypes = [c.c_void_p, c.c_int]
+    lib.ptm_tick.restype = c.c_int
+    lib.ptm_tick.argtypes = [c.c_void_p, c.c_double]
+    lib.ptm_stats.argtypes = [c.c_void_p] + [c.POINTER(c.c_int)] * 5
+    lib.ptm_snapshot.restype = c.c_int
+    lib.ptm_snapshot.argtypes = [c.c_void_p, c.c_char_p]
+    lib.ptm_restore.restype = c.c_int
+    lib.ptm_restore.argtypes = [c.c_void_p, c.c_char_p]
+    # recordio
+    lib.ptr_writer_open.restype = c.c_void_p
+    lib.ptr_writer_open.argtypes = [c.c_char_p]
+    lib.ptr_writer_write.restype = c.c_int
+    lib.ptr_writer_write.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.ptr_writer_close.restype = c.c_int64
+    lib.ptr_writer_close.argtypes = [c.c_void_p]
+    lib.ptr_reader_open.restype = c.c_void_p
+    lib.ptr_reader_open.argtypes = [c.c_char_p]
+    lib.ptr_reader_next.restype = c.c_int
+    lib.ptr_reader_next.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.ptr_reader_close.argtypes = [c.c_void_p]
+    # arena
+    lib.pta_create.restype = c.c_void_p
+    lib.pta_create.argtypes = [c.c_uint64, c.c_uint64]
+    lib.pta_destroy.argtypes = [c.c_void_p]
+    lib.pta_alloc.restype = c.c_uint64
+    lib.pta_alloc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pta_free.restype = c.c_int
+    lib.pta_free.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pta_stats.argtypes = [c.c_void_p] + [c.POINTER(c.c_uint64)] * 3

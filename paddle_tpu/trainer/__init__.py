@@ -1,0 +1,20 @@
+"""Training driver: events, evaluators, checkpoints, pass/batch loop.
+
+The merged analog of paddle/trainer (C++ driver) and python/paddle/v2/trainer.py
+(events API) — see trainer.py for the mapping.
+"""
+
+from . import event
+from .checkpoint import (from_tar, latest_pass, load_checkpoint, pass_dir,
+                         save_checkpoint, to_tar)
+from .evaluator import (AucEvaluator, ChunkEvaluator,
+                        ClassificationErrorEvaluator, Evaluator,
+                        EvaluatorGroup, PrecisionRecallEvaluator, SumEvaluator)
+from .trainer import Trainer
+
+__all__ = ["Trainer", "event",
+           "Evaluator", "EvaluatorGroup", "ClassificationErrorEvaluator",
+           "SumEvaluator", "AucEvaluator", "PrecisionRecallEvaluator",
+           "ChunkEvaluator",
+           "to_tar", "from_tar", "save_checkpoint", "load_checkpoint",
+           "latest_pass", "pass_dir"]

@@ -1,0 +1,144 @@
+"""Checkpoint / resume.
+
+Capability parity with the reference (SURVEY.md §5 'Checkpoint / resume'):
+* per-pass directories ``output/pass-%05d`` (trainer/ParamUtil.cpp:50-67)
+* tar parameter archives with versioned headers (v2/parameters.py:296-358
+  to_tar/from_tar; parameter/Parameter.cpp save/load)
+* resume via ``--init_model_path`` / ``--start_pass`` -> :func:`latest_pass` +
+  :func:`load_checkpoint`
+* CRC-checked payloads like the Go pserver checkpoints (go/pserver/service.go:119-126).
+
+Format: a real tarfile, one ``.npy`` member per parameter path plus a JSON
+``__meta__`` member carrying {version, crc32 per member, pytree paths}; works for
+any params/optimizer-state pytree.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import tarfile
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core.pytree import flatten_path_tree, unflatten_path_tree
+
+FORMAT_VERSION = 1
+_META = "__meta__.json"
+
+
+def _flatten(tree) -> Dict[str, np.ndarray]:
+    return {path: np.asarray(jax.device_get(leaf))
+            for path, leaf in flatten_path_tree(tree)}
+
+
+def _unflatten(flat: Dict[str, np.ndarray]):
+    return unflatten_path_tree(flat)
+
+
+# -- tar serialization ----------------------------------------------------------
+
+def to_tar(f, params) -> None:
+    """Serialize a params pytree into an open binary file object (v2
+    parameters.to_tar analog, with CRC32 like go pserver checkpoints)."""
+    flat = _flatten(params)
+    meta = {"version": FORMAT_VERSION, "crc32": {}, "order": list(flat)}
+    with tarfile.open(fileobj=f, mode="w") as tar:
+        for path, arr in flat.items():
+            buf = io.BytesIO()
+            np.save(buf, arr)
+            payload = buf.getvalue()
+            meta["crc32"][path] = zlib.crc32(payload) & 0xFFFFFFFF
+            info = tarfile.TarInfo(name=path.replace("/", "%2F") + ".npy")
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+        mb = json.dumps(meta).encode()
+        info = tarfile.TarInfo(name=_META)
+        info.size = len(mb)
+        tar.addfile(info, io.BytesIO(mb))
+
+
+def from_tar(f):
+    """Load a params pytree; verifies version + CRC (Parameter.cpp load +
+    go/pserver/service.go:156-201 load-with-checksum analog)."""
+    with tarfile.open(fileobj=f, mode="r") as tar:
+        meta_m = tar.extractfile(_META)
+        if meta_m is None:
+            raise ValueError("checkpoint missing metadata member")
+        meta = json.loads(meta_m.read().decode())
+        if meta.get("version") != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {meta.get('version')}")
+        flat = {}
+        for member in tar.getmembers():
+            if member.name == _META:
+                continue
+            path = member.name[:-len(".npy")].replace("%2F", "/")
+            payload = tar.extractfile(member).read()
+            want = meta["crc32"].get(path)
+            got = zlib.crc32(payload) & 0xFFFFFFFF
+            if want is not None and got != want:
+                raise ValueError(f"CRC mismatch for {path}: {got} != {want}")
+            flat[path] = np.load(io.BytesIO(payload), allow_pickle=False)
+    return _unflatten(flat)
+
+
+# -- pass directories -----------------------------------------------------------
+
+def pass_dir(output_dir: str, pass_id: int) -> str:
+    """output/pass-%05d naming (ParamUtil.cpp:56)."""
+    return os.path.join(output_dir, f"pass-{pass_id:05d}")
+
+
+def save_checkpoint(output_dir: str, pass_id: int, params,
+                    opt_state=None, extra: Optional[Dict[str, Any]] = None) -> str:
+    d = pass_dir(output_dir, pass_id)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "params.tar"), "wb") as f:
+        to_tar(f, params)
+    if opt_state is not None:
+        with open(os.path.join(d, "opt_state.tar"), "wb") as f:
+            to_tar(f, opt_state)
+    state = {"pass_id": pass_id, "version": FORMAT_VERSION}
+    if extra:
+        state.update(extra)
+    with open(os.path.join(d, "state.json"), "w") as f:
+        json.dump(state, f)
+    return d
+
+
+def load_checkpoint(output_dir: str, pass_id: Optional[int] = None
+                    ) -> Tuple[Any, Optional[Any], Dict[str, Any]]:
+    """Load (params, opt_state_or_None, state). pass_id None -> latest."""
+    if pass_id is None:
+        pass_id = latest_pass(output_dir)
+        if pass_id is None:
+            raise FileNotFoundError(f"no checkpoints under {output_dir}")
+    d = pass_dir(output_dir, pass_id)
+    with open(os.path.join(d, "params.tar"), "rb") as f:
+        params = from_tar(f)
+    opt_state = None
+    op = os.path.join(d, "opt_state.tar")
+    if os.path.exists(op):
+        with open(op, "rb") as f:
+            opt_state = from_tar(f)
+    with open(os.path.join(d, "state.json")) as f:
+        state = json.load(f)
+    return params, opt_state, state
+
+
+def latest_pass(output_dir: str) -> Optional[int]:
+    """Largest pass-%05d with a complete params.tar (resume point — the
+    --start_pass discovery, ParamUtil.h:108-111)."""
+    if not os.path.isdir(output_dir):
+        return None
+    best = None
+    for name in os.listdir(output_dir):
+        m = re.fullmatch(r"pass-(\d{5})", name)
+        if m and os.path.exists(os.path.join(output_dir, name, "params.tar")):
+            best = max(best if best is not None else -1, int(m.group(1)))
+    return best

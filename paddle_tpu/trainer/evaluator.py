@@ -1,0 +1,186 @@
+"""Streaming evaluators — the gserver Evaluator zoo re-provided.
+
+Reference: abstract Evaluator with start/eval/finish accumulation
+(gserver/evaluators/Evaluator.h:42; registry Evaluator.cpp:172-1357:
+classification_error, sum, rank-AUC, precision-recall, chunk NER-F1, CTC error).
+
+TPU-native: each evaluator owns small host-side accumulators; the per-batch
+statistics are computed on device by ops/metrics.py (jit-fusable alongside the
+train step) and merged here. ``result()`` returns a dict for events/logging.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import metrics as M
+
+
+class Evaluator:
+    name = "evaluator"
+
+    def start(self):
+        raise NotImplementedError
+
+    def update(self, **batch_outputs):
+        raise NotImplementedError
+
+    def result(self) -> Dict[str, float]:
+        raise NotImplementedError
+
+
+class ClassificationErrorEvaluator(Evaluator):
+    """Error-rate (1 - accuracy), the default classification metric
+    (Evaluator.cpp ClassificationErrorEvaluator)."""
+
+    name = "classification_error"
+
+    def __init__(self):
+        self.start()
+
+    def start(self):
+        self.wrong = 0.0
+        self.total = 0.0
+
+    def update(self, logits=None, labels=None, correct=None, count=None, **_):
+        if correct is None:
+            correct, count = M.accuracy(logits, labels)
+        self.wrong += float(count) - float(correct)
+        self.total += float(count)
+
+    def result(self):
+        err = self.wrong / max(self.total, 1.0)
+        return {"classification_error": err, "accuracy": 1.0 - err}
+
+
+class SumEvaluator(Evaluator):
+    """Accumulate a scalar (cost) across batches (Evaluator.cpp SumEvaluator)."""
+
+    name = "sum"
+
+    def __init__(self, key: str = "cost"):
+        self.key = key
+        self.start()
+
+    def start(self):
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, **kw):
+        v = kw.get(self.key)
+        if v is not None:
+            self.total += float(v)
+            self.count += 1
+
+    def result(self):
+        return {f"avg_{self.key}": self.total / max(self.count, 1),
+                f"sum_{self.key}": self.total}
+
+
+class AucEvaluator(Evaluator):
+    """Rank-AUC via fixed-threshold histograms (AucEvaluator analog) — the
+    histogram update runs on device (ops/metrics.py:auc_histogram)."""
+
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.n = num_thresholds
+        self.start()
+
+    def start(self):
+        self.pos = np.zeros(self.n, np.float64)
+        self.neg = np.zeros(self.n, np.float64)
+
+    def update(self, probs=None, labels=None, **_):
+        p, n = M.auc_histogram(probs, labels, self.n)
+        self.pos += np.asarray(p, np.float64)
+        self.neg += np.asarray(n, np.float64)
+
+    def result(self):
+        auc = M.auc_from_histogram(jnp.asarray(self.pos), jnp.asarray(self.neg))
+        return {"auc": float(auc)}
+
+
+class PrecisionRecallEvaluator(Evaluator):
+    """Per-class and macro precision/recall/F1 (PrecisionRecallEvaluator)."""
+
+    name = "precision_recall"
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.start()
+
+    def start(self):
+        self.tp = np.zeros(self.num_classes, np.float64)
+        self.fp = np.zeros(self.num_classes, np.float64)
+        self.fn = np.zeros(self.num_classes, np.float64)
+
+    def update(self, pred=None, labels=None, **_):
+        counts = np.asarray(M.precision_recall_counts(pred, labels,
+                                                      self.num_classes), np.float64)
+        self.tp += counts[:, 0]
+        self.fp += counts[:, 1]
+        self.fn += counts[:, 2]
+
+    def result(self):
+        prec = self.tp / np.maximum(self.tp + self.fp, 1.0)
+        rec = self.tp / np.maximum(self.tp + self.fn, 1.0)
+        f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+        return {"macro_precision": float(prec.mean()),
+                "macro_recall": float(rec.mean()),
+                "macro_f1": float(f1.mean())}
+
+
+class ChunkEvaluator(Evaluator):
+    """Chunk (NER) F1 over IOB tags (ChunkEvaluator.cpp analog)."""
+
+    name = "chunk"
+
+    def __init__(self, num_tag_types: int, scheme: str = "IOB"):
+        self.num_tag_types = num_tag_types
+        self.scheme = scheme
+        self.start()
+
+    def start(self):
+        self.n_pred = 0.0
+        self.n_label = 0.0
+        self.n_correct = 0.0
+
+    def update(self, pred_tags=None, label_tags=None, lengths=None, **_):
+        nc, np_, nl = M.chunk_count(pred_tags, label_tags, lengths,
+                                    scheme=self.scheme,
+                                    num_chunk_types=self.num_tag_types)
+        self.n_pred += float(np_)
+        self.n_label += float(nl)
+        self.n_correct += float(nc)
+
+    def result(self):
+        p = self.n_correct / max(self.n_pred, 1.0)
+        r = self.n_correct / max(self.n_label, 1.0)
+        f1 = 2 * p * r / max(p + r, 1e-12)
+        return {"chunk_precision": p, "chunk_recall": r, "chunk_f1": f1}
+
+
+class EvaluatorGroup:
+    """Evaluator composition the way NeuralNetwork combines them
+    (gserver combined evaluator): start/update/result fan out."""
+
+    def __init__(self, *evaluators: Evaluator):
+        self.evaluators = list(evaluators)
+
+    def start(self):
+        for e in self.evaluators:
+            e.start()
+
+    def update(self, **kw):
+        for e in self.evaluators:
+            e.update(**kw)
+
+    def result(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for e in self.evaluators:
+            out.update(e.result())
+        return out

@@ -1,0 +1,249 @@
+"""SGD training driver.
+
+Re-provides the reference's two drivers as one:
+* C++ Trainer: pass/batch loops, evaluator wiring, testing, gradient check,
+  per-pass checkpoints (trainer/Trainer.cpp:265, TrainerInternal.cpp:66-172,
+  Tester.cpp, ParamUtil.cpp:50-67, --job=train/test/checkgrad/time
+  TrainerMain.cpp:54).
+* Python v2 SGD: events to user callbacks, reader-driven batches
+  (v2/trainer.py:124-202).
+
+TPU-native: the batch step is ONE jitted function (forward+backward+update fused
+by XLA; the reference's per-parameter update callback pipelining,
+TrainerInternal.cpp:70-73, is recovered by XLA's latency-hiding scheduler); data
+parallelism is the SPMD mesh (parallel/data_parallel.py), not trainer threads;
+host-side prep overlaps via DoubleBuffer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.prefetch import DoubleBuffer
+from ..parallel.data_parallel import DataParallel
+from ..utils.logging import get_logger
+from ..utils.stats import StatSet
+from . import event as EV
+from .checkpoint import latest_pass, load_checkpoint, save_checkpoint
+from .evaluator import EvaluatorGroup
+
+log = get_logger(__name__)
+
+
+class Trainer:
+    """Drive (loss_fn, optimizer) over reader batches with events/evaluators.
+
+    Args:
+      loss_fn: (params, *batch) -> scalar loss.
+      optimizer: paddle_tpu optimizer.
+      mesh: optional jax Mesh -> SPMD data-parallel step over its 'data' axis.
+      outputs_fn: optional (params, *batch) -> dict of device metrics handed to
+        evaluators (e.g. {'logits':..., 'labels':...}).
+      evaluators: EvaluatorGroup or list of Evaluators.
+      output_dir: if set, save pass-%05d checkpoints (ParamUtil semantics).
+    """
+
+    def __init__(self, loss_fn: Callable, optimizer, *, mesh=None,
+                 outputs_fn: Optional[Callable] = None,
+                 evaluators=None, output_dir: Optional[str] = None,
+                 prefetch: int = 2, log_period: int = 0):
+        self.loss_fn = loss_fn
+        self.opt = optimizer
+        self.outputs_fn = jax.jit(outputs_fn) if outputs_fn is not None else None
+        if evaluators is None:
+            self.evaluators = EvaluatorGroup()
+        elif isinstance(evaluators, EvaluatorGroup):
+            self.evaluators = evaluators
+        else:
+            self.evaluators = EvaluatorGroup(*evaluators)
+        self.output_dir = output_dir
+        self.prefetch = prefetch
+        self.log_period = log_period
+        self.stats = StatSet()
+        self.mesh = mesh
+        if mesh is not None:
+            self._dp = DataParallel(loss_fn, optimizer, mesh=mesh)
+            self._step = None
+        else:
+            self._dp = None
+
+            def _step(params, opt_state, *batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+                params, opt_state = optimizer.update(grads, opt_state, params)
+                return params, opt_state, loss
+
+            self._step = jax.jit(_step, donate_argnums=(0, 1))
+        self._loss_jit = jax.jit(loss_fn)
+
+    # ------------------------------------------------------------------ train
+    def train(self, reader: Callable[[], Iterable], params, *,
+              num_passes: int = 1, event_handler: Optional[Callable] = None,
+              feeder: Optional[Callable] = None,
+              test_reader: Optional[Callable] = None,
+              resume: bool = False):
+        """Run the pass/batch loop; returns (params, opt_state).
+
+        reader yields raw row-batches; ``feeder`` converts one row-batch to the
+        loss_fn's *batch arrays (identity if None).
+        """
+        event_handler = event_handler or (lambda e: None)
+        start_pass = 0
+        opt_state = None
+        if resume and self.output_dir and latest_pass(self.output_dir) is not None:
+            params, opt_state, st = load_checkpoint(self.output_dir)
+            start_pass = st["pass_id"] + 1
+            log.info("resumed from pass %d", st["pass_id"])
+        if opt_state is None:
+            if self._dp is not None:
+                params, opt_state = self._dp.init(params)
+            else:
+                opt_state = self.opt.init(params)
+        elif self._dp is not None:
+            params, opt_state = self._dp.init(params, opt_state)
+
+        for pass_id in range(start_pass, start_pass + num_passes):
+            event_handler(EV.BeginPass(pass_id))
+            self.evaluators.start()
+            batches = self._batches(reader, feeder)
+            for batch_id, batch in enumerate(batches):
+                event_handler(EV.BeginIteration(pass_id, batch_id))
+                with self.stats.timer("TrainBatch"):
+                    if self._dp is not None:
+                        batch = self._dp.shard_batch(batch)
+                        params, opt_state, cost = self._dp.step(params, opt_state,
+                                                                *batch)
+                    else:
+                        params, opt_state, cost = self._step(params, opt_state,
+                                                             *batch)
+                ev_result = None
+                if self.outputs_fn is not None:
+                    with self.stats.timer("Eval"):
+                        outs = self.outputs_fn(params, *batch)
+                        self.evaluators.update(cost=float(cost), **outs)
+                        ev_result = self.evaluators.result()
+                cost_f = float(cost)
+                if self.log_period and (batch_id + 1) % self.log_period == 0:
+                    log.info("pass %d batch %d cost %.6f", pass_id, batch_id, cost_f)
+                event_handler(EV.EndIteration(pass_id, batch_id, cost_f,
+                                              ev_result))
+            pass_result = (self.evaluators.result()
+                           if self.outputs_fn is not None else None)
+            if test_reader is not None:
+                tr = self.test(test_reader, params, feeder=feeder)
+                event_handler(EV.TestResult(pass_id, tr["cost"],
+                                            tr.get("evaluator_result")))
+            if self.output_dir:
+                save_checkpoint(self.output_dir, pass_id, params, opt_state)
+            event_handler(EV.EndPass(pass_id, pass_result))
+        return params, opt_state
+
+    def _batches(self, reader, feeder):
+        if feeder is None:
+            return iter(reader())
+        return iter(DoubleBuffer(reader, depth=self.prefetch, transform=feeder))
+
+    # ------------------------------------------------------------------- test
+    def test(self, reader, params, *, feeder=None) -> Dict[str, Any]:
+        """Average cost (+ evaluator results) over a test reader (Tester.cpp)."""
+        total, n = 0.0, 0
+        self.evaluators.start()
+        for batch in self._batches(reader, feeder):
+            cost = self._loss_jit(params, *batch)
+            total += float(cost)
+            n += 1
+            if self.outputs_fn is not None:
+                outs = self.outputs_fn(params, *batch)
+                self.evaluators.update(cost=float(cost), **outs)
+        out: Dict[str, Any] = {"cost": total / max(n, 1)}
+        if self.outputs_fn is not None:
+            out["evaluator_result"] = self.evaluators.result()
+        return out
+
+    # -------------------------------------------------------------- checkgrad
+    def check_gradient(self, params, batch: Tuple, *, eps: float = 1e-3,
+                       rtol: float = 5e-2, max_checks_per_param: int = 5,
+                       seed: int = 0) -> bool:
+        """Central-difference gradient check (--job=checkgrad,
+        Trainer.h:84; LayerGradUtil perturbation semantics, SURVEY §4.1).
+        Runs in float64 (enable_x64) — float32 losses don't resolve the
+        perturbation; returns True when analytic and numeric agree."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def enable_x64():
+            prev = jax.config.jax_enable_x64
+            jax.config.update("jax_enable_x64", True)
+            try:
+                yield
+            finally:
+                jax.config.update("jax_enable_x64", prev)
+
+        def to64(x):
+            x = np.asarray(jax.device_get(x))
+            return x.astype(np.float64) if np.issubdtype(x.dtype, np.floating) else x
+
+        with enable_x64():
+            params64 = jax.tree_util.tree_map(to64, params)
+            batch64 = jax.tree_util.tree_map(to64, batch)
+            loss64 = jax.jit(self.loss_fn)
+            grads = jax.jit(jax.grad(self.loss_fn))(params64, *batch64)
+            leaves, treedef = jax.tree_util.tree_flatten(params64)
+            gleaves = jax.tree_util.tree_leaves(grads)
+            rs = np.random.RandomState(seed)
+            ok = True
+            for li, (p, g) in enumerate(zip(leaves, gleaves)):
+                p_host = np.asarray(jax.device_get(p), np.float64)
+                flat = p_host.reshape(-1)
+                n_checks = min(max_checks_per_param, flat.size)
+                for idx in rs.choice(flat.size, size=n_checks, replace=False):
+                    orig = flat[idx]
+                    vals = {}
+                    for sign in (+1, -1):
+                        flat[idx] = orig + sign * eps
+                        leaves2 = list(leaves)
+                        leaves2[li] = jnp.asarray(p_host)
+                        vals[sign] = float(loss64(
+                            jax.tree_util.tree_unflatten(treedef, leaves2),
+                            *batch64))
+                    flat[idx] = orig
+                    numeric = (vals[+1] - vals[-1]) / (2 * eps)
+                    analytic = float(np.asarray(jax.device_get(g)).reshape(-1)[idx])
+                    denom = max(abs(numeric), abs(analytic), 1e-6)
+                    if abs(numeric - analytic) / denom > rtol:
+                        log.warning("checkgrad mismatch leaf %d idx %d: "
+                                    "numeric %.6g analytic %.6g", li, idx,
+                                    numeric, analytic)
+                        ok = False
+        return ok
+
+    # ------------------------------------------------------------------- time
+    def benchmark(self, reader, params, *, feeder=None, warmup: int = 3,
+                  iters: int = 20) -> Dict[str, float]:
+        """--job=time analog (TrainerBenchmark.cpp): steady-state ms/batch."""
+        opt_state = self.opt.init(params) if self._dp is None else None
+        if self._dp is not None:
+            params, opt_state = self._dp.init(params)
+        batches = list(self._batches(reader, feeder))
+        if not batches:
+            raise ValueError("empty reader")
+        step = (self._step if self._dp is None
+                else lambda p, s, *b: self._dp.step(p, s, *b))
+        i = 0
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state,
+                                           *batches[i % len(batches)])
+            i += 1
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state,
+                                           *batches[i % len(batches)])
+            i += 1
+        jax.block_until_ready(loss)
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        return {"ms_per_batch": ms}

@@ -1,0 +1,114 @@
+"""Data-path tests (SURVEY.md §4.5: reader decorators, datasets, feeder)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import data as pdata
+from paddle_tpu.core import SeqBatch
+from paddle_tpu.data import (DataFeeder, DenseSlot, DoubleBuffer, IndexSlot,
+                             SeqSlot, SparseSlot, batch, buffered, chain,
+                             compose, firstn, map_readers, shuffle, xmap_readers)
+from paddle_tpu.data.dataset import (cifar, conll05, criteo, imdb, imikolov,
+                                     mnist, movielens, mq2007, uci_housing,
+                                     wmt14)
+
+
+def _r(xs):
+    return lambda: iter(xs)
+
+
+def test_reader_decorators():
+    assert list(map_readers(lambda a, b: a + b, _r([1, 2]), _r([10, 20]))()) == [11, 22]
+    assert sorted(shuffle(_r(range(10)), 4, seed=0)()) == list(range(10))
+    assert list(chain(_r([1]), _r([2, 3]))()) == [1, 2, 3]
+    assert list(compose(_r([1, 2]), _r([(3, 4), (5, 6)]))()) == [(1, 3, 4), (2, 5, 6)]
+    assert list(buffered(_r(range(5)), 2)()) == list(range(5))
+    assert list(firstn(_r(range(100)), 3)()) == [0, 1, 2]
+    got = sorted(xmap_readers(lambda x: x * 2, _r(range(8)), 3, 4)())
+    assert got == [0, 2, 4, 6, 8, 10, 12, 14]
+    got = list(xmap_readers(lambda x: x * 2, _r(range(8)), 3, 4, order=True)())
+    assert got == [0, 2, 4, 6, 8, 10, 12, 14]
+    bs = list(batch(_r(range(7)), 3)())
+    assert bs == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(batch(_r(range(7)), 3, drop_last=True)()) == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_compose_misaligned_raises():
+    with pytest.raises(ValueError):
+        list(compose(_r([1]), _r([1, 2]))())
+
+
+def test_buffered_propagates_errors():
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+    with pytest.raises(RuntimeError):
+        list(buffered(lambda: bad(), 2)())
+
+
+def test_feeder_dense_index_seq_sparse():
+    feeder = DataFeeder([DenseSlot(3), IndexSlot(), SeqSlot(),
+                         SparseSlot(100)])
+    rows = [
+        (np.ones(3), 1, [1, 2, 3], [4, 7]),
+        (np.zeros(3), 0, [5], [9]),
+    ]
+    dense, idx, seq, (sp_ids, sp_vals) = feeder.feed(rows)
+    assert dense.shape == (2, 3)
+    assert idx.shape == (2,) and int(idx[0]) == 1
+    assert isinstance(seq, SeqBatch)
+    assert seq.data.shape[0] == 2 and int(seq.lengths[0]) == 3
+    assert sp_ids.shape == sp_vals.shape and sp_ids.shape[0] == 2
+    np.testing.assert_allclose(np.asarray(sp_vals[0])[:2], [1.0, 1.0])
+
+
+def test_feeder_nested_seq():
+    feeder = DataFeeder([SeqSlot(nested=True)])
+    rows = [([[1, 2], [3]],), ([[4]],)]
+    (sb,) = feeder.feed(rows)
+    assert sb.lod == ((0, 2, 3), (0, 1))
+    assert int(sb.lengths[0]) == 3
+
+
+def test_double_buffer_order_and_errors():
+    out = list(DoubleBuffer(lambda: iter(range(10)), depth=3))
+    assert out == list(range(10))
+    def bad():
+        yield 1
+        raise ValueError("x")
+    with pytest.raises(ValueError):
+        list(DoubleBuffer(lambda: bad()))
+
+
+@pytest.mark.parametrize("ds,checks", [
+    (mnist, lambda s: (len(s[0]) == 784, 0 <= s[1] < 10)),
+    (uci_housing, lambda s: (len(s[0]) == 13, len(s[1]) == 1)),
+])
+def test_dense_datasets(ds, checks):
+    samples = list(firstn(ds.train(64), 5)())
+    assert len(samples) == 5
+    for s in samples:
+        assert all(checks(s))
+    # deterministic
+    again = list(firstn(ds.train(64), 5)())
+    np.testing.assert_allclose(again[0][0], samples[0][0])
+
+
+def test_seq_datasets_schema():
+    for ids, label in firstn(imdb.train(16), 4)():
+        assert all(0 <= i < imdb.VOCAB for i in ids) and label in (0, 1)
+    for tup in firstn(imikolov.train(16), 4)():
+        assert len(tup) == 5
+    for src, tin, tout in firstn(wmt14.train(16), 4)():
+        assert len(tin) == len(tout) == len(src) + 1
+        assert tin[0] == wmt14.START and tout[-1] == wmt14.END
+    for words, tags in firstn(conll05.train(16), 4)():
+        assert len(words) == len(tags)
+    for u, g, a, j, m, cats, r in firstn(movielens.train(16), 4)():
+        assert 1.0 <= r <= 5.0 and len(cats) >= 1
+    for q, x, rel in firstn(mq2007.train(4), 4)():
+        assert x.shape == (46,) and rel in (0, 1, 2)
+    for dense, ids, y in firstn(criteo.train(16), 4)():
+        assert len(dense) == 13 and len(ids) == 26 and y in (0, 1)
+    for img, label in firstn(cifar.train10(8), 2)():
+        assert len(img) == 3072

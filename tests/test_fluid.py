@@ -1,0 +1,146 @@
+"""Fluid (Program IR + Executor) tests — the book-test shapes of
+fluid/tests/book/test_recognize_digits_mlp.py and fit_a_line, plus IR
+round-trip and executable-cache behavior."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.data.dataset import mnist, uci_housing
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    # fresh scope per test
+    fluid.executor._global_scope = fluid.Scope()
+    yield
+
+
+def _run_startup(exe):
+    exe.run(fluid.default_startup_program())
+
+
+def test_fit_a_line():
+    """fluid/tests/book/test_fit_a_line.py analog: linear regression to low loss."""
+    x = fluid.layers.data("x", shape=(13,))
+    y = fluid.layers.data("y", shape=(1,))
+    pred = fluid.layers.fc(x, 1)
+    b = fluid.default_main_program().global_block()
+    diff = fluid.layers.elementwise_sub(pred, y)
+    sq = fluid.layers.elementwise_mul(diff, diff)
+    loss = fluid.layers.mean(sq)
+    fluid.SGDOptimizer(0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    _run_startup(exe)
+    data = list(uci_housing.train(256)())
+    xs = np.stack([d[0] for d in data])
+    ys = np.stack([d[1] for d in data])
+    first = None
+    for i in range(50):
+        out, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        if first is None:
+            first = float(out)
+    assert float(out) < first * 0.5
+
+
+def test_recognize_digits_mlp():
+    """MNIST MLP book test: train to decreasing loss with Adam + accuracy."""
+    img = fluid.layers.data("img", shape=(784,))
+    label = fluid.layers.data("label", shape=(), dtype="int32")
+    h1 = fluid.layers.fc(img, 128, act="relu")
+    h2 = fluid.layers.fc(h1, 64, act="relu")
+    logits = fluid.layers.fc(h2, 10)
+    loss_vec = fluid.layers.softmax_with_cross_entropy(logits, label)
+    loss = fluid.layers.mean(loss_vec)
+    acc = fluid.layers.accuracy(logits, label)
+    fluid.AdamOptimizer(1e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    _run_startup(exe)
+    data = list(mnist.train(512)())
+    xs = np.stack([d[0] for d in data])
+    ys = np.array([d[1] for d in data], np.int32)
+    costs = []
+    for i in range(30):
+        c, a = exe.run(feed={"img": xs, "label": ys},
+                       fetch_list=[loss, acc])
+        costs.append(float(c))
+    assert costs[-1] < costs[0] * 0.5
+    assert float(a) > 0.5
+
+
+def test_executable_cache_reused():
+    x = fluid.layers.data("x", shape=(4,))
+    out = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    _run_startup(exe)
+    exe.run(feed={"x": np.ones((3, 4), np.float32)}, fetch_list=[out])
+    n1 = len(exe._cache)
+    exe.run(feed={"x": np.zeros((3, 4), np.float32)}, fetch_list=[out])
+    assert len(exe._cache) == n1          # same shapes -> cache hit
+    exe.run(feed={"x": np.ones((5, 4), np.float32)}, fetch_list=[out])
+    assert len(exe._cache) == n1 + 1      # new batch shape -> new executable
+
+
+def test_program_serialization_roundtrip():
+    x = fluid.layers.data("x", shape=(4,))
+    h = fluid.layers.fc(x, 8, act="tanh")
+    out = fluid.layers.fc(h, 2)
+    prog = fluid.default_main_program()
+    d = prog.to_dict()
+    import json
+    d2 = json.loads(json.dumps(d, default=str))
+    back = fluid.Program.from_dict(d)
+    assert len(back.global_block().ops) == len(prog.global_block().ops)
+    assert set(back.global_block().vars) == set(prog.global_block().vars)
+
+
+def test_prune_drops_dead_ops():
+    x = fluid.layers.data("x", shape=(4,))
+    used = fluid.layers.fc(x, 2)
+    dead = fluid.layers.fc(x, 3)   # never fetched
+    prog = fluid.default_main_program()
+    pruned = prog.prune([used.name])
+    kept_types = [op.type for op in pruned.global_block().ops]
+    assert len(pruned.global_block().ops) < len(prog.global_block().ops)
+    # the dead fc's mul op must be gone
+    dead_inputs = {n for op in prog.global_block().ops
+                   if dead.name in op.output_vars() for n in op.input_vars()}
+    for op in pruned.global_block().ops:
+        assert dead.name not in op.output_vars()
+
+
+def test_momentum_optimizer_runs():
+    x = fluid.layers.data("x", shape=(4,))
+    y = fluid.layers.data("y", shape=(1,))
+    pred = fluid.layers.fc(x, 1)
+    diff = fluid.layers.elementwise_sub(pred, y)
+    loss = fluid.layers.mean(fluid.layers.elementwise_mul(diff, diff))
+    fluid.MomentumOptimizer(0.01, momentum=0.9).minimize(loss)
+    exe = fluid.Executor()
+    _run_startup(exe)
+    rs = np.random.RandomState(0)
+    xs = rs.randn(32, 4).astype(np.float32)
+    ys = (xs @ rs.randn(4, 1)).astype(np.float32)
+    c0 = float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+    for _ in range(30):
+        c = float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+    assert c < c0 * 0.5
+
+
+def test_save_load_persistables(tmp_path):
+    x = fluid.layers.data("x", shape=(4,))
+    out = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    _run_startup(exe)
+    r1 = exe.run(feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[out])[0]
+    fluid.io.save_persistables(exe, str(tmp_path))
+    # clobber the scope, reload, same output
+    fluid.executor._global_scope = fluid.Scope()
+    exe2 = fluid.Executor()
+    fluid.io.load_persistables(exe2, str(tmp_path))
+    r2 = exe2.run(fluid.default_main_program(),
+                  feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[out])[0]
+    np.testing.assert_allclose(r1, r2, rtol=1e-6)

@@ -1,0 +1,168 @@
+"""End-to-end model tests — the 'book' acceptance suite.
+
+Each test trains a tiny config on its synthetic dataset until the loss clearly
+drops (the reference trains to a loss threshold then exits —
+fluid/tests/book/test_recognize_digits_mlp.py:67-68; SURVEY.md §4.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.data import (DataFeeder, DenseSlot, IndexSlot, SeqSlot,
+                             SparseSlot, batch)
+from paddle_tpu.data.dataset import (conll05, criteo, imdb, imikolov, mnist,
+                                     movielens, wmt14)
+from paddle_tpu.models import (AttentionSeq2Seq, BiLSTMCRFTagger, ConvTextCls,
+                               DeepFM, LeNet, LSTMTextCls, Recommender, ResNet,
+                               VGG, Word2Vec)
+from paddle_tpu.optimizer import Adam
+
+
+def _train(loss_fn, params, batches, lr=1e-2, passes=1):
+    opt = Adam(lr)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, *b):
+        l, g = jax.value_and_grad(loss_fn)(params, *b)
+        params, state = opt.update(g, state, params)
+        return params, state, l
+
+    costs = []
+    for _ in range(passes):
+        for b in batches:
+            params, state, l = step(params, state, *b)
+            costs.append(float(l))
+    return params, costs
+
+
+def test_lstm_text_cls_learns():
+    model = LSTMTextCls(imdb.VOCAB, embed_dim=32, hidden=32)
+    feeder = DataFeeder([SeqSlot(), IndexSlot()])
+    batches = [feeder.feed(rows) for rows in batch(imdb.train(256), 32)()]
+    params = model.init(jax.random.PRNGKey(0))
+    params, costs = _train(model.loss, params, batches, passes=3)
+    assert costs[-1] < costs[0] * 0.7
+
+
+def test_conv_text_cls_learns():
+    model = ConvTextCls(imdb.VOCAB, embed_dim=32, num_filters=32)
+    feeder = DataFeeder([SeqSlot(), IndexSlot()])
+    batches = [feeder.feed(rows) for rows in batch(imdb.train(256), 32)()]
+    params = model.init(jax.random.PRNGKey(0))
+    params, costs = _train(model.loss, params, batches, passes=3)
+    assert costs[-1] < costs[0] * 0.7
+
+
+def test_lenet_learns():
+    model = LeNet()
+    feeder = DataFeeder([DenseSlot(784), IndexSlot()])
+
+    def conv_feed(rows):
+        x, y = feeder.feed(rows)
+        return x.reshape(-1, 28, 28, 1), y
+
+    batches = [conv_feed(rows) for rows in batch(mnist.train(256), 32)()]
+    params = model.init(jax.random.PRNGKey(0))
+    params, costs = _train(model.loss, params, batches, passes=2)
+    assert costs[-1] < costs[0] * 0.6
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (VGG, dict(classes=10, width_mult=0.125)),
+    (ResNet, dict(depth=18, classes=10, width_mult=0.25, small_input=True)),
+    (ResNet, dict(depth=50, classes=10, width_mult=0.125, small_input=True)),
+])
+def test_image_models_forward_and_grad(cls, kw):
+    model = cls(**kw)
+    params = model.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    y = jnp.array([0, 1, 2, 3])
+    logits = model(params, x)
+    assert logits.shape == (4, 10)
+
+    def loss_with_stats(p):
+        mut = {}
+        l = model.loss(p, x, y, train=True, mutable=mut)
+        return l
+
+    g = jax.jit(jax.grad(loss_with_stats))(params)
+    assert np.isfinite(float(jax.tree_util.tree_reduce(
+        lambda a, b: a + jnp.sum(jnp.abs(b)), g, 0.0)))
+
+
+def test_seq2seq_learns_and_decodes():
+    model = AttentionSeq2Seq(wmt14.SRC_VOCAB, wmt14.TRG_VOCAB, embed_dim=32,
+                             hidden=32)
+    feeder = DataFeeder([SeqSlot(), SeqSlot(), SeqSlot()])
+    batches = [feeder.feed(rows) for rows in batch(wmt14.train(320), 32)()]
+    params = model.init(jax.random.PRNGKey(0))
+    params, costs = _train(model.loss, params, batches, lr=1e-2, passes=5)
+    assert costs[-1] < costs[0] * 0.95  # NLL moves slowly on the toy task; decode below is the substance
+    src, _, _ = batches[0]
+    toks, scores = model.generate(params, src, beam_size=3, max_len=8,
+                                  bos_id=wmt14.START, eos_id=wmt14.END)
+    assert toks.shape == (32, 3, 8)
+    # beam scores sorted best-first
+    assert np.all(np.diff(np.asarray(scores), axis=1) <= 1e-5)
+    gt, _ = model.greedy_generate(params, src, max_len=8, bos_id=wmt14.START,
+                                  eos_id=wmt14.END)
+    assert gt.shape == (32, 8)
+
+
+def test_bilstm_crf_learns_and_decodes():
+    model = BiLSTMCRFTagger(conll05.VOCAB, conll05.TAGS, embed_dim=32, hidden=32)
+    feeder = DataFeeder([SeqSlot(), SeqSlot()])
+    batches = [feeder.feed(rows) for rows in batch(conll05.train(128), 16)()]
+    params = model.init(jax.random.PRNGKey(0))
+    params, costs = _train(model.loss, params, batches, passes=2)
+    assert costs[-1] < costs[0] * 0.9
+    words, tags = batches[0]
+    pred, score = model.decode(params, words)
+    assert pred.shape == words.data.shape
+    assert score.shape == (words.batch_size,)
+
+
+def test_word2vec_learns():
+    model = Word2Vec(imikolov.VOCAB, embed_dim=16, context=4, hidden=32)
+    rows = list(batch(imikolov.train(512), 64)())
+
+    def feed(b):
+        arr = np.asarray(b, np.int32)
+        return jnp.asarray(arr[:, :4]), jnp.asarray(arr[:, 4])
+
+    batches = [feed(b) for b in rows]
+    params = model.init(jax.random.PRNGKey(0))
+    params, costs = _train(model.loss, params, batches, passes=6)
+    assert costs[-1] < costs[0] * 0.9
+
+
+def test_recommender_learns():
+    model = Recommender(movielens.USERS, movielens.MOVIES, movielens.CATEGORIES,
+                        movielens.JOBS, movielens.AGES, dim=16)
+    feeder = DataFeeder([IndexSlot(), IndexSlot(), IndexSlot(), IndexSlot(),
+                         IndexSlot(), SparseSlot(movielens.CATEGORIES),
+                         DenseSlot(1)])
+    def feed(rows):
+        u, g, a, j, m, (cids, cvals), r = feeder.feed(rows)
+        return u, g, a, j, m, cids, cvals, r[:, 0]
+    batches = [feed(rows) for rows in batch(movielens.train(512), 64)()]
+    params = model.init(jax.random.PRNGKey(0))
+    params, costs = _train(model.loss, params, batches, passes=3)
+    assert costs[-1] < costs[0] * 0.8
+
+
+def test_deepfm_learns():
+    model = DeepFM(criteo.HASH, criteo.FIELDS, criteo.DENSE, factor=4)
+
+    def feed(rows):
+        dense = jnp.asarray(np.stack([r[0] for r in rows]))
+        ids = jnp.asarray(np.stack([r[1] for r in rows]).astype(np.int32))
+        y = jnp.asarray(np.array([r[2] for r in rows], np.int32))
+        return dense, ids, y
+
+    batches = [feed(rows) for rows in batch(criteo.train(512), 64)()]
+    params = model.init(jax.random.PRNGKey(0))
+    params, costs = _train(model.loss, params, batches, passes=3)
+    assert costs[-1] < costs[0] * 0.9

@@ -1,0 +1,47 @@
+"""Pallas kernel numerics vs the jnp reference path (interpret mode on CPU) —
+the per-op equivalence discipline of the MKLDNN tester (SURVEY.md §8.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas_kernels import flash_attention
+
+
+def _full_attention(q, k, v, causal=False):
+    B, T, H, D = q.shape
+    s = jnp.einsum("bthd,bshd->bhts", q, k) * (D ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("T", [32, 48])   # 48 exercises the padded-tail path
+def test_flash_attention_matches_reference(causal, T):
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    B, H, D = 2, 2, 16
+    q = jax.random.normal(kq, (B, T, H, D))
+    k = jax.random.normal(kk, (B, T, H, D))
+    v = jax.random.normal(kv, (B, T, H, D))
+    ref = _full_attention(q, k, v, causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_jits_and_grads():
+    rng = jax.random.PRNGKey(1)
+    q = jax.random.normal(rng, (1, 32, 2, 16))
+
+    def loss(q):
+        return jnp.sum(flash_attention(q, q, q, causal=True, block_q=16,
+                                       block_k=16, interpret=True))
+
+    g = jax.jit(jax.grad(loss))(q)
+    assert np.isfinite(np.asarray(g)).all()

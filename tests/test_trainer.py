@@ -1,0 +1,169 @@
+"""Trainer-driver tests: events, evaluators, checkpoints, checkgrad, test loop.
+
+Shaped like the reference's trainer tests (SURVEY.md §4.4 test_Trainer.cpp,
+test_TrainerOnePass.cpp — tiny end-to-end trainings with embedded data)."""
+
+import io
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import data as pdata
+from paddle_tpu import parallel as pp
+from paddle_tpu.data import DataFeeder, DenseSlot, IndexSlot, batch
+from paddle_tpu.data.dataset import mnist
+from paddle_tpu.nn import Linear, Module
+from paddle_tpu.optimizer import Adam, SGD
+from paddle_tpu.trainer import (ClassificationErrorEvaluator, EvaluatorGroup,
+                                SumEvaluator, Trainer, event, from_tar,
+                                latest_pass, load_checkpoint, save_checkpoint,
+                                to_tar)
+
+
+class _MLP(Module):
+    def __init__(self):
+        super().__init__()
+        self.l1 = Linear(784, 64, act=jax.nn.relu)
+        self.l2 = Linear(64, 10)
+
+    def __call__(self, params, x, **kw):
+        return self.l2(params["l2"], self.l1(params["l1"], x))
+
+
+def _loss(model):
+    def loss(params, x, y):
+        logp = jax.nn.log_softmax(model(params, x))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+    return loss
+
+
+def _outputs(model):
+    def outputs(params, x, y):
+        return {"logits": model(params, x), "labels": y}
+    return outputs
+
+
+def _reader():
+    return batch(mnist.train(512), 64, drop_last=True)
+
+
+_feeder = DataFeeder([DenseSlot(784), IndexSlot()])
+
+
+def test_train_events_and_learning():
+    model = _MLP()
+    trainer = Trainer(_loss(model), Adam(1e-3), outputs_fn=_outputs(model),
+                      evaluators=[ClassificationErrorEvaluator(), SumEvaluator()])
+    seen = []
+    costs = []
+
+    def handler(e):
+        seen.append(type(e).__name__)
+        if isinstance(e, event.EndIteration):
+            costs.append(e.cost)
+            assert e.evaluator_result is not None
+
+    params = model.init(jax.random.PRNGKey(0))
+    params, _ = trainer.train(_reader(), params, num_passes=2,
+                              event_handler=handler,
+                              feeder=lambda rows: _feeder.feed(rows))
+    assert "BeginPass" in seen and "EndPass" in seen
+    assert "BeginIteration" in seen and "EndIteration" in seen
+    assert costs[-1] < costs[0]  # it learns
+    # evaluator accumulated over the pass
+    res = trainer.evaluators.result()
+    assert 0.0 <= res["classification_error"] <= 1.0
+
+
+def test_test_loop():
+    model = _MLP()
+    trainer = Trainer(_loss(model), SGD(0.1), outputs_fn=_outputs(model),
+                      evaluators=[ClassificationErrorEvaluator()])
+    params = model.init(jax.random.PRNGKey(0))
+    out = trainer.test(lambda: batch(mnist.test(128), 64)(), params,
+                       feeder=lambda rows: _feeder.feed(rows))
+    assert out["cost"] > 0
+    assert "classification_error" in out["evaluator_result"]
+
+
+def test_tar_roundtrip_and_crc():
+    params = {"a": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+              "b": [np.ones(2), np.zeros(3)]}
+    buf = io.BytesIO()
+    to_tar(buf, params)
+    buf.seek(0)
+    back = from_tar(buf)
+    np.testing.assert_allclose(back["a"]["w"], params["a"]["w"])
+    assert isinstance(back["b"], list)
+    np.testing.assert_allclose(back["b"][1], np.zeros(3))
+    # corrupt a byte -> CRC failure
+    raw = bytearray(buf.getvalue())
+    # flip a byte inside the first npy payload (past the 512-byte tar header)
+    raw[600] ^= 0xFF
+    with pytest.raises(ValueError):
+        from_tar(io.BytesIO(bytes(raw)))
+
+
+def test_checkpoint_save_resume(tmp_path):
+    out = str(tmp_path / "ckpt")
+    model = _MLP()
+    trainer = Trainer(_loss(model), Adam(1e-3), output_dir=out)
+    params = model.init(jax.random.PRNGKey(0))
+    params, _ = trainer.train(_reader(), params, num_passes=2,
+                              feeder=lambda rows: _feeder.feed(rows))
+    assert latest_pass(out) == 1
+    p2, s2, st = load_checkpoint(out)
+    assert st["pass_id"] == 1
+    # resume continues at pass 2
+    trainer2 = Trainer(_loss(model), Adam(1e-3), output_dir=out)
+    passes = []
+    trainer2.train(_reader(), model.init(jax.random.PRNGKey(1)), num_passes=1,
+                   event_handler=lambda e: passes.append(e.pass_id)
+                   if isinstance(e, event.BeginPass) else None,
+                   feeder=lambda rows: _feeder.feed(rows), resume=True)
+    assert passes == [2]
+
+
+def test_checkgrad():
+    # smooth activations only — finite differences straddle relu kinks
+    class Smooth(Module):
+        def __init__(self):
+            super().__init__()
+            self.l1 = Linear(784, 32, act=jnp.tanh)
+            self.l2 = Linear(32, 10)
+
+        def __call__(self, params, x, **kw):
+            return self.l2(params["l2"], self.l1(params["l1"], x))
+
+    model = Smooth()
+    trainer = Trainer(_loss(model), SGD(0.1))
+    params = model.init(jax.random.PRNGKey(0))
+    rows = list(batch(mnist.train(32), 32)())[0]
+    b = _feeder.feed(rows)
+    assert trainer.check_gradient(params, b, max_checks_per_param=3)
+
+
+def test_trainer_with_mesh_dp():
+    mesh = pp.make_mesh(data=8)
+    model = _MLP()
+    trainer = Trainer(_loss(model), SGD(0.1), mesh=mesh)
+    params = model.init(jax.random.PRNGKey(0))
+    costs = []
+    trainer.train(_reader(), params, num_passes=1,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, event.EndIteration) else None,
+                  feeder=lambda rows: _feeder.feed(rows))
+    assert costs[-1] < costs[0]
+
+
+def test_benchmark_job():
+    model = _MLP()
+    trainer = Trainer(_loss(model), SGD(0.1))
+    params = model.init(jax.random.PRNGKey(0))
+    r = trainer.benchmark(lambda: batch(mnist.train(128), 64, drop_last=True)(),
+                          params, feeder=lambda rows: _feeder.feed(rows),
+                          warmup=1, iters=3)
+    assert r["ms_per_batch"] > 0
