@@ -1,0 +1,84 @@
+"""ResNet-50 training throughput — the driver's image north-star metric
+(BASELINE.json: ResNet-50 ImageNet images/sec/chip; config parity:
+benchmark/paddle/image/resnet.py layer_num=50, batch 64, 224x224x3).
+
+bf16 compute (MXU native) with f32 params/optimizer — the TPU-idiomatic mixed
+precision; same on-device-loop timing discipline as lstm_textcls.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 64
+IMAGE = 224
+CLASSES = 1000
+
+
+def build(batch: int = BATCH, bf16: bool = True):
+    from paddle_tpu.models import ResNet
+    from paddle_tpu.optimizer import Momentum
+
+    model = ResNet(depth=50, classes=CLASSES)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = Momentum(0.1, momentum=0.9)
+    state = opt.init(params)
+
+    def loss_fn(params, x, y):
+        if bf16:
+            p16 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if a.dtype == jnp.float32 else a, params)
+            logits = model(p16, x.astype(jnp.bfloat16)).astype(jnp.float32)
+        else:
+            logits = model(params, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+    def step_fn(params, state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        params, state = opt.update(grads, state, params)
+        return params, state, loss
+
+    @jax.jit
+    def run_n(params, state, x, y, n):
+        def body(_, carry):
+            params, state, _ = carry
+            return step_fn(params, state, x, y)
+        return jax.lax.fori_loop(0, n, body, (params, state, jnp.float32(0)))
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch, IMAGE, IMAGE, 3), jnp.float32)
+    y = jnp.asarray(rs.randint(0, CLASSES, batch), jnp.int32)
+    return run_n, params, state, (x, y)
+
+
+def run(iters: int = 20, repeats: int = 2, batch: int = BATCH):
+    run_n, params, state, b = build(batch)
+    run_n(params, state, *b, 1)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        _, _, loss = run_n(params, state, *b, n)
+        float(loss)
+        return time.perf_counter() - t0
+
+    t_short = min(timed(1) for _ in range(repeats))
+    t_long = min(timed(iters + 1) for _ in range(repeats))
+    sec = max(t_long - t_short, 1e-9) / iters
+    ips = batch / sec
+    return {"metric": "resnet50_train_images_per_sec_bs64_224",
+            "value": round(ips, 2), "unit": "images/sec",
+            "vs_baseline": None}  # no published reference ResNet number (BASELINE.md)
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    print(json.dumps(run()))

@@ -1,0 +1,84 @@
+"""Attention seq2seq NMT training throughput — the driver's seq2seq
+north-star (BASELINE.json tokens/sec/chip; the reference's benchmark README
+deferred its seq2seq numbers, benchmark/README.md:141,168).
+
+Config: vocab 30k/30k, embed 512, hidden 512, src/trg length 32, batch 64 —
+a standard GNMT-small-ish shape. Counts target tokens/sec through the full
+training step.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SRC_VOCAB = TRG_VOCAB = 30000
+EMBED = 512
+HIDDEN = 512
+SEQ = 32
+BATCH = 64
+
+
+def build():
+    from paddle_tpu.core import SeqBatch
+    from paddle_tpu.models import AttentionSeq2Seq
+    from paddle_tpu.optimizer import Adam
+
+    model = AttentionSeq2Seq(SRC_VOCAB, TRG_VOCAB, embed_dim=EMBED,
+                             hidden=HIDDEN)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = Adam(1e-3)
+    state = opt.init(params)
+
+    def loss_fn(params, src, slen, tin, tout, tlen):
+        return model.loss(params, SeqBatch(src, slen), SeqBatch(tin, tlen),
+                          SeqBatch(tout, tlen))
+
+    def step_fn(params, state, *b):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *b)
+        params, state = opt.update(grads, state, params)
+        return params, state, loss
+
+    @jax.jit
+    def run_n(params, state, src, slen, tin, tout, tlen, n):
+        def body(_, carry):
+            params, state, _ = carry
+            return step_fn(params, state, src, slen, tin, tout, tlen)
+        return jax.lax.fori_loop(0, n, body, (params, state, jnp.float32(0)))
+
+    rs = np.random.RandomState(0)
+    src = jnp.asarray(rs.randint(3, SRC_VOCAB, (BATCH, SEQ)), jnp.int32)
+    tin = jnp.asarray(rs.randint(3, TRG_VOCAB, (BATCH, SEQ)), jnp.int32)
+    tout = jnp.asarray(rs.randint(3, TRG_VOCAB, (BATCH, SEQ)), jnp.int32)
+    lens = jnp.full((BATCH,), SEQ, jnp.int32)
+    return run_n, params, state, (src, lens, tin, tout, lens)
+
+
+def run(iters: int = 30, repeats: int = 2):
+    run_n, params, state, b = build()
+    run_n(params, state, *b, 1)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        _, _, loss = run_n(params, state, *b, n)
+        float(loss)
+        return time.perf_counter() - t0
+
+    t_short = min(timed(1) for _ in range(repeats))
+    t_long = min(timed(iters + 1) for _ in range(repeats))
+    sec = max(t_long - t_short, 1e-9) / iters
+    tokens = BATCH * SEQ
+    return {"metric": "seq2seq_nmt_train_tokens_per_sec_h512_len32_bs64",
+            "value": round(tokens / sec, 1), "unit": "tokens/sec",
+            "vs_baseline": None}  # reference published no seq2seq number
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    print(json.dumps(run()))

@@ -1,0 +1,189 @@
+"""Command-line driver — the `paddle` CLI analog.
+
+Reference surface (paddle/scripts/submit_local.sh.in:3-16 + TrainerMain.cpp
+job types): train / test / time / version / dump_config / merge_model.
+
+The config file is a Python script (the reference's config style,
+config_parser.py executing user configs) that builds a model through the v2
+or fluid front end and exposes module-level names:
+
+    cost       — v2 LayerOutput or fluid Variable to minimize
+    optimizer  — paddle_tpu.v2.optimizer.* (or fluid optimizer)
+    train_reader() / test_reader() — batched reader creators
+    feeding    — list of v2 data layers in row order (v2 configs)
+    outputs    — optional list of layers to export for inference
+
+Usage: python -m paddle_tpu train --config cfg.py --num_passes 2 --save_dir out
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import runpy
+import sys
+import time
+from typing import Any, Dict
+
+
+def _load_config(path: str) -> Dict[str, Any]:
+    from . import fluid
+    fluid.reset_default_programs()
+    return runpy.run_path(path)
+
+
+def _make_trainer(cfg):
+    from . import v2
+    cost = cfg["cost"]
+    opt = cfg.get("optimizer") or v2.optimizer.SGD(0.01)
+    if not hasattr(opt, "fluid_opt"):
+        opt = type("O", (), {"fluid_opt": opt})()
+    return v2.SGD(cost, opt)
+
+
+def cmd_train(args):
+    from .trainer import event
+    cfg = _load_config(args.config)
+    trainer = _make_trainer(cfg)
+    costs = []
+
+    def handler(e):
+        if isinstance(e, event.EndIteration):
+            costs.append(e.cost)
+            if args.log_period and (e.batch_id + 1) % args.log_period == 0:
+                print(f"pass {e.pass_id} batch {e.batch_id} cost {e.cost:.6f}")
+        elif isinstance(e, event.EndPass):
+            print(f"pass {e.pass_id} done; last cost "
+                  f"{costs[-1] if costs else float('nan'):.6f}")
+            if args.save_dir:
+                import os
+
+                from .trainer.checkpoint import pass_dir
+                d = pass_dir(args.save_dir, e.pass_id)
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, "params.tar"), "wb") as f:
+                    trainer.parameters.to_tar(f)
+
+    trainer.train(cfg["train_reader"], num_passes=args.num_passes,
+                  event_handler=handler, feeding=cfg.get("feeding"))
+    if args.save_dir and "outputs" in cfg:
+        from . import fluid
+        fluid.io.export_inference_model(
+            args.save_dir + "/inference",
+            [dl.var.name for dl in cfg.get("feeding", [])],
+            [o.var for o in cfg["outputs"]], trainer.exe)
+    return 0
+
+
+def cmd_test(args):
+    cfg = _load_config(args.config)
+    trainer = _make_trainer(cfg)
+    if args.init_model_path:
+        with open(args.init_model_path, "rb") as f:
+            trainer.parameters.from_tar(f)
+    res = trainer.test(cfg.get("test_reader", cfg["train_reader"]),
+                       feeding=cfg.get("feeding"))
+    print(json.dumps({"cost": res.cost}))
+    return 0
+
+
+def cmd_time(args):
+    """--job=time analog (TrainerBenchmark.cpp): steady-state ms/batch."""
+    cfg = _load_config(args.config)
+    trainer = _make_trainer(cfg)
+    batches = list(cfg["train_reader"]())[: max(args.iters + args.warmup, 1)]
+    from .v2.trainer import _V2Feeder
+    feeder = _V2Feeder(cfg["feeding"]) if cfg.get("feeding") else None
+    fetch = [cfg["cost"].var]
+    i = 0
+    for _ in range(args.warmup):
+        feed = feeder(batches[i % len(batches)]) if feeder else batches[i % len(batches)]
+        trainer.exe.run(feed=feed, fetch_list=fetch)
+        i += 1
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        feed = feeder(batches[i % len(batches)]) if feeder else batches[i % len(batches)]
+        trainer.exe.run(feed=feed, fetch_list=fetch)
+        i += 1
+    ms = (time.perf_counter() - t0) / args.iters * 1e3
+    print(json.dumps({"ms_per_batch": round(ms, 3)}))
+    return 0
+
+
+def cmd_dump_config(args):
+    """Print the built Program IR as JSON (dump_config / make_diagram data)."""
+    cfg = _load_config(args.config)
+    from . import fluid
+    print(json.dumps(fluid.default_main_program().to_dict(), indent=2,
+                     default=str))
+    return 0
+
+
+def cmd_merge_model(args):
+    """Merge a params tar + config into one inference bundle
+    (trainer/MergeModel.cpp:29 analog)."""
+    cfg = _load_config(args.config)
+    trainer = _make_trainer(cfg)
+    with open(args.model_path, "rb") as f:
+        trainer.parameters.from_tar(f)
+    from . import fluid
+    outs = cfg.get("outputs") or [cfg["cost"]]
+    fluid.io.export_inference_model(
+        args.output_dir, [dl.var.name for dl in cfg.get("feeding", [])],
+        [o.var for o in outs], trainer.exe)
+    print(f"merged model written to {args.output_dir}")
+    return 0
+
+
+def cmd_version(args):
+    from . import __version__
+    import jax
+    print(f"paddle_tpu {__version__} (jax {jax.__version__}, "
+          f"backend {jax.default_backend()})")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="paddle_tpu")
+    sub = p.add_subparsers(dest="job", required=True)
+
+    def common(sp):
+        sp.add_argument("--config", required=True)
+
+    t = sub.add_parser("train")
+    common(t)
+    t.add_argument("--num_passes", type=int, default=1)
+    t.add_argument("--save_dir", default=None)
+    t.add_argument("--log_period", type=int, default=0)
+    t.set_defaults(fn=cmd_train)
+
+    te = sub.add_parser("test")
+    common(te)
+    te.add_argument("--init_model_path", default=None)
+    te.set_defaults(fn=cmd_test)
+
+    tm = sub.add_parser("time")
+    common(tm)
+    tm.add_argument("--warmup", type=int, default=2)
+    tm.add_argument("--iters", type=int, default=10)
+    tm.set_defaults(fn=cmd_time)
+
+    dc = sub.add_parser("dump_config")
+    common(dc)
+    dc.set_defaults(fn=cmd_dump_config)
+
+    mm = sub.add_parser("merge_model")
+    common(mm)
+    mm.add_argument("--model_path", required=True)
+    mm.add_argument("--output_dir", required=True)
+    mm.set_defaults(fn=cmd_merge_model)
+
+    v = sub.add_parser("version")
+    v.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

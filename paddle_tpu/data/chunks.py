@@ -1,0 +1,90 @@
+"""Reader <-> recordio-chunk bridge + the fault-tolerant cloud reader.
+
+Reference pipeline being re-provided: datasets are converted to RecordIO
+chunks, the master shards chunk ranges into tasks, and trainers read via
+``cloud_reader`` (python/paddle/v2/reader/creator.py:91-109 +
+python/paddle/v2/master/client.py:15-80). Sample payloads are pickled tuples
+(the reference pickles through its recordio client the same way); files are
+the CRC-checked chunk format of native/recordio.cc.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Iterable, List, Optional
+
+from .reader import Reader
+
+
+def dump_to_chunks(reader_creator: Reader, dirname: str, *,
+                   samples_per_chunk: int = 1024,
+                   prefix: str = "chunk") -> List[str]:
+    """Materialise a reader into chunk files; returns the paths
+    (dataset/common.py convert + recordio writer analog)."""
+    from ..runtime.recordio import RecordWriter
+    os.makedirs(dirname, exist_ok=True)
+    paths: List[str] = []
+    writer = None
+    count = 0
+    for sample in reader_creator():
+        if writer is None:
+            path = os.path.join(dirname, f"{prefix}-{len(paths):05d}.ptr")
+            writer = RecordWriter(path)
+            paths.append(path)
+        writer.write(pickle.dumps(sample, protocol=4))
+        count += 1
+        if count >= samples_per_chunk:
+            writer.close()
+            writer = None
+            count = 0
+    if writer is not None:
+        writer.close()
+    return paths
+
+
+def chunk_reader(paths: Iterable[str]) -> Reader:
+    """Reader creator over chunk files (recordio.creator analog)."""
+    paths = list(paths)
+
+    def reader():
+        from ..runtime.recordio import RecordReader
+        for path in paths:
+            with RecordReader(path) as r:
+                for payload in r:
+                    yield pickle.loads(payload)
+
+    return reader
+
+
+def cloud_reader(master_client, *, pass_end_sentinel: bool = False,
+                 poll_interval: float = 0.1,
+                 max_idle_polls: int = 600) -> Reader:
+    """Fault-tolerant distributed reader (creator.py:91 cloud_reader): pull
+    chunk tasks from the master service, stream their samples, report
+    finished/failed. One pass = until the master says the pass is done."""
+    import time
+
+    def reader():
+        idle = 0
+        while True:
+            task = master_client.get_task()
+            if task is None:
+                todo, pending, done, disc, epoch = master_client.stats()
+                if todo == 0 and pending == 0:
+                    return                      # pass complete
+                idle += 1
+                if idle > max_idle_polls:
+                    raise TimeoutError("master starved the reader")
+                time.sleep(poll_interval)
+                continue
+            idle = 0
+            task_id, path = task
+            try:
+                yield from chunk_reader([path])()
+            except Exception:
+                master_client.task_failed(task_id)
+                continue
+            master_client.task_finished(task_id)
+
+    return reader

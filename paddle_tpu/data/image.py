@@ -1,0 +1,83 @@
+"""Image preprocessing/augmentation (python/paddle/v2/image.py analog).
+
+The reference wraps cv2; this is pure numpy (no cv2 in the TPU image): resize
+(bilinear), center/random crop, horizontal flip, channel-mean normalize —
+the standard ImageNet training pipeline pieces. All functions take HWC
+float/uint8 arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Scale so the short edge equals ``size`` (image.py resize_short)."""
+    h, w = im.shape[:2]
+    if h <= w:
+        nh, nw = size, max(1, int(round(w * size / h)))
+    else:
+        nh, nw = max(1, int(round(h * size / w))), size
+    return _bilinear(im, nh, nw)
+
+
+def _bilinear(im: np.ndarray, nh: int, nw: int) -> np.ndarray:
+    h, w = im.shape[:2]
+    ys = np.linspace(0, h - 1, nh)
+    xs = np.linspace(0, w - 1, nw)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    im = im.astype(np.float32)
+    if im.ndim == 2:
+        im = im[..., None]
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def center_crop(im: np.ndarray, size: int) -> np.ndarray:
+    h, w = im.shape[:2]
+    y = max(0, (h - size) // 2)
+    x = max(0, (w - size) // 2)
+    return im[y:y + size, x:x + size]
+
+
+def random_crop(im: np.ndarray, size: int,
+                rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    y = rng.randint(0, max(h - size, 0) + 1)
+    x = rng.randint(0, max(w - size, 0) + 1)
+    return im[y:y + size, x:x + size]
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def normalize(im: np.ndarray, mean: Sequence[float],
+              std: Optional[Sequence[float]] = None) -> np.ndarray:
+    out = im.astype(np.float32) - np.asarray(mean, np.float32)
+    if std is not None:
+        out = out / np.asarray(std, np.float32)
+    return out
+
+
+def simple_transform(im: np.ndarray, resize: int, crop: int, is_train: bool,
+                     mean: Optional[Sequence[float]] = None,
+                     rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    """The canonical train/test pipeline (image.py simple_transform):
+    resize-short -> random/center crop -> (train) flip -> normalize."""
+    im = resize_short(im, resize)
+    im = random_crop(im, crop, rng) if is_train else center_crop(im, crop)
+    if is_train and (rng or np.random).rand() < 0.5:
+        im = left_right_flip(im)
+    if mean is not None:
+        im = normalize(im, mean)
+    return im

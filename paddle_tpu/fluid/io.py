@@ -34,3 +34,45 @@ def load_persistables(executor: Executor, dirname: str,
         tree = from_tar(f)
     for name, arr in tree.items():
         executor.scope.set(name, jnp.asarray(arr))
+
+
+# -- merged inference model (capi merged-model + fluid io analog) ---------------
+
+def export_inference_model(dirname: str, feed_names, fetch_vars,
+                           executor: Executor,
+                           main_program: Optional[Program] = None):
+    """Save a deployable model: the program pruned to the fetch targets
+    (training/backward ops dropped, framework/prune.cc analog) as JSON +
+    the persistables tar — the single-artifact inference bundle of the
+    reference's merge_model CLI (trainer/MergeModel.cpp:29) and the C API's
+    merged model (capi/gradient_machine.h:36)."""
+    import json
+    program = main_program or default_main_program()
+    fetch_names = [v.name if hasattr(v, "name") else str(v) for v in fetch_vars]
+    pruned = program.prune(fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"program": pruned.to_dict(),
+            "feed_names": list(feed_names),
+            "fetch_names": fetch_names}
+    with open(os.path.join(dirname, "model.json"), "w") as f:
+        json.dump(meta, f)
+    scope = executor.scope
+    tree = {n: scope.get(n)
+            for n, v in pruned.global_block().vars.items()
+            if v.persistable and scope.has(n)}
+    with open(os.path.join(dirname, "params.tar"), "wb") as f:
+        to_tar(f, tree)
+
+
+def load_inference_model(dirname: str, executor: Executor):
+    """-> (program, feed_names, fetch_names); scope populated with params."""
+    import json
+
+    import jax.numpy as jnp
+    with open(os.path.join(dirname, "model.json")) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    with open(os.path.join(dirname, "params.tar"), "rb") as f:
+        for name, arr in from_tar(f).items():
+            executor.scope.set(name, jnp.asarray(arr))
+    return program, meta["feed_names"], meta["fetch_names"]

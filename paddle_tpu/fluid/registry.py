@@ -342,3 +342,57 @@ def _adam(ins, attrs):
 @OpRegistry.register("autodiff_grad")
 def _autodiff_stub(ins, attrs):
     raise RuntimeError("autodiff_grad is lowered by the executor, not run directly")
+
+
+# ------------------------------------------------------ sequence / recurrent --
+# TPU-idiomatic coarse ops: a whole masked LSTM/GRU pass is ONE op (the
+# lax.scan lives inside), replacing the reference's per-step RecurrentOp
+# machinery (operators/recurrent_op.cc) for the common fixed-topology case.
+
+@OpRegistry.register("lstm")
+def _lstm(ins, attrs):
+    from ..ops.rnn import lstm
+    out, state = lstm(ins["X"][0], ins["Lengths"][0] if "Lengths" in ins else None,
+                      ins["W"][0], ins["U"][0],
+                      ins["B"][0] if "B" in ins else None,
+                      reverse=attrs.get("reverse", False),
+                      forget_bias=attrs.get("forget_bias", 1.0))
+    return {"Out": [out], "LastH": [state.h], "LastC": [state.c]}
+
+
+@OpRegistry.register("gru")
+def _gru(ins, attrs):
+    from ..ops.rnn import gru
+    out, last = gru(ins["X"][0], ins["Lengths"][0] if "Lengths" in ins else None,
+                    ins["W"][0], ins["U"][0],
+                    ins["B"][0] if "B" in ins else None,
+                    reverse=attrs.get("reverse", False))
+    return {"Out": [out], "LastH": [last]}
+
+
+@OpRegistry.register("sequence_pool")
+def _seq_pool(ins, attrs):
+    from ..ops.sequence import sequence_pool
+    return {"Out": [sequence_pool(ins["X"][0], ins["Lengths"][0],
+                                  attrs.get("pool_type", "average"))]}
+
+
+@OpRegistry.register("sequence_conv")
+def _seq_conv(ins, attrs):
+    from ..ops.sequence import sequence_conv
+    return {"Out": [sequence_conv(ins["X"][0], ins["Lengths"][0],
+                                  ins["Filter"][0],
+                                  context_start=attrs.get("context_start", -1),
+                                  context_length=attrs.get("context_length", 3))]}
+
+
+@OpRegistry.register("sequence_last_step")
+def _seq_last(ins, attrs):
+    from ..ops.sequence import sequence_last_step
+    return {"Out": [sequence_last_step(ins["X"][0], ins["Lengths"][0])]}
+
+
+@OpRegistry.register("sequence_first_step")
+def _seq_first(ins, attrs):
+    from ..ops.sequence import sequence_first_step
+    return {"Out": [sequence_first_step(ins["X"][0], ins["Lengths"][0])]}

@@ -2,6 +2,7 @@
 (SURVEY.md §2.4 v1_api_demo + benchmark/paddle + fluid/tests/book)."""
 
 from .embeddings import DeepFM, Recommender, Word2Vec
+from .generative import GAN, VAE
 from .image import LeNet, ResNet, SmallNet, VGG, resnet50
 from .mlp import MnistMLP
 from .seq2seq import AttentionSeq2Seq
@@ -11,4 +12,4 @@ from .text_cls import BiLSTMTextCls, ConvTextCls, LSTMTextCls
 __all__ = ["MnistMLP", "LeNet", "SmallNet", "VGG", "ResNet", "resnet50",
            "LSTMTextCls", "BiLSTMTextCls", "ConvTextCls",
            "AttentionSeq2Seq", "LinearCRFTagger", "BiLSTMCRFTagger",
-           "Word2Vec", "Recommender", "DeepFM"]
+           "Word2Vec", "Recommender", "DeepFM", "GAN", "VAE"]

@@ -1,0 +1,101 @@
+"""Generative demo models — GAN and VAE.
+
+Analogs of the reference demos ``v1_api_demo/gan/`` (gan_conf.py: generator/
+discriminator MLPs trained adversarially) and ``v1_api_demo/vae/`` (vae_conf.py:
+MLP encoder/decoder, gaussian reparameterization). TPU-first: both are plain
+jitted train steps; the GAN alternates two optimizers over disjoint param
+subtrees (the reference used two separate GradientMachines).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import loss as L
+
+
+class GAN(nn.Module):
+    """MLP GAN (gan_conf.py shapes): G: z->sample; D: sample->real logit."""
+
+    def __init__(self, data_dim: int = 784, noise_dim: int = 64,
+                 hidden: int = 128):
+        super().__init__()
+        self.noise_dim = noise_dim
+        self.g1 = nn.Linear(noise_dim, hidden, act="relu")
+        self.g2 = nn.Linear(hidden, hidden, act="relu")
+        self.g3 = nn.Linear(hidden, data_dim, act="tanh")
+        self.d1 = nn.Linear(data_dim, hidden, act="relu")
+        self.d2 = nn.Linear(hidden, hidden, act="relu")
+        self.d3 = nn.Linear(hidden, 1)
+
+    def generate(self, params, z):
+        h = self.g1(params["g1"], z)
+        h = self.g2(params["g2"], h)
+        return self.g3(params["g3"], h)
+
+    def discriminate(self, params, x):
+        h = self.d1(params["d1"], x)
+        h = self.d2(params["d2"], h)
+        return self.d3(params["d3"], h)[..., 0]
+
+    # -- losses (non-saturating GAN) ---------------------------------------
+    def d_loss(self, params, real, z):
+        fake = jax.lax.stop_gradient(self.generate(params, z))
+        logit_r = self.discriminate(params, real)
+        logit_f = self.discriminate(params, fake)
+        return (L.sigmoid_cross_entropy_with_logits(
+                    logit_r, jnp.ones_like(logit_r)).mean()
+                + L.sigmoid_cross_entropy_with_logits(
+                    logit_f, jnp.zeros_like(logit_f)).mean())
+
+    def g_loss(self, params, z):
+        fake = self.generate(params, z)
+        logit_f = self.discriminate(params, fake)
+        return L.sigmoid_cross_entropy_with_logits(
+            logit_f, jnp.ones_like(logit_f)).mean()
+
+    @staticmethod
+    def split_grads(grads) -> Tuple[Dict, Dict]:
+        g = {k: v for k, v in grads.items() if k.startswith("g")}
+        d = {k: v for k, v in grads.items() if k.startswith("d")}
+        return g, d
+
+
+class VAE(nn.Module):
+    """MLP VAE (vae_conf.py): encoder -> (mu, logvar) -> decoder; ELBO loss."""
+
+    def __init__(self, data_dim: int = 784, latent: int = 32,
+                 hidden: int = 128):
+        super().__init__()
+        self.latent = latent
+        self.enc1 = nn.Linear(data_dim, hidden, act="relu")
+        self.enc_mu = nn.Linear(hidden, latent)
+        self.enc_lv = nn.Linear(hidden, latent)
+        self.dec1 = nn.Linear(latent, hidden, act="relu")
+        self.dec2 = nn.Linear(hidden, data_dim)
+
+    def encode(self, params, x):
+        h = self.enc1(params["enc1"], x)
+        return self.enc_mu(params["enc_mu"], h), self.enc_lv(params["enc_lv"], h)
+
+    def decode(self, params, z):
+        return self.dec2(params["dec2"], self.dec1(params["dec1"], z))
+
+    def loss(self, params, x, rng):
+        mu, logvar = self.encode(params, x)
+        eps = jax.random.normal(rng, mu.shape)
+        z = mu + jnp.exp(0.5 * logvar) * eps          # reparameterization
+        logits = self.decode(params, z)
+        # Bernoulli reconstruction on x scaled to [0,1]
+        x01 = (x + 1.0) / 2.0
+        rec = L.sigmoid_cross_entropy_with_logits(logits, x01).sum(-1).mean()
+        kl = -0.5 * jnp.sum(1 + logvar - mu ** 2 - jnp.exp(logvar), -1).mean()
+        return rec + kl
+
+    def sample(self, params, rng, n: int):
+        z = jax.random.normal(rng, (n, self.latent))
+        return jax.nn.sigmoid(self.decode(params, z))

@@ -1,5 +1,7 @@
 from . import (activations, beam_search, conv, crf, ctc, loss, math, metrics,
-               norm, pool, random, rnn, sequence, sparse)
+               detection, nce, norm, pallas_kernels, pool, random, rnn,
+               sequence, sparse)
 
 __all__ = ["math", "activations", "loss", "conv", "pool", "norm", "random",
-           "rnn", "sequence", "crf", "ctc", "beam_search", "metrics", "sparse"]
+           "rnn", "sequence", "crf", "ctc", "beam_search", "metrics", "sparse",
+           "detection", "nce", "pallas_kernels"]

@@ -115,3 +115,30 @@ def im2col(x: jax.Array, kernel: IntOr2, stride: IntOr2 = 1,
     patches = patches.reshape(B, oh, ow, C, kh, kw)
     patches = jnp.transpose(patches, (0, 1, 2, 4, 5, 3))
     return patches.reshape(B, oh, ow, kh * kw * C)
+
+
+def bilinear_interp(x: jax.Array, out_h: int, out_w: int) -> jax.Array:
+    """Bilinear resize NHWC (ref: operators/bilinear_interp_op.cc,
+    gserver BilinearInterpLayer.cpp)."""
+    B, H, W, C = x.shape
+    ry = (H - 1) / max(out_h - 1, 1)
+    rx = (W - 1) / max(out_w - 1, 1)
+    ys = jnp.arange(out_h) * ry
+    xs = jnp.arange(out_w) * rx
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    g = lambda yi, xi: x[:, yi][:, :, xi]
+    top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+    bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+    return top * (1 - wy) + bot * wy
+
+
+def maxout(x: jax.Array, groups: int) -> jax.Array:
+    """Maxout over channel groups NHWC (ref: operators/maxout_op.cc,
+    gserver MaxOutLayer.cpp): C -> C/groups channels."""
+    B, H, W, C = x.shape
+    return jnp.max(x.reshape(B, H, W, C // groups, groups), axis=-1)

@@ -1,0 +1,210 @@
+"""Object-detection ops — the SSD suite.
+
+Re-provisions the reference's detection layers/ops (gserver/layers/
+PriorBox.cpp, MultiBoxLossLayer.cpp, DetectionOutputLayer.cpp + detection
+utils; gen-2 operators/ equivalents) TPU-style: everything fixed-shape and
+masked; NMS is an O(K^2) masked suppression over a static top-K candidate set
+(data-dependent loops won't compile — SURVEY.md §7 hard parts).
+
+Boxes are [xmin, ymin, xmax, ymax] normalized to [0, 1] throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ------------------------------------------------------------------ priors ---
+
+def prior_box(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
+              min_size: float, max_size: Optional[float] = None,
+              aspect_ratios: Sequence[float] = (2.0,),
+              flip: bool = True, clip: bool = True,
+              variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2)
+              ) -> Tuple[jax.Array, jax.Array]:
+    """SSD prior (anchor) boxes for one feature map (PriorBox.cpp semantics).
+
+    Returns (boxes [H*W*P, 4], variances [H*W*P, 4]); P priors per cell:
+    min + (sqrt(min*max) if max_size) + one per aspect ratio (x2 if flip).
+    """
+    H, W = feature_hw
+    img_h, img_w = image_hw
+    sizes_w, sizes_h = [], []
+    s = min_size
+    sizes_w.append(s / img_w)
+    sizes_h.append(s / img_h)
+    if max_size is not None:
+        sp = (min_size * max_size) ** 0.5
+        sizes_w.append(sp / img_w)
+        sizes_h.append(sp / img_h)
+    for ar in aspect_ratios:
+        for a in ((ar, 1.0 / ar) if flip else (ar,)):
+            sizes_w.append(min_size * (a ** 0.5) / img_w)
+            sizes_h.append(min_size / (a ** 0.5) / img_h)
+    P = len(sizes_w)
+    cy, cx = jnp.meshgrid(
+        (jnp.arange(H) + 0.5) / H, (jnp.arange(W) + 0.5) / W, indexing="ij")
+    cx = jnp.broadcast_to(cx[..., None], (H, W, P))
+    cy = jnp.broadcast_to(cy[..., None], (H, W, P))
+    w2 = jnp.asarray(sizes_w) / 2.0
+    h2 = jnp.asarray(sizes_h) / 2.0
+    boxes = jnp.stack([cx - w2, cy - h2, cx + w2, cy + h2], axis=-1)
+    boxes = boxes.reshape(-1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(jnp.asarray(variance), boxes.shape)
+    return boxes, variances
+
+
+# ------------------------------------------------------------------- iou ----
+
+def iou_matrix(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Pairwise IoU: a [N, 4], b [M, 4] -> [N, M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0.0) * jnp.clip(a[:, 3] - a[:, 1], 0.0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0.0) * jnp.clip(b[:, 3] - b[:, 1], 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+# --------------------------------------------------------------- encoding ---
+
+def encode_boxes(gt: jax.Array, priors: jax.Array,
+                 variances: jax.Array) -> jax.Array:
+    """Ground truth -> regression targets relative to priors (SSD encoding)."""
+    p_wh = priors[:, 2:] - priors[:, :2]
+    p_c = (priors[:, :2] + priors[:, 2:]) / 2
+    g_wh = jnp.maximum(gt[:, 2:] - gt[:, :2], 1e-8)
+    g_c = (gt[:, :2] + gt[:, 2:]) / 2
+    d_c = (g_c - p_c) / (p_wh * variances[:, :2])
+    d_wh = jnp.log(g_wh / p_wh) / variances[:, 2:]
+    return jnp.concatenate([d_c, d_wh], axis=-1)
+
+
+def decode_boxes(loc: jax.Array, priors: jax.Array,
+                 variances: jax.Array) -> jax.Array:
+    """Regression output -> boxes (DetectionOutputLayer decode)."""
+    p_wh = priors[:, 2:] - priors[:, :2]
+    p_c = (priors[:, :2] + priors[:, 2:]) / 2
+    c = loc[..., :2] * variances[:, :2] * p_wh + p_c
+    wh = jnp.exp(loc[..., 2:] * variances[:, 2:]) * p_wh
+    return jnp.concatenate([c - wh / 2, c + wh / 2], axis=-1)
+
+
+# --------------------------------------------------------------- matching ---
+
+def match_priors(priors: jax.Array, gt_boxes: jax.Array, gt_mask: jax.Array,
+                 threshold: float = 0.5) -> Tuple[jax.Array, jax.Array]:
+    """Match each prior to a gt box (MultiBoxLossLayer matching).
+
+    gt_boxes [G, 4] padded, gt_mask [G] 1.0 for real boxes.
+    Returns (matched_gt_idx [N], positive_mask [N]): best-gt per prior above
+    threshold, with each gt's single best prior force-matched.
+    """
+    iou = iou_matrix(priors, gt_boxes) * gt_mask[None, :]
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    pos = best_iou >= threshold
+    # force-match: the best prior for each (real) gt becomes positive for it
+    best_prior = jnp.argmax(iou, axis=0)                    # [G]
+    N, G = iou.shape
+    forced = jnp.zeros((N,), jnp.int32).at[best_prior].set(
+        jnp.arange(G, dtype=jnp.int32), mode="drop")
+    force_mask = jnp.zeros((N,), bool).at[best_prior].set(
+        gt_mask > 0, mode="drop")
+    matched = jnp.where(force_mask, forced, best_gt)
+    pos = pos | force_mask
+    return matched, pos
+
+
+def multibox_loss(loc_pred: jax.Array, conf_logits: jax.Array,
+                  priors: jax.Array, variances: jax.Array,
+                  gt_boxes: jax.Array, gt_labels: jax.Array,
+                  gt_mask: jax.Array, *, neg_pos_ratio: float = 3.0,
+                  overlap_threshold: float = 0.5,
+                  background_id: int = 0) -> jax.Array:
+    """SSD loss for ONE image (vmap over the batch):
+    smooth-L1 on matched locs + softmax CE with hard-negative mining
+    (MultiBoxLossLayer.cpp semantics). conf_logits [N, C]; gt_labels [G]
+    (0 = background id reserved).
+    """
+    from .loss import smooth_l1
+    matched, pos = match_priors(priors, gt_boxes, gt_mask, overlap_threshold)
+    n_pos = jnp.sum(pos.astype(jnp.float32))
+
+    # localization: smooth L1 over positive priors
+    targets = encode_boxes(gt_boxes[matched], priors, variances)
+    loc_l = jnp.sum(smooth_l1(loc_pred, targets), axis=-1)
+    loc_loss = jnp.sum(loc_l * pos) / jnp.maximum(n_pos, 1.0)
+
+    # classification with hard negative mining
+    labels = jnp.where(pos, gt_labels[matched], background_id)
+    logp = jax.nn.log_softmax(conf_logits)
+    ce = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    neg_ce = jnp.where(pos, -jnp.inf, ce)                  # candidates: negatives
+    n_neg = jnp.minimum(neg_pos_ratio * jnp.maximum(n_pos, 1.0),
+                        jnp.sum(1.0 - pos.astype(jnp.float32)))
+    # take the hardest n_neg negatives via rank threshold (static shape)
+    order = jnp.argsort(-neg_ce)
+    rank = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+    neg = (~pos) & (rank < n_neg)
+    conf_loss = jnp.sum(ce * (pos | neg)) / jnp.maximum(n_pos, 1.0)
+    return loc_loss + conf_loss
+
+
+# ------------------------------------------------------------------- nms ----
+
+def nms(boxes: jax.Array, scores: jax.Array, *, iou_threshold: float = 0.45,
+        score_threshold: float = 0.01, top_k: int = 100
+        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Masked fixed-shape NMS (DetectionOutputLayer::applyNMSFast analog).
+
+    boxes [N, 4], scores [N] -> (boxes [top_k, 4], scores [top_k],
+    valid [top_k]) sorted by score; suppressed/empty slots have valid=0.
+    """
+    N = scores.shape[0]
+    k = min(top_k, N)
+    sc, idx = jax.lax.top_k(jnp.where(scores >= score_threshold, scores,
+                                      -jnp.inf), k)
+    bx = boxes[idx]
+    iou = iou_matrix(bx, bx)
+
+    def body(i, keep):
+        # drop i if a higher-scored kept candidate overlaps too much
+        sup = (iou[:, i] > iou_threshold) & keep & (jnp.arange(k) < i)
+        keep_i = keep[i] & ~jnp.any(sup)
+        return keep.at[i].set(keep_i)
+
+    keep0 = sc > -jnp.inf
+    keep = jax.lax.fori_loop(0, k, body, keep0)
+    return bx, jnp.where(keep, sc, 0.0), keep.astype(jnp.float32)
+
+
+def detection_output(loc_pred: jax.Array, conf_logits: jax.Array,
+                     priors: jax.Array, variances: jax.Array, *,
+                     num_classes: int, background_id: int = 0,
+                     iou_threshold: float = 0.45,
+                     score_threshold: float = 0.01, keep_top_k: int = 100):
+    """Decode + per-class NMS for ONE image (DetectionOutputLayer.cpp).
+
+    Returns (boxes [C-1, K, 4], scores [C-1, K], valid [C-1, K]) for the
+    non-background classes (vmap over batch outside).
+    """
+    boxes = decode_boxes(loc_pred, priors, variances)
+    probs = jax.nn.softmax(conf_logits, axis=-1)
+    out_b, out_s, out_v = [], [], []
+    for c in range(num_classes):
+        if c == background_id:
+            continue
+        b, s, v = nms(boxes, probs[:, c], iou_threshold=iou_threshold,
+                      score_threshold=score_threshold, top_k=keep_top_k)
+        out_b.append(b)
+        out_s.append(s)
+        out_v.append(v)
+    return (jnp.stack(out_b), jnp.stack(out_s), jnp.stack(out_v))

@@ -1,0 +1,114 @@
+"""Large-vocabulary output approximations: NCE and hierarchical sigmoid.
+
+Reference: gserver/layers/NCELayer.cpp (noise-contrastive estimation with
+sampled negatives) and HierarchicalSigmoidLayer.cpp (binary-tree softmax);
+gen-2 operators/nce_op.cc. TPU-style: the negative sample set is drawn
+host-side or via jax.random with static sample count; all gathers are dense
+[B, S] lookups that batch into one MXU matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def nce_loss(hidden: jax.Array, labels: jax.Array, weight: jax.Array,
+             bias: Optional[jax.Array], rng: jax.Array, *,
+             num_neg_samples: int = 10,
+             sample_dist: Optional[jax.Array] = None) -> jax.Array:
+    """Noise-contrastive estimation loss (NCELayer.cpp / nce_op.cc).
+
+    hidden [B, D]; labels [B] target class ids; weight [V, D]; bias [V].
+    Negatives drawn per-batch from ``sample_dist`` (default uniform).
+    Returns mean loss over the batch.
+    """
+    B, D = hidden.shape
+    V = weight.shape[0]
+    if sample_dist is None:
+        neg = jax.random.randint(rng, (num_neg_samples,), 0, V)
+        logq_neg = jnp.full((num_neg_samples,), -jnp.log(V))
+        logq_pos = jnp.full((B,), -jnp.log(V))
+    else:
+        neg = jax.random.categorical(rng, jnp.log(sample_dist),
+                                     shape=(num_neg_samples,))
+        logq_neg = jnp.log(sample_dist[neg] + 1e-20)
+        logq_pos = jnp.log(sample_dist[labels] + 1e-20)
+
+    def logit(ids_vecs, h):
+        return jnp.einsum("bd,sd->bs", h, ids_vecs)
+
+    w_pos = weight[labels]                                  # [B, D]
+    s_pos = jnp.sum(hidden * w_pos, axis=-1)
+    w_neg = weight[neg]                                     # [S, D]
+    s_neg = logit(w_neg, hidden)                            # [B, S]
+    if bias is not None:
+        s_pos = s_pos + bias[labels]
+        s_neg = s_neg + bias[neg][None, :]
+    # NCE with k negatives: sigmoid classification of data vs noise with the
+    # log-k*q(w) correction
+    k = float(num_neg_samples)
+    pos_logit = s_pos - (jnp.log(k) + logq_pos)
+    neg_logit = s_neg - (jnp.log(k) + logq_neg[None, :])
+    loss_pos = jax.nn.softplus(-pos_logit)                  # -log sigmoid(x)
+    loss_neg = jnp.sum(jax.nn.softplus(neg_logit), axis=-1)
+    return jnp.mean(loss_pos + loss_neg)
+
+
+# ---------------------------------------------------------------- hsigmoid ---
+
+def build_huffman_codes(num_classes: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Complete-binary-tree codes (the reference uses the same implicit tree:
+    class c's path follows the bits of c+1, HierarchicalSigmoidLayer.cpp).
+
+    Returns (paths [V, L] inner-node ids, codes [V, L] 0/1 with -1 padding).
+    """
+    import numpy as np
+    L = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+    paths = np.zeros((num_classes, L), np.int32)
+    codes = np.full((num_classes, L), -1, np.int32)
+    for c in range(num_classes):
+        node = c + num_classes  # leaves occupy [V, 2V); inner nodes [1, V)
+        bits = []
+        while node > 1:
+            bits.append((node // 2, node & 1))
+            node //= 2
+        bits.reverse()
+        for i, (parent, bit) in enumerate(bits[:L]):
+            paths[c, i] = parent
+            codes[c, i] = bit
+    return jnp.asarray(paths), jnp.asarray(codes)
+
+
+def hsigmoid_loss(hidden: jax.Array, labels: jax.Array, inner_w: jax.Array,
+                  inner_b: Optional[jax.Array], paths: jax.Array,
+                  codes: jax.Array) -> jax.Array:
+    """Hierarchical-sigmoid NLL. inner_w [2V, D] (rows for inner nodes);
+    paths/codes from :func:`build_huffman_codes`. O(log V) per example."""
+    p = paths[labels]                                       # [B, L]
+    c = codes[labels]                                       # [B, L]
+    w = inner_w[p]                                          # [B, L, D]
+    logits = jnp.einsum("bld,bd->bl", w, hidden)
+    if inner_b is not None:
+        logits = logits + inner_b[p]
+    # code bit 1 -> right child: P = sigmoid(logit); bit 0 -> 1 - sigmoid
+    mask = (c >= 0).astype(logits.dtype)
+    signed = jnp.where(c == 1, logits, -logits)
+    nll = jax.nn.softplus(-signed) * mask                   # -log sigmoid(±x)
+    return jnp.mean(jnp.sum(nll, axis=-1))
+
+
+def hsigmoid_logprobs(hidden: jax.Array, inner_w: jax.Array,
+                      inner_b: Optional[jax.Array], paths: jax.Array,
+                      codes: jax.Array) -> jax.Array:
+    """Full log-distribution [B, V] (for small-V eval/testing)."""
+    V = paths.shape[0]
+    w = inner_w[paths]                                      # [V, L, D]
+    logits = jnp.einsum("vld,bd->bvl", w, hidden)
+    if inner_b is not None:
+        logits = logits + inner_b[paths][None]
+    mask = (codes >= 0).astype(logits.dtype)[None]
+    signed = jnp.where(codes[None] == 1, logits, -logits)
+    return -jnp.sum(jax.nn.softplus(-signed) * mask, axis=-1)

@@ -27,6 +27,7 @@ from .tensor_parallel import ColumnParallelLinear, RowParallelLinear, ShardedEmb
 from .ring_attention import (ring_attention, blockwise_attention,
                              ring_self_attention, ulysses_attention)
 from .pipeline import PipelineStage, pipeline_spmd
+from . import multihost
 
 __all__ = [
     "MeshSpec", "make_mesh", "local_mesh", "mesh_axis_size",
@@ -38,5 +39,5 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "ShardedEmbedding",
     "ring_attention", "blockwise_attention", "ring_self_attention",
     "ulysses_attention",
-    "PipelineStage", "pipeline_spmd",
+    "PipelineStage", "pipeline_spmd", "multihost",
 ]

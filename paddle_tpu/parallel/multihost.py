@@ -1,0 +1,68 @@
+"""Multi-host (pod / multi-slice) initialization helpers.
+
+The reference scales across machines with pserver endpoints + etcd membership
+(trainer flags trainer_id/num_gradient_servers, utils/Flags.h:19-43; cluster
+launchers paddle/scripts/cluster_train*). TPU-native: every host runs the SAME
+SPMD program; membership/coordination is jax.distributed's coordinator (GCE
+metadata on real pods), the mesh spans all hosts' devices (ICI within a slice,
+DCN across), and the data plane is the master service
+(runtime/master_service.py) sharding input chunks across hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import MeshSpec, make_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> dict:
+    """Join the multi-host job (jax.distributed.initialize wrapper).
+
+    On real TPU pods all three args auto-detect from the environment; flags
+    mirror the reference's --trainer_id/--num_gradient_servers. Returns a
+    summary dict. Safe to call single-host (no-op when nothing configured).
+    """
+    if coordinator_address or num_processes or os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def global_mesh(**axes: int) -> Mesh:
+    """Mesh over ALL devices in the job (every process constructs the same
+    mesh; jax.devices() is globally consistent)."""
+    return make_mesh(**axes)
+
+
+def process_batch_slice(global_batch_size: int) -> slice:
+    """This host's row range of the global batch — the per-process feed for
+    jax.make_array_from_process_local_data-style input pipelines."""
+    n = jax.process_count()
+    per = global_batch_size // n
+    i = jax.process_index()
+    return slice(i * per, (i + 1) * per)
+
+
+def make_global_array(local_rows: np.ndarray, mesh: Mesh, axis: str = "data"):
+    """Assemble a global device array from each process's local batch rows
+    (multi-host feed path; single-host it is a plain device_put)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, P(axis, *([None] * (local_rows.ndim - 1))))
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_rows)

@@ -1,0 +1,204 @@
+"""Network service + client for the data master — the trainer-facing RPC.
+
+Re-provides the reference's distributed data-dispatch plane:
+* Go master RPC service (go/master/service.go GetTask/TaskFinished/TaskFailed
+  RPCs) -> :class:`MasterServer` serving the native C++ TaskMaster
+  (native/task_master.cc) over a length-prefixed JSON protocol — the framing
+  discipline of ProtoServer (pserver/ProtoServer.h:36: length-framed proto
+  messages over raw sockets).
+* auto-reconnecting client (go/connection/conn.go) -> :class:`MasterClient`.
+* periodic timeout tick + snapshot (service.go:198-200, :166-227) -> the
+  server's housekeeping thread.
+
+Trainers are stateless consumers: a consumer that dies mid-task simply lets
+the lease expire; the task re-dispatches to a healthy one (elastic training,
+SURVEY.md §5 'Failure detection').
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from .master import TaskMaster
+
+_HDR = struct.Struct("<I")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return json.loads(body.decode())
+
+
+def _recv_exact(sock, n) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class MasterServer:
+    """Serve a TaskMaster over TCP with timeout housekeeping + snapshots."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 timeout_s: float = 60.0, failure_max: int = 3,
+                 snapshot_path: Optional[str] = None,
+                 tick_interval: float = 1.0):
+        self.master = TaskMaster(timeout_s=timeout_s, failure_max=failure_max)
+        if snapshot_path:
+            try:
+                self.master.restore(snapshot_path)
+            except IOError:
+                pass  # no snapshot yet
+        self.snapshot_path = snapshot_path
+        self._tick_interval = tick_interval
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    req = _recv_msg(self.request)
+                    if req is None:
+                        return
+                    _send_msg(self.request, outer._dispatch(req))
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address: Tuple[str, int] = self._server.server_address
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        h = threading.Thread(target=self._housekeeping, daemon=True)
+        h.start()
+        self._threads = [t, h]
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _housekeeping(self):
+        while not self._stop.wait(self._tick_interval):
+            self.master.tick()
+            if self.snapshot_path:
+                try:
+                    self.master.snapshot(self.snapshot_path)
+                except IOError:
+                    pass
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, req):
+        op = req.get("op")
+        if op == "set_dataset":
+            self.master.set_dataset(req["payloads"])
+            return {"ok": True}
+        if op == "get_task":
+            t = self.master.get_task()
+            if t is None:
+                return {"ok": True, "task": None,
+                        "pass_finished": self.master.pass_finished()}
+            return {"ok": True, "task": {"id": t[0], "payload": t[1]}}
+        if op == "task_finished":
+            self.master.task_finished(req["task_id"])
+            return {"ok": True}
+        if op == "task_failed":
+            return {"ok": True,
+                    "discarded": self.master.task_failed(req["task_id"])}
+        if op == "new_pass":
+            return {"ok": self.master.new_pass()}
+        if op == "stats":
+            todo, pending, done, disc, epoch = self.master.stats()
+            return {"ok": True, "todo": todo, "pending": pending,
+                    "done": done, "discarded": disc, "epoch": epoch}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class MasterClient:
+    """Auto-reconnecting client (go/connection/conn.go semantics)."""
+
+    def __init__(self, host: str, port: int, *, retries: int = 5,
+                 retry_delay: float = 0.2):
+        self.addr = (host, port)
+        self.retries = retries
+        self.retry_delay = retry_delay
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=10.0)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)  # LightNetwork
+        self._sock = s
+
+    def _call(self, req):
+        with self._lock:
+            last_err = None
+            for attempt in range(self.retries):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    _send_msg(self._sock, req)
+                    resp = _recv_msg(self._sock)
+                    if resp is None:
+                        raise ConnectionError("server closed connection")
+                    return resp
+                except (OSError, ConnectionError) as e:
+                    last_err = e
+                    self._sock = None
+                    time.sleep(self.retry_delay * (attempt + 1))
+            raise ConnectionError(f"master unreachable: {last_err}")
+
+    # -- API ---------------------------------------------------------------
+    def set_dataset(self, payloads: List[str]):
+        self._call({"op": "set_dataset", "payloads": payloads})
+
+    def get_task(self) -> Optional[Tuple[int, str]]:
+        r = self._call({"op": "get_task"})
+        if r.get("task") is None:
+            return None
+        return r["task"]["id"], r["task"]["payload"]
+
+    def task_finished(self, task_id: int):
+        self._call({"op": "task_finished", "task_id": task_id})
+
+    def task_failed(self, task_id: int) -> bool:
+        return bool(self._call({"op": "task_failed",
+                                "task_id": task_id}).get("discarded"))
+
+    def new_pass(self) -> bool:
+        return bool(self._call({"op": "new_pass"})["ok"])
+
+    def stats(self):
+        r = self._call({"op": "stats"})
+        return (r["todo"], r["pending"], r["done"], r["discarded"], r["epoch"])
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

@@ -1,0 +1,19 @@
+"""infer() facade (python/paddle/v2/inference.py:111)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .layer import LayerOutput
+from .trainer import SGD, _V2Feeder
+
+
+def infer(output_layer: LayerOutput, trainer: SGD, input,
+          feeding: Optional[Sequence[LayerOutput]] = None) -> np.ndarray:
+    """Run the trained program forward and fetch ``output_layer`` for a batch
+    of raw rows (same reader-row format as training)."""
+    feed = _V2Feeder(feeding)(input) if feeding else input
+    out, = trainer.exe.run(feed=feed, fetch_list=[output_layer.var])
+    return np.asarray(out)

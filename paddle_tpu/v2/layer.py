@@ -1,0 +1,172 @@
+"""v2 layer DSL emitting fluid ops.
+
+Mirrors the surface of python/paddle/v2/layer.py + trainer_config_helpers/
+layers.py (fc, embedding, lstmemory, conv, pooling, costs), but each call
+appends to the fluid default programs. Sequence-typed layers carry a paired
+``<name>__len__`` lengths variable (the LoD metadata under the static-shape
+regime — core/lod.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..fluid import layers as FL
+from ..fluid.framework import Variable, default_main_program
+from ..nn import initializer as I
+from .data_type import InputType
+
+
+@dataclass
+class LayerOutput:
+    var: Variable
+    lengths: Optional[Variable] = None      # set for sequence outputs
+    input_type: Optional[InputType] = None
+
+    @property
+    def name(self):
+        return self.var.name
+
+
+def data(name: str, type: InputType) -> LayerOutput:
+    """paddle.v2.layer.data analog; sequence types get a lengths feed var."""
+    if type.is_seq:
+        elem = getattr(type.slot, "elem_dim", None)
+        if elem is None:
+            v = FL.data(name, shape=(-1,), dtype="int32")
+        else:
+            v = FL.data(name, shape=(-1, elem), dtype="float32")
+        lens = FL.data(name + "__len__", shape=(), dtype="int32")
+        return LayerOutput(v, lens, type)
+    from ..data.feeder import DenseSlot, IndexSlot, SparseSlot
+    if isinstance(type.slot, DenseSlot):
+        v = FL.data(name, shape=(type.slot.dim,))
+    elif isinstance(type.slot, IndexSlot):
+        v = FL.data(name, shape=(), dtype="int32")
+    else:  # sparse: padded (ids, vals) pair
+        v = FL.data(name, shape=(-1,), dtype="int32")
+        vals = FL.data(name + "__vals__", shape=(-1,), dtype="float32")
+    return LayerOutput(v, None, type)
+
+
+def fc(input: LayerOutput, size: int, act: Optional[str] = None,
+       bias_attr: bool = True) -> LayerOutput:
+    return LayerOutput(FL.fc(input.var, size, act=act, bias_attr=bias_attr))
+
+
+def embedding(input: LayerOutput, size: int) -> LayerOutput:
+    t = input.input_type
+    if t is None or not t.vocab:
+        raise ValueError("embedding needs a data layer typed "
+                         "integer_value[_sequence](vocab_size)")
+    out = FL.embedding(input.var, (t.vocab, size))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def _seq_op(op_type, input: LayerOutput, extra_attrs=None, out_shape=None,
+            seq_out=False, params=None) -> LayerOutput:
+    b = default_main_program().global_block()
+    out = b.create_var(shape=out_shape or input.var.shape,
+                       dtype="float32")
+    inputs = {"X": [input.var.name], "Lengths": [input.lengths.name]}
+    if params:
+        inputs.update(params)
+    b.append_op(op_type, inputs, {"Out": [out.name]}, extra_attrs or {})
+    return LayerOutput(out, input.lengths if seq_out else None,
+                       input.input_type if seq_out else None)
+
+
+def lstmemory(input: LayerOutput, size: int, reverse: bool = False,
+              forget_bias: float = 1.0) -> LayerOutput:
+    """Whole-sequence masked LSTM (simple_lstm/lstmemory analog)."""
+    b = default_main_program().global_block()
+    in_dim = input.var.shape[-1]
+    w = FL._create_parameter("lstm_w", (in_dim, 4 * size), "float32",
+                             I.uniform(-0.08, 0.08))
+    u = FL._create_parameter("lstm_u", (size, 4 * size), "float32",
+                             I.uniform(-0.08, 0.08))
+    bias = FL._create_parameter("lstm_b", (4 * size,), "float32", I.zeros)
+    out = b.create_var(shape=input.var.shape[:-1] + (size,), dtype="float32")
+    last_h = b.create_var(shape=(-1, size), dtype="float32")
+    last_c = b.create_var(shape=(-1, size), dtype="float32")
+    b.append_op("lstm",
+                {"X": [input.var.name], "Lengths": [input.lengths.name],
+                 "W": [w.name], "U": [u.name], "B": [bias.name]},
+                {"Out": [out.name], "LastH": [last_h.name],
+                 "LastC": [last_c.name]},
+                {"reverse": reverse, "forget_bias": forget_bias})
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def grumemory(input: LayerOutput, size: int, reverse: bool = False) -> LayerOutput:
+    b = default_main_program().global_block()
+    in_dim = input.var.shape[-1]
+    w = FL._create_parameter("gru_w", (in_dim, 3 * size), "float32",
+                             I.uniform(-0.08, 0.08))
+    u = FL._create_parameter("gru_u", (size, 3 * size), "float32",
+                             I.uniform(-0.08, 0.08))
+    bias = FL._create_parameter("gru_b", (3 * size,), "float32", I.zeros)
+    out = b.create_var(shape=input.var.shape[:-1] + (size,), dtype="float32")
+    last = b.create_var(shape=(-1, size), dtype="float32")
+    b.append_op("gru",
+                {"X": [input.var.name], "Lengths": [input.lengths.name],
+                 "W": [w.name], "U": [u.name], "B": [bias.name]},
+                {"Out": [out.name], "LastH": [last.name]},
+                {"reverse": reverse})
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def pooling(input: LayerOutput, pooling_type: str = "max") -> LayerOutput:
+    """Sequence pooling (SequencePoolLayer): max|average|sum."""
+    return _seq_op("sequence_pool", input,
+                   {"pool_type": pooling_type},
+                   out_shape=(-1, input.var.shape[-1]))
+
+
+def last_seq(input: LayerOutput) -> LayerOutput:
+    return _seq_op("sequence_last_step", input,
+                   out_shape=(-1, input.var.shape[-1]))
+
+
+def first_seq(input: LayerOutput) -> LayerOutput:
+    return _seq_op("sequence_first_step", input,
+                   out_shape=(-1, input.var.shape[-1]))
+
+
+def concat(inputs: List[LayerOutput], axis: int = -1) -> LayerOutput:
+    return LayerOutput(FL.concat([i.var for i in inputs], axis=axis))
+
+
+def dropout(input: LayerOutput, dropout_rate: float) -> LayerOutput:
+    return LayerOutput(FL.dropout(input.var, dropout_rate, is_test=False),
+                       input.lengths, input.input_type)
+
+
+def img_conv(input: LayerOutput, num_filters: int, filter_size: int,
+             stride: int = 1, padding: int = 0,
+             act: Optional[str] = "relu") -> LayerOutput:
+    return LayerOutput(FL.conv2d(input.var, num_filters, filter_size,
+                                 stride=stride, padding=padding, act=act))
+
+
+def img_pool(input: LayerOutput, pool_size: int = 2, pool_type: str = "max",
+             stride: Optional[int] = None) -> LayerOutput:
+    return LayerOutput(FL.pool2d(input.var, pool_size, pool_type,
+                                 pool_stride=stride))
+
+
+# ------------------------------------------------------------------- costs ---
+
+def classification_cost(input: LayerOutput, label: LayerOutput) -> LayerOutput:
+    loss = FL.softmax_with_cross_entropy(input.var, label.var)
+    return LayerOutput(FL.mean(loss))
+
+
+def cross_entropy_cost(input: LayerOutput, label: LayerOutput) -> LayerOutput:
+    return LayerOutput(FL.mean(FL.cross_entropy(input.var, label.var)))
+
+
+def square_error_cost(input: LayerOutput, label: LayerOutput) -> LayerOutput:
+    d = FL.elementwise_sub(input.var, label.var)
+    return LayerOutput(FL.mean(FL.elementwise_mul(d, d)))

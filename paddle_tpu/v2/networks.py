@@ -1,0 +1,70 @@
+"""Prebuilt networks (trainer_config_helpers/networks.py analog):
+simple_lstm:553, bidirectional_lstm:1230, text_conv_pool, simple_img_conv_pool:144,
+vgg_16_network:468."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..fluid import layers as FL
+from ..fluid.framework import default_main_program
+from ..nn import initializer as I
+from . import layer as L
+from .layer import LayerOutput
+
+
+def simple_lstm(input: LayerOutput, size: int, reverse: bool = False) -> LayerOutput:
+    """networks.py simple_lstm — the reference projects inputs to 4*size then
+    runs lstmemory; our lstm op fuses that projection (one MXU matmul)."""
+    return L.lstmemory(input, size, reverse=reverse)
+
+
+def bidirectional_lstm(input: LayerOutput, size: int,
+                       return_concat: bool = True) -> LayerOutput:
+    fwd = L.lstmemory(input, size)
+    bwd = L.lstmemory(input, size, reverse=True)
+    last_f = L.last_seq(fwd)
+    first_b = L.first_seq(bwd)
+    return L.concat([last_f, first_b], axis=-1)
+
+
+def text_conv_pool(input: LayerOutput, hidden_size: int,
+                   context_len: int = 3) -> LayerOutput:
+    """sequence conv + max pool (networks.py text_conv_pool)."""
+    b = default_main_program().global_block()
+    in_dim = input.var.shape[-1]
+    filt = FL._create_parameter("seqconv_w", (context_len * in_dim, hidden_size),
+                                "float32", I.uniform(-0.08, 0.08))
+    out = b.create_var(shape=input.var.shape[:-1] + (hidden_size,),
+                       dtype="float32")
+    b.append_op("sequence_conv",
+                {"X": [input.var.name], "Lengths": [input.lengths.name],
+                 "Filter": [filt.name]},
+                {"Out": [out.name]},
+                {"context_start": -(context_len // 2),
+                 "context_length": context_len})
+    h = LayerOutput(FL.relu(out), input.lengths, input.input_type)
+    return L.pooling(h, "max")
+
+
+def simple_img_conv_pool(input: LayerOutput, filter_size: int,
+                         num_filters: int, pool_size: int,
+                         act: str = "relu") -> LayerOutput:
+    conv = L.img_conv(input, num_filters, filter_size, act=act)
+    return L.img_pool(conv, pool_size)
+
+
+def vgg_16_network(input_image: LayerOutput, num_classes: int = 1000,
+                   width_mult: float = 1.0) -> LayerOutput:
+    """VGG-16 conv stack (networks.py vgg_16_network:468)."""
+    cfg = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    h = input_image
+    for n, ch in cfg:
+        ch = max(8, int(ch * width_mult))
+        for _ in range(n):
+            h = L.img_conv(h, ch, 3, padding=1, act="relu")
+        h = L.img_pool(h, 2)
+    h = LayerOutput(FL.pool2d(h.var, global_pooling=True))
+    h = L.fc(h, 512, act="relu")
+    h = L.fc(h, 512, act="relu")
+    return L.fc(h, num_classes)

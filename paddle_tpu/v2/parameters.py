@@ -1,0 +1,43 @@
+"""Parameters facade (python/paddle/v2/parameters.py analog): numpy get/set
+over the executor scope + tar serialization (:296-358 to_tar/from_tar)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fluid.executor import Scope
+from ..fluid.framework import Program
+from ..trainer.checkpoint import from_tar, to_tar
+
+
+class Parameters:
+    def __init__(self, scope: Scope, program: Program):
+        self._scope = scope
+        self._program = program
+
+    def names(self) -> List[str]:
+        b = self._program.global_block()
+        return [n for n, v in b.vars.items()
+                if v.persistable and self._scope.has(n)]
+
+    def get(self, name: str) -> np.ndarray:
+        return np.asarray(self._scope.get(name))
+
+    def set(self, name: str, value: np.ndarray):
+        self._scope.set(name, jnp.asarray(value))
+
+    def __getitem__(self, name):
+        return self.get(name)
+
+    def __setitem__(self, name, value):
+        self.set(name, value)
+
+    def to_tar(self, f):
+        to_tar(f, {n: self.get(n) for n in self.names()})
+
+    def from_tar(self, f):
+        for name, arr in from_tar(f).items():
+            self.set(name, arr)

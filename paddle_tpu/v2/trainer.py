@@ -1,0 +1,88 @@
+"""v2 SGD trainer facade (python/paddle/v2/trainer.py:24-202).
+
+Same event-driven reader loop as the reference's SGD.train, executing the
+fluid Program the v2 layers emitted (one compiled XLA step, executable-cached
+by the Executor).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.lod import SeqBatch
+from ..data.feeder import DataFeeder
+from ..fluid.executor import Executor, Scope
+from ..fluid.framework import (default_main_program, default_startup_program)
+from ..trainer import event as EV
+from .layer import LayerOutput
+from .parameters import Parameters
+
+
+class _V2Feeder:
+    """Map reader rows -> executor feed dict per the data layers' types.
+
+    Sequence slots expand to (name, name__len__) feeds (the LoD pair)."""
+
+    def __init__(self, data_layers: Sequence[LayerOutput]):
+        self.layers = list(data_layers)
+        self.feeder = DataFeeder([dl.input_type.slot for dl in self.layers])
+
+    def __call__(self, rows) -> Dict[str, np.ndarray]:
+        cols = self.feeder.feed(rows)
+        feed: Dict[str, np.ndarray] = {}
+        for dl, col in zip(self.layers, cols):
+            base = dl.var.name
+            if isinstance(col, SeqBatch):
+                feed[base] = col.data
+                feed[base + "__len__"] = col.lengths
+            elif isinstance(col, tuple):      # sparse (ids, vals)
+                feed[base] = col[0]
+                feed[base + "__vals__"] = col[1]
+            else:
+                feed[base] = col
+        return feed
+
+
+class SGD:
+    """trainer.SGD(cost, parameters=None, update_equation=optimizer)."""
+
+    def __init__(self, cost: LayerOutput, update_equation,
+                 extra_layers: Optional[List[LayerOutput]] = None):
+        self.cost = cost
+        self.extra = extra_layers or []
+        self.exe = Executor(scope=Scope())
+        update_equation.fluid_opt.minimize(cost.var)
+        self.exe.run(default_startup_program())
+        self.parameters = Parameters(self.exe.scope, default_main_program())
+
+    def train(self, reader: Callable[[], Iterable], *, num_passes: int = 1,
+              event_handler: Optional[Callable] = None,
+              feeding: Optional[Sequence[LayerOutput]] = None):
+        """reader yields row-batches (use paddle_tpu.v2.batch); ``feeding``
+        lists the data layers in row order."""
+        event_handler = event_handler or (lambda e: None)
+        feeder = _V2Feeder(feeding) if feeding else None
+        fetches = [self.cost.var] + [e.var for e in self.extra]
+        for pass_id in range(num_passes):
+            event_handler(EV.BeginPass(pass_id))
+            for batch_id, rows in enumerate(reader()):
+                event_handler(EV.BeginIteration(pass_id, batch_id))
+                feed = feeder(rows) if feeder else rows
+                outs = self.exe.run(feed=feed, fetch_list=fetches)
+                metrics = {e.var.name: float(np.asarray(o).mean())
+                           for e, o in zip(self.extra, outs[1:])}
+                event_handler(EV.EndIteration(pass_id, batch_id,
+                                              float(outs[0]), None, metrics))
+            event_handler(EV.EndPass(pass_id))
+
+    def test(self, reader, feeding: Optional[Sequence[LayerOutput]] = None):
+        feeder = _V2Feeder(feeding) if feeding else None
+        total, n = 0.0, 0
+        for rows in reader():
+            feed = feeder(rows) if feeder else rows
+            c, = self.exe.run(feed=feed, fetch_list=[self.cost.var])
+            total += float(c)
+            n += 1
+        return EV.TestResult(0, total / max(n, 1))

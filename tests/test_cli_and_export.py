@@ -1,0 +1,96 @@
+"""CLI + inference-export tests (paddle CLI submit_local.sh.in job parity;
+merged inference model of MergeModel.cpp/capi)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+CONFIG = textwrap.dedent("""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.data.dataset import uci_housing
+
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(13))
+    y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(x, 1)
+    cost = paddle.layer.square_error_cost(pred, y)
+    optimizer = paddle.optimizer.SGD(0.05)
+    train_reader = paddle.batch(uci_housing.train(128), 32)
+    test_reader = paddle.batch(uci_housing.test(64), 32)
+    feeding = [x, y]
+    outputs = [pred]
+""")
+
+
+@pytest.fixture
+def config_file(tmp_path):
+    p = tmp_path / "cfg.py"
+    p.write_text(CONFIG)
+    return str(p)
+
+
+def _run(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-m", "paddle_tpu", *argv],
+                       capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r.stdout
+
+
+def test_cli_version():
+    out = _run("version")
+    assert "paddle_tpu" in out
+
+
+def test_cli_train_test_time_dump(config_file, tmp_path):
+    save = str(tmp_path / "out")
+    out = _run("train", "--config", config_file, "--num_passes", "2",
+               "--save_dir", save, "--log_period", "2")
+    assert "pass 1 done" in out
+    assert os.path.exists(os.path.join(save, "pass-00001", "params.tar"))
+    assert os.path.exists(os.path.join(save, "inference", "model.json"))
+
+    out = _run("test", "--config", config_file, "--init_model_path",
+               os.path.join(save, "pass-00001", "params.tar"))
+    assert json.loads(out.strip().splitlines()[-1])["cost"] >= 0
+
+    out = _run("time", "--config", config_file, "--iters", "4")
+    assert json.loads(out.strip().splitlines()[-1])["ms_per_batch"] > 0
+
+    out = _run("dump_config", "--config", config_file)
+    d = json.loads(out)
+    assert d["blocks"][0]["ops"]
+
+
+def test_export_load_inference_model(tmp_path):
+    fluid.reset_default_programs()
+    fluid.executor._global_scope = fluid.Scope()
+    x = fluid.layers.data("x", shape=(4,))
+    h = fluid.layers.fc(x, 8, act="tanh")
+    out = fluid.layers.fc(h, 2)
+    loss = fluid.layers.mean(out)
+    fluid.SGDOptimizer(0.1).minimize(loss)   # training ops present
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = np.ones((3, 4), np.float32)
+    d = str(tmp_path / "model")
+    fluid.io.export_inference_model(d, ["x"], [out], exe)
+    # reference forward via the pruned program (running the full training
+    # block would also fire the sgd op and mutate params)
+    infer_prog = fluid.default_main_program().prune([out.name])
+    ref = exe.run(infer_prog, feed={"x": xs}, fetch_list=[out])[0]
+
+    # fresh scope + executor; the loaded program must not contain training ops
+    exe2 = fluid.Executor(scope=fluid.Scope())
+    prog, feeds, fetches = fluid.io.load_inference_model(d, exe2)
+    assert feeds == ["x"] and fetches == [out.name]
+    types = {op.type for op in prog.global_block().ops}
+    assert "autodiff_grad" not in types and "sgd" not in types
+    got = exe2.run(prog, feed={"x": xs}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)

@@ -1,0 +1,143 @@
+"""Detection suite + NCE/hsigmoid op tests (op-level, SURVEY.md §4.1 style)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import detection as D
+from paddle_tpu.ops import nce as N
+from paddle_tpu.ops.conv import bilinear_interp, maxout
+
+
+def test_prior_box_shapes_and_range():
+    boxes, variances = D.prior_box((4, 4), (64, 64), min_size=16.0,
+                                   max_size=32.0, aspect_ratios=(2.0,))
+    # P = 1(min) + 1(sqrt) + 2(ar 2, flip) = 4 per cell
+    assert boxes.shape == (4 * 4 * 4, 4) and variances.shape == boxes.shape
+    assert float(boxes.min()) >= 0.0 and float(boxes.max()) <= 1.0
+    # xmax > xmin for all
+    assert np.all(np.asarray(boxes[:, 2] >= boxes[:, 0]))
+
+
+def test_iou_and_encode_decode_roundtrip():
+    a = jnp.array([[0.0, 0.0, 0.5, 0.5]])
+    b = jnp.array([[0.25, 0.25, 0.75, 0.75], [0.0, 0.0, 0.5, 0.5]])
+    iou = D.iou_matrix(a, b)
+    np.testing.assert_allclose(np.asarray(iou[0]), [0.0625 / 0.4375, 1.0],
+                               rtol=1e-5)
+    priors = jnp.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]])
+    var = jnp.full((2, 4), 0.1)
+    gt = jnp.array([[0.15, 0.12, 0.43, 0.45], [0.52, 0.48, 0.88, 0.95]])
+    enc = D.encode_boxes(gt, priors, var)
+    dec = D.decode_boxes(enc, priors, var)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(gt), atol=1e-5)
+
+
+def test_match_priors_force_match():
+    priors = jnp.array([[0.0, 0.0, 0.3, 0.3], [0.6, 0.6, 1.0, 1.0]])
+    gt = jnp.array([[0.65, 0.6, 0.95, 1.0], [0.0, 0.0, 0.0, 0.0]])
+    mask = jnp.array([1.0, 0.0])
+    matched, pos = D.match_priors(priors, gt, mask, threshold=0.5)
+    assert bool(pos[1]) and not bool(pos[0])
+    assert int(matched[1]) == 0
+
+
+def test_multibox_loss_decreases_with_better_preds():
+    rs = np.random.RandomState(0)
+    priors, var = D.prior_box((4, 4), (32, 32), min_size=8.0)
+    Np = priors.shape[0]
+    gt = jnp.array([[0.2, 0.2, 0.5, 0.5]])
+    gt_labels = jnp.array([1])
+    gt_mask = jnp.array([1.0])
+    matched, pos = D.match_priors(priors, gt, gt_mask)
+    perfect_loc = D.encode_boxes(gt[matched], priors, var)
+    good_conf = jnp.where(pos[:, None],
+                          jnp.array([[-5.0, 5.0]]), jnp.array([[5.0, -5.0]]))
+    l_good = D.multibox_loss(perfect_loc, good_conf, priors, var, gt,
+                             gt_labels, gt_mask)
+    bad_loc = jnp.asarray(rs.randn(Np, 4), jnp.float32)
+    bad_conf = jnp.asarray(rs.randn(Np, 2), jnp.float32)
+    l_bad = D.multibox_loss(bad_loc, bad_conf, priors, var, gt, gt_labels,
+                            gt_mask)
+    assert float(l_good) < float(l_bad)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.array([[0.0, 0.0, 0.5, 0.5],
+                       [0.01, 0.01, 0.51, 0.51],     # dup of 0
+                       [0.6, 0.6, 0.9, 0.9]])
+    scores = jnp.array([0.9, 0.8, 0.7])
+    b, s, v = D.nms(boxes, scores, iou_threshold=0.5, top_k=3)
+    assert np.asarray(v).sum() == 2                   # dup suppressed
+    assert float(s[0]) == pytest.approx(0.9)
+    assert bool(v[1] == 0)
+
+
+def test_detection_output_shapes():
+    priors, var = D.prior_box((2, 2), (32, 32), min_size=8.0)
+    Np = priors.shape[0]
+    rs = np.random.RandomState(0)
+    loc = jnp.asarray(rs.randn(Np, 4) * 0.1, jnp.float32)
+    conf = jnp.asarray(rs.randn(Np, 3), jnp.float32)
+    b, s, v = D.detection_output(loc, conf, priors, var, num_classes=3,
+                                 keep_top_k=5)
+    assert b.shape == (2, 5, 4) and s.shape == (2, 5) and v.shape == (2, 5)
+
+
+def test_nce_loss_learns_direction():
+    """NCE gradient should pull the target row toward the hidden vector."""
+    rs = np.random.RandomState(0)
+    V, Dm, B = 50, 8, 4
+    weight = jnp.asarray(rs.randn(V, Dm) * 0.1, jnp.float32)
+    bias = jnp.zeros((V,))
+    hidden = jnp.asarray(rs.randn(B, Dm), jnp.float32)
+    labels = jnp.array([3, 7, 3, 9])
+    rng = jax.random.PRNGKey(0)
+
+    def loss(w):
+        return N.nce_loss(hidden, labels, w, bias, rng, num_neg_samples=20)
+
+    l0 = float(loss(weight))
+    g = jax.grad(loss)(weight)
+    w2 = weight - 0.5 * g
+    assert float(loss(w2)) < l0
+    # untouched rows (not target, not sampled often) have ~zero grad for most
+    assert np.abs(np.asarray(g)[labels]).sum() > 0
+
+
+def test_hsigmoid_is_valid_distribution_and_trains():
+    V, Dm, B = 16, 8, 8
+    rs = np.random.RandomState(1)
+    paths, codes = N.build_huffman_codes(V)
+    inner_w = jnp.asarray(rs.randn(2 * V, Dm) * 0.1, jnp.float32)
+    inner_b = jnp.zeros((2 * V,))
+    hidden = jnp.asarray(rs.randn(B, Dm), jnp.float32)
+    logp = N.hsigmoid_logprobs(hidden, inner_w, inner_b, paths, codes)
+    # probabilities over classes sum to 1 (complete binary tree)
+    np.testing.assert_allclose(np.exp(np.asarray(logp)).sum(-1),
+                               np.ones(B), rtol=1e-4)
+    labels = jnp.asarray(rs.randint(0, V, B))
+
+    def loss(w):
+        return N.hsigmoid_loss(hidden, labels, w, inner_b, paths, codes)
+
+    l0 = float(loss(inner_w))
+    w2 = inner_w - 0.5 * jax.grad(loss)(inner_w)
+    assert float(loss(w2)) < l0
+    # loss equals NLL computed from the full distribution
+    nll = -np.asarray(logp)[np.arange(B), np.asarray(labels)].mean()
+    np.testing.assert_allclose(l0, nll, rtol=1e-5)
+
+
+def test_bilinear_interp_and_maxout():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    up = bilinear_interp(x, 8, 8)
+    assert up.shape == (1, 8, 8, 1)
+    np.testing.assert_allclose(float(up[0, 0, 0, 0]), 0.0)
+    np.testing.assert_allclose(float(up[0, -1, -1, 0]), 15.0)
+    # identity when resizing to same size
+    same = bilinear_interp(x, 4, 4)
+    np.testing.assert_allclose(np.asarray(same), np.asarray(x), atol=1e-6)
+    m = maxout(jnp.arange(8.0).reshape(1, 1, 1, 8), groups=2)
+    np.testing.assert_allclose(np.asarray(m)[0, 0, 0], [1, 3, 5, 7])

@@ -1,0 +1,124 @@
+"""Master service tests: in-process server + clients, elastic re-dispatch —
+the reference's in-process multi-node strategy (SURVEY.md §4.3: pserver
+objects on localhost ports inside the test process)."""
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.runtime import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native toolchain unavailable")
+
+from paddle_tpu.runtime.master_service import MasterClient, MasterServer  # noqa: E402
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = MasterServer(timeout_s=1.0, failure_max=3,
+                       snapshot_path=str(tmp_path / "m.snap"),
+                       tick_interval=0.2).start()
+    yield srv
+    srv.stop()
+
+
+def _client(server):
+    return MasterClient(server.address[0], server.address[1])
+
+
+def test_dispatch_over_network(server):
+    c = _client(server)
+    c.set_dataset([f"chunk{i}" for i in range(5)])
+    got = []
+    while True:
+        t = c.get_task()
+        if t is None:
+            break
+        got.append(t[1])
+        c.task_finished(t[0])
+    assert sorted(got) == [f"chunk{i}" for i in range(5)]
+    assert c.new_pass()
+    assert c.stats()[0] == 5  # todo refilled
+
+
+def test_elastic_redispatch_on_consumer_death(server):
+    """Consumer A leases a task and dies; the lease expires via the server's
+    tick thread and consumer B completes the pass."""
+    a, b = _client(server), _client(server)
+    a.set_dataset(["t0", "t1"])
+    dead_task = a.get_task()
+    assert dead_task is not None
+    a.close()                         # A dies holding its task
+
+    done = []
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        t = b.get_task()
+        if t is None:
+            if b.stats()[2] == 2:     # done == 2
+                break
+            time.sleep(0.2)
+            continue
+        done.append(t[1])
+        b.task_finished(t[0])
+    assert dead_task[1] in done       # the orphaned task was re-dispatched
+
+
+def test_concurrent_clients(server):
+    c0 = _client(server)
+    c0.set_dataset([f"c{i}" for i in range(40)])
+    got, lock = [], threading.Lock()
+
+    def worker():
+        c = _client(server)
+        while True:
+            t = c.get_task()
+            if t is None:
+                todo, pending, done, disc, epoch = c.stats()
+                if todo == 0 and pending == 0:
+                    return
+                time.sleep(0.05)
+                continue
+            with lock:
+                got.append(t[1])
+            c.task_finished(t[0])
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert sorted(got) == sorted(f"c{i}" for i in range(40))
+
+
+def test_snapshot_written_and_recovered(server, tmp_path):
+    c = _client(server)
+    c.set_dataset(["a", "b", "c"])
+    t = c.get_task()
+    c.task_finished(t[0])
+    time.sleep(0.5)                   # let the housekeeping thread snapshot
+
+    srv2 = MasterServer(timeout_s=1.0, snapshot_path=str(tmp_path / "m.snap"),
+                        tick_interval=0.2).start()
+    try:
+        c2 = _client(srv2)
+        todo, pending, done, disc, epoch = c2.stats()
+        assert done == 1 and todo == 2 and pending == 0
+    finally:
+        srv2.stop()
+
+
+def test_multihost_helpers_single_process():
+    import numpy as np
+
+    from paddle_tpu import parallel as pp
+    from paddle_tpu.parallel import multihost as mh
+    info = mh.initialize()
+    assert info["process_count"] == 1
+    mesh = mh.global_mesh(data=8)
+    sl = mh.process_batch_slice(64)
+    assert sl == slice(0, 64)
+    arr = mh.make_global_array(np.ones((16, 4), np.float32), mesh)
+    assert arr.shape == (16, 4)

@@ -1,0 +1,92 @@
+"""v2 facade end-to-end tests — the quick_start / fit_a_line demos driven
+through the paddle.v2-style API (SURVEY.md §2.4 python/paddle/v2)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.data.dataset import imdb, uci_housing
+from paddle_tpu.trainer import event
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    yield
+
+
+def test_fit_a_line_v2():
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(13))
+    y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(x, 1)
+    cost = paddle.layer.square_error_cost(pred, y)
+
+    trainer = paddle.SGD(cost, paddle.optimizer.SGD(0.01))
+    costs = []
+    trainer.train(paddle.batch(uci_housing.train(256), 64),
+                  num_passes=8,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, event.EndIteration) else None,
+                  feeding=[x, y])
+    assert costs[-1] < costs[0] * 0.5
+
+    # inference on test rows
+    rows = list(uci_housing.test(8)())
+    out = paddle.infer(pred, trainer, rows, feeding=[x, y])
+    assert out.shape == (8, 1)
+
+    # parameters facade: names, get/set, tar roundtrip
+    params = trainer.parameters
+    assert len(params.names()) >= 2
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    name = params.names()[0]
+    orig = params.get(name)
+    params.set(name, np.zeros_like(orig))
+    buf.seek(0)
+    params.from_tar(buf)
+    np.testing.assert_allclose(params.get(name), orig)
+
+
+def test_quickstart_lstm_text_classification():
+    """quick_start trainer_config.lstm.py analog over the v2 facade."""
+    words = paddle.layer.data("words",
+                              paddle.data_type.integer_value_sequence(imdb.VOCAB))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(words, 32)
+    lstm = paddle.networks.simple_lstm(emb, 32)
+    pooled = paddle.layer.pooling(lstm, "max")
+    logits = paddle.layer.fc(pooled, 2)
+    cost = paddle.layer.classification_cost(logits, label)
+
+    trainer = paddle.SGD(cost, paddle.optimizer.Adam(1e-2))
+    costs = []
+    trainer.train(paddle.batch(imdb.train(256), 32), num_passes=3,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, event.EndIteration) else None,
+                  feeding=[words, label])
+    assert costs[-1] < costs[0] * 0.7
+    tr = trainer.test(paddle.batch(imdb.test(64), 32), feeding=[words, label])
+    assert tr.cost > 0
+
+
+def test_bidirectional_lstm_and_text_conv():
+    words = paddle.layer.data("w",
+                              paddle.data_type.integer_value_sequence(imdb.VOCAB))
+    label = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(words, 16)
+    bi = paddle.networks.bidirectional_lstm(emb, 16)
+    conv = paddle.networks.text_conv_pool(emb, 16)
+    h = paddle.layer.concat([bi, conv])
+    logits = paddle.layer.fc(h, 2)
+    cost = paddle.layer.classification_cost(logits, label)
+    trainer = paddle.SGD(cost, paddle.optimizer.Adam(1e-2))
+    costs = []
+    trainer.train(paddle.batch(imdb.train(128), 32), num_passes=2,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, event.EndIteration) else None,
+                  feeding=[words, label])
+    assert costs[-1] < costs[0]
