@@ -70,15 +70,23 @@ void ptm_set_dataset(void* h, const char** payloads, int n) {
 
 // GetTask (service.go:366 GetTask): todo -> pending with deadline.
 // Returns task id >= 0, -1 if nothing available, -2 if pass finished
-// (todo+pending empty). `now` is caller-supplied monotonic seconds.
-int ptm_get_task(void* h, double now, char* buf, int buflen) {
+// (todo+pending empty), -3 if buf is too small — then *needed holds the
+// required byte count (incl. NUL) and the task is NOT dequeued, so the
+// caller can reallocate and retry (recordio peek/seek-back pattern; a
+// truncate-and-consume here would silently corrupt large chunk payloads).
+// `now` is caller-supplied monotonic seconds.
+int ptm_get_task(void* h, double now, char* buf, int buflen, int* needed) {
   auto* m = static_cast<Master*>(h);
   std::lock_guard<std::mutex> g(m->mu);
   if (m->todo.empty()) return m->pending.empty() ? -2 : -1;
-  Task t = m->todo.front();
+  Task& front = m->todo.front();
+  int want = (int)front.payload.size() + 1;
+  if (needed) *needed = want;
+  if (want > buflen) return -3;
+  Task t = std::move(front);
   m->todo.pop_front();
   t.deadline = now + m->timeout_s;
-  snprintf(buf, buflen, "%s", t.payload.c_str());
+  memcpy(buf, t.payload.c_str(), want);
   int id = t.id;
   m->pending[id] = std::move(t);
   return id;

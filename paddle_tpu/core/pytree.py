@@ -14,33 +14,68 @@ from typing import Any, Dict, List, Tuple
 _IDX = re.compile(r"@\d+")
 
 
-def flatten_path_tree(tree, prefix: str = "") -> List[Tuple[str, Any]]:
-    out: List[Tuple[str, Any]] = []
+def _walk(tree, prefix: str = ""):
+    """Single traversal defining the path grammar (dict keys joined with '/',
+    list/tuple indices as '@i'). Yields (path, kind, node) with kind in
+    {'dict', 'list', 'tuple', 'leaf'} — every other walker derives from this
+    so the grammar can't desynchronize."""
     if isinstance(tree, dict):
+        yield prefix, "dict", tree
         for k, v in tree.items():
-            out.extend(flatten_path_tree(v, f"{prefix}/{k}" if prefix else str(k)))
+            yield from _walk(v, f"{prefix}/{k}" if prefix else str(k))
     elif isinstance(tree, (list, tuple)):
+        yield prefix, "tuple" if isinstance(tree, tuple) else "list", tree
         for i, v in enumerate(tree):
-            out.extend(flatten_path_tree(v, f"{prefix}/@{i}" if prefix else f"@{i}"))
+            yield from _walk(v, f"{prefix}/@{i}" if prefix else f"@{i}")
     else:
-        out.append((prefix, tree))
-    return out
+        yield prefix, "leaf", tree
 
 
-def unflatten_path_tree(flat: Dict[str, Any]):
+def flatten_path_tree(tree, prefix: str = "") -> List[Tuple[str, Any]]:
+    return [(p, node) for p, kind, node in _walk(tree, prefix) if kind == "leaf"]
+
+
+def tree_spec(tree, prefix: str = "") -> Dict[str, str]:
+    """Record container kinds by path — including *empty* dicts/lists/tuples,
+    which carry no leaves and would otherwise vanish in a flatten/unflatten
+    round-trip (e.g. SGD optimizer slots are ``{}`` per param)."""
+    return {p: kind for p, kind, _ in _walk(tree, prefix) if kind != "leaf"}
+
+
+def unflatten_path_tree(flat: Dict[str, Any], spec: Dict[str, str] | None = None):
+    """Rebuild a nested tree from ``{path: leaf}``.
+
+    With a ``spec`` from :func:`tree_spec`, empty containers are recreated and
+    list-vs-tuple identity is preserved; without one, containers are inferred
+    (all-``@i`` keys become lists).
+    """
     root: Dict[str, Any] = {}
-    for path, leaf in flat.items():
-        keys = path.split("/")
-        node = root
-        for k in keys[:-1]:
-            node = node.setdefault(k, {})
-        node[keys[-1]] = leaf
 
-    def fix(node):
-        if isinstance(node, dict):
-            if node and all(_IDX.fullmatch(k) for k in node):
-                return [fix(node[f"@{i}"]) for i in range(len(node))]
-            return {k: fix(v) for k, v in node.items()}
+    def ensure(path):
+        node = root
+        if path:
+            for k in path.split("/"):
+                node = node.setdefault(k, {})
         return node
 
-    return fix(root)
+    if spec:
+        for p in spec:
+            ensure(p)
+    for path, leaf in flat.items():
+        keys = path.split("/")
+        node = ensure("/".join(keys[:-1]))
+        node[keys[-1]] = leaf
+
+    def fix(node, p):
+        if isinstance(node, dict):
+            kind = spec.get(p) if spec else None
+            if kind is None:
+                kind = "list" if node and all(_IDX.fullmatch(k) for k in node) else "dict"
+            if kind in ("list", "tuple"):
+                items = [fix(node[f"@{i}"], f"{p}/@{i}" if p else f"@{i}")
+                         for i in range(len(node))]
+                return tuple(items) if kind == "tuple" else items
+            return {k: fix(v, f"{p}/{k}" if p else k) for k, v in node.items()}
+        return node
+
+    return fix(root, "")

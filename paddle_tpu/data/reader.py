@@ -66,7 +66,7 @@ def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
         its = [r() for r in readers]
         for items in (zip(*its) if not check_alignment
                       else itertools.zip_longest(*its, fillvalue=_SENTINEL)):
-            if check_alignment and _SENTINEL in items:
+            if check_alignment and any(i is _SENTINEL for i in items):
                 raise ValueError("composed readers have different lengths")
             yield sum((_to_tuple(i) for i in items), ())
 

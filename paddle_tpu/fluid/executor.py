@@ -130,7 +130,7 @@ class Executor:
                    if n in block.vars and block.vars[n].persistable]
         written = list(dict.fromkeys(written))
 
-        key = (id(program), len(block.ops), tuple(fetch_names),
+        key = (program._serial, program.version, block.idx, tuple(fetch_names),
                tuple(persist_in),
                tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed.items())))
         fn = self._cache.get(key) if use_cache else None

@@ -112,6 +112,7 @@ class Block:
     def append_op(self, op_type: str, inputs, outputs, attrs=None) -> Operator:
         op = Operator(self, op_type, inputs, outputs, attrs)
         self.ops.append(op)
+        self.program.version += 1
         return op
 
     def all_parameters(self) -> List[Variable]:
@@ -127,9 +128,17 @@ class Program:
     """ProgramDesc analog. Two default programs mirror fluid's
     default_startup_program (param init ops) + default_main_program."""
 
+    _serial_counter = 0
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self._name_counter = 0
+        # Monotonic identity + mutation stamp for the executor's compiled-fn
+        # cache: id(program) can be reused after GC, and an op list edited in
+        # place must invalidate the cache (the reference recompiles per Run).
+        Program._serial_counter += 1
+        self._serial = Program._serial_counter
+        self.version = 0
 
     def global_block(self) -> Block:
         return self.blocks[0]

@@ -114,10 +114,14 @@ def match_priors(priors: jax.Array, gt_boxes: jax.Array, gt_mask: jax.Array,
     # force-match: the best prior for each (real) gt becomes positive for it
     best_prior = jnp.argmax(iou, axis=0)                    # [G]
     N, G = iou.shape
+    # padded gts (iou all zero) argmax to prior 0 — route them out of bounds
+    # so mode="drop" discards them instead of racing a real match at index 0
+    # (XLA scatter order with duplicate indices is unspecified)
+    best_prior = jnp.where(gt_mask > 0, best_prior, N)
     forced = jnp.zeros((N,), jnp.int32).at[best_prior].set(
         jnp.arange(G, dtype=jnp.int32), mode="drop")
     force_mask = jnp.zeros((N,), bool).at[best_prior].set(
-        gt_mask > 0, mode="drop")
+        True, mode="drop")
     matched = jnp.where(force_mask, forced, best_gt)
     pos = pos | force_mask
     return matched, pos

@@ -22,7 +22,7 @@ def load_library() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO):
+        if _needs_build():
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR, "-j4"],
                                check=True, capture_output=True, timeout=120)
@@ -37,6 +37,20 @@ def load_library() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+def _needs_build() -> bool:
+    """Rebuild when any source is newer than the .so — a stale binary with an
+    old C ABI would be silently called with the new signature otherwise."""
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    try:
+        entries = os.listdir(_NATIVE_DIR)
+    except OSError:
+        return False
+    return any(os.path.getmtime(os.path.join(_NATIVE_DIR, n)) > so_mtime
+               for n in entries if n.endswith((".cc", ".h")) or n == "Makefile")
+
+
 def native_available() -> bool:
     return load_library() is not None
 
@@ -49,7 +63,8 @@ def _configure(lib: ctypes.CDLL):
     lib.ptm_destroy.argtypes = [c.c_void_p]
     lib.ptm_set_dataset.argtypes = [c.c_void_p, c.POINTER(c.c_char_p), c.c_int]
     lib.ptm_get_task.restype = c.c_int
-    lib.ptm_get_task.argtypes = [c.c_void_p, c.c_double, c.c_char_p, c.c_int]
+    lib.ptm_get_task.argtypes = [c.c_void_p, c.c_double, c.c_char_p, c.c_int,
+                                 c.POINTER(c.c_int)]
     lib.ptm_task_finished.argtypes = [c.c_void_p, c.c_int]
     lib.ptm_new_pass.restype = c.c_int
     lib.ptm_new_pass.argtypes = [c.c_void_p]

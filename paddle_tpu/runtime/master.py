@@ -35,13 +35,19 @@ class TaskMaster:
 
     def get_task(self, now: Optional[float] = None) -> Optional[Tuple[int, str]]:
         """-> (task_id, payload) | None when nothing currently available."""
-        buf = ctypes.create_string_buffer(4096)
-        tid = self._lib.ptm_get_task(
-            self._h, ctypes.c_double(time.monotonic() if now is None else now),
-            buf, len(buf))
-        if tid < 0:
-            return None
-        return tid, buf.value.decode()
+        ts = ctypes.c_double(time.monotonic() if now is None else now)
+        size = 4096
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            needed = ctypes.c_int(0)
+            tid = self._lib.ptm_get_task(self._h, ts, buf, len(buf),
+                                         ctypes.byref(needed))
+            if tid == -3:  # buffer too small; task not consumed — retry bigger
+                size = max(needed.value, size * 2)
+                continue
+            if tid < 0:
+                return None
+            return tid, buf.value.decode()
 
     def pass_finished(self) -> bool:
         """True when todo and pending are both empty (end of pass)."""

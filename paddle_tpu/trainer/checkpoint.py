@@ -26,7 +26,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from ..core.pytree import flatten_path_tree, unflatten_path_tree
+from ..core.pytree import flatten_path_tree, tree_spec, unflatten_path_tree
 
 FORMAT_VERSION = 1
 _META = "__meta__.json"
@@ -37,17 +37,17 @@ def _flatten(tree) -> Dict[str, np.ndarray]:
             for path, leaf in flatten_path_tree(tree)}
 
 
-def _unflatten(flat: Dict[str, np.ndarray]):
-    return unflatten_path_tree(flat)
-
-
 # -- tar serialization ----------------------------------------------------------
 
 def to_tar(f, params) -> None:
     """Serialize a params pytree into an open binary file object (v2
     parameters.to_tar analog, with CRC32 like go pserver checkpoints)."""
     flat = _flatten(params)
-    meta = {"version": FORMAT_VERSION, "crc32": {}, "order": list(flat)}
+    # Container structure (incl. empty dicts/lists and tuple-ness) travels in
+    # meta so from_tar restores the exact pytree — an SGD state whose per-param
+    # slots are {} must round-trip, not collapse to {'step': ...} (ADVICE r1).
+    meta = {"version": FORMAT_VERSION, "crc32": {}, "order": list(flat),
+            "structure": tree_spec(params)}
     with tarfile.open(fileobj=f, mode="w") as tar:
         for path, arr in flat.items():
             buf = io.BytesIO()
@@ -84,7 +84,7 @@ def from_tar(f):
             if want is not None and got != want:
                 raise ValueError(f"CRC mismatch for {path}: {got} != {want}")
             flat[path] = np.load(io.BytesIO(payload), allow_pickle=False)
-    return _unflatten(flat)
+    return unflatten_path_tree(flat, spec=meta.get("structure"))
 
 
 # -- pass directories -----------------------------------------------------------

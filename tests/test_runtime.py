@@ -195,3 +195,16 @@ def test_host_optimizer_sparse_rows():
     opt.update_rows(rows, grad)
     out = opt.param
     assert out[1].sum() == -4 and out[5].sum() == -4 and out[0].sum() == 0
+
+
+def test_master_large_payload_not_truncated():
+    """Payloads >= the client's initial 4096-byte buffer must round-trip: the
+    C side returns -3 + required length without consuming, client retries
+    (recordio peek pattern; ADVICE r1 medium)."""
+    m = TaskMaster(timeout_s=60, failure_max=3)
+    big = "p" * 20000
+    m.set_dataset([big])
+    tid, payload = m.get_task(now=0.0)
+    assert payload == big
+    m.task_finished(tid)
+    assert m.pass_finished()

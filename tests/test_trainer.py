@@ -167,3 +167,29 @@ def test_benchmark_job():
                           params, feeder=lambda rows: _feeder.feed(rows),
                           warmup=1, iters=3)
     assert r["ms_per_batch"] > 0
+
+
+def test_tar_preserves_empty_containers_and_tuples():
+    """SGD optimizer state has {} slots per param: structure (incl. empty
+    containers and tuple-ness) must survive to_tar/from_tar so resume works
+    (ADVICE r1 high)."""
+    from paddle_tpu.optimizer import SGD
+    params = {"fc": {"w": np.ones((3, 2), np.float32),
+                     "b": np.zeros((2,), np.float32)}}
+    opt = SGD(0.1)
+    state = opt.init(params)
+    buf = io.BytesIO()
+    to_tar(buf, state)
+    buf.seek(0)
+    back = from_tar(buf)
+    assert (jax.tree_util.tree_structure(back)
+            == jax.tree_util.tree_structure(state))
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    opt.update(grads, back, params)  # must not KeyError
+    # tuples round-trip as tuples
+    tup = {"pair": (np.ones(2, np.float32), np.zeros(3, np.float32)), "empty": []}
+    buf = io.BytesIO()
+    to_tar(buf, tup)
+    buf.seek(0)
+    back = from_tar(buf)
+    assert isinstance(back["pair"], tuple) and back["empty"] == []
