@@ -5,18 +5,31 @@ Build a Program of ops via ``layers``, differentiate with
 ``Executor`` — which compiles each block to a single cached XLA computation.
 """
 
-from . import backward, io, layers, optimizer, registry
+from . import (backward, evaluator, executor, io, layers, nets, optimizer,
+               registry, regularizer)
+from ..nn import initializer
 from .backward import append_backward
+from .evaluator import Accuracy as AccuracyEvaluator
+from .evaluator import ChunkEvaluator
 from .executor import Executor, Scope, global_scope
 from .framework import (Block, Operator, Program, Variable,
                         default_main_program, default_startup_program,
                         program_guard, reset_default_programs)
-from .optimizer import AdamOptimizer, MomentumOptimizer, SGDOptimizer
+from .layers import Cond, StaticRNN, While
+from .optimizer import (AdadeltaOptimizer, AdagradOptimizer, AdamaxOptimizer,
+                        AdamOptimizer, DecayedAdagradOptimizer,
+                        MomentumOptimizer, RMSPropOptimizer, SGDOptimizer)
 from .registry import OpRegistry
+from .regularizer import L1Decay, L2Decay, append_regularization_ops
 
-__all__ = ["layers", "backward", "io", "optimizer", "registry",
+__all__ = ["layers", "backward", "io", "optimizer", "registry", "executor",
+           "nets", "regularizer", "evaluator", "initializer",
            "append_backward", "Executor", "Scope", "global_scope",
            "Program", "Block", "Operator", "Variable",
            "default_main_program", "default_startup_program", "program_guard",
-           "reset_default_programs",
-           "SGDOptimizer", "MomentumOptimizer", "AdamOptimizer", "OpRegistry"]
+           "reset_default_programs", "While", "Cond", "StaticRNN",
+           "SGDOptimizer", "MomentumOptimizer", "AdamOptimizer",
+           "AdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
+           "AdamaxOptimizer", "DecayedAdagradOptimizer",
+           "L1Decay", "L2Decay", "append_regularization_ops",
+           "AccuracyEvaluator", "ChunkEvaluator", "OpRegistry"]

@@ -7,6 +7,12 @@ jitted and cached keyed on (program fingerprint, feed shapes/dtypes) — the
 shape-keyed executable cache that makes repeated `run` calls free of Python op
 dispatch. Feed/fetch (feed_op.cc/fetch_op.cc) become function inputs/outputs.
 
+Control flow (while_op.cc, conditional_block_op.cc, recurrent_op.cc): sub-block
+ops are traced into ``lax.while_loop`` / ``lax.cond`` / ``lax.scan`` bodies.
+The loop-carried state is derived from the IR: any outer variable a sub-block
+writes is carried (the reference threads these through the enclosing Scope;
+here they thread through the XLA loop carry, which is what the hardware wants).
+
 Autodiff: a block may contain one ``autodiff_grad`` op (appended by
 backward.append_backward). During tracing it replays the forward prefix as a
 closure over the parameter leaves and calls jax.grad — XLA CSE merges the
@@ -64,11 +70,31 @@ def global_scope() -> Scope:
     return _global_scope
 
 
-def _trace_ops(ops, env: Dict[str, Any]):
+class TraceContext:
+    """Per-trace state threaded through op lowering: the owning program (for
+    sub-block lookup) and the block-entry environment (for autodiff replay).
+    Replaces the former in-place ``op.attrs['_init_env']`` stash, which was
+    non-reentrant and leaked traced arrays into the desc layer."""
+
+    def __init__(self, program: Program, entry_env: Dict[str, Any]):
+        self.program = program
+        self.entry_env = entry_env
+
+
+def _trace_ops(ops, env: Dict[str, Any], ctx: TraceContext):
     """Symbolically run an op list over env (name -> traced array)."""
     for op in ops:
         if op.type == "autodiff_grad":
-            _trace_autodiff(op, ops, env)
+            _trace_autodiff(op, ops, env, ctx)
+            continue
+        if op.type == "while":
+            _trace_while(op, env, ctx)
+            continue
+        if op.type == "conditional_block":
+            _trace_cond(op, env, ctx)
+            continue
+        if op.type == "static_rnn":
+            _trace_static_rnn(op, env, ctx)
             continue
         compute = OpRegistry.get(op.type)
         ins = {k: [env[n] for n in vs] for k, vs in op.inputs.items()}
@@ -80,22 +106,125 @@ def _trace_ops(ops, env: Dict[str, Any]):
     return env
 
 
-def _trace_autodiff(op, ops, env):
+def _trace_autodiff(op, ops, env, ctx: TraceContext):
     loss_name = op.attrs["loss"]
     param_names = list(op.attrs["params"])
     n_fwd = op.attrs["num_fwd_ops"]
-    init_env = op.attrs["_init_env"]  # captured block-entry env
+    init_env = ctx.entry_env
 
     def replay(param_vals):
         env2 = dict(init_env)
         for name, val in zip(param_names, param_vals):
             env2[name] = val
-        _trace_ops(ops[:n_fwd], env2)
+        _trace_ops(ops[:n_fwd], env2, ctx)
         return env2[loss_name]
 
     grads = jax.grad(replay)([env[n] for n in param_names])
     for name, g in zip(param_names, grads):
         env[name + "@GRAD"] = g
+
+
+def _sub_block_written(sub: Block, env) -> List[str]:
+    """Outer vars a sub-block (transitively) writes — the loop-carried state.
+
+    The reference threads these through the parent Scope
+    (while_op.cc's step scopes); under XLA they become the loop carry."""
+    written: List[str] = []
+    prog = sub.program
+
+    def collect(block: Block):
+        for o in block.ops:
+            for n in o.output_vars():
+                written.append(n)
+            for key in ("sub_block_idx", "true_block_idx", "false_block_idx"):
+                if key in o.attrs and o.attrs[key] is not None:
+                    collect(prog.blocks[o.attrs[key]])
+
+    collect(sub)
+    return list(dict.fromkeys(n for n in written if n in env))
+
+
+def _trace_while(op, env, ctx: TraceContext):
+    """Lower a while op to lax.while_loop (while_op.cc semantics: re-run the
+    sub-block until the condition var — updated inside the block — is false)."""
+    sub = ctx.program.blocks[op.attrs["sub_block_idx"]]
+    cond_name = op.inputs["Condition"][0]
+    carried = _sub_block_written(sub, env)
+    if cond_name not in carried:
+        raise ValueError(
+            f"while condition '{cond_name}' is never updated in the loop body "
+            "(would loop forever); write it with less_than(..., cond=cond)")
+    ci = carried.index(cond_name)
+
+    def cond_fn(state):
+        return jnp.reshape(state[ci], ()).astype(bool)
+
+    def body_fn(state):
+        env2 = dict(env)
+        env2.update(zip(carried, state))
+        _trace_ops(sub.ops, env2, ctx)
+        return tuple(env2[n] for n in carried)
+
+    init = tuple(env[n] for n in carried)
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    env.update(zip(carried, final))
+
+
+def _trace_cond(op, env, ctx: TraceContext):
+    """Lower conditional_block(+optional else block) to lax.cond. Vars written
+    by either branch must pre-exist outside so the untaken branch has a value
+    to pass through (conditional_block_op.cc runs the block or skips it,
+    leaving scope vars untouched)."""
+    true_b = ctx.program.blocks[op.attrs["true_block_idx"]]
+    false_idx = op.attrs.get("false_block_idx")
+    false_b = ctx.program.blocks[false_idx] if false_idx is not None else None
+    cond_name = op.inputs["Condition"][0]
+    carried = _sub_block_written(true_b, env)
+    if false_b is not None:
+        for n in _sub_block_written(false_b, env):
+            if n not in carried:
+                carried.append(n)
+
+    def make_branch(blk: Optional[Block]):
+        def branch(state):
+            env2 = dict(env)
+            env2.update(zip(carried, state))
+            if blk is not None:
+                _trace_ops(blk.ops, env2, ctx)
+            return tuple(env2[n] for n in carried)
+        return branch
+
+    init = tuple(env[n] for n in carried)
+    pred = jnp.reshape(env[cond_name], ()).astype(bool)
+    final = jax.lax.cond(pred, make_branch(true_b), make_branch(false_b), init)
+    env.update(zip(carried, final))
+
+
+def _trace_static_rnn(op, env, ctx: TraceContext):
+    """Lower a static_rnn op (recurrent_op.cc / fluid StaticRNN) to ONE
+    lax.scan over the time axis — the TPU-native form of the reference's
+    per-step frame cloning (RecurrentGradientMachine.h:304)."""
+    a = op.attrs
+    sub = ctx.program.blocks[a["sub_block_idx"]]
+    # step inputs: outer [B, T, ...] -> scan over [T, B, ...]
+    xs = tuple(jnp.moveaxis(env[n], 1, 0) for n in a["outer_inputs"])
+    init = tuple(env[n] for n in a["boot_mems"])
+
+    def body(carry, xt):
+        env2 = dict(env)
+        env2.update(zip(a["mem_names"], carry))
+        env2.update(zip(a["step_in_names"], xt))
+        _trace_ops(sub.ops, env2, ctx)
+        new_carry = tuple(env2[n] for n in a["mem_update_names"])
+        outs = tuple(env2[n] for n in a["step_out_names"])
+        return new_carry, outs
+
+    carry, ys = jax.lax.scan(body, init, xs)
+    for name, y in zip(a["outer_outputs"], ys):
+        env[name] = jnp.moveaxis(y, 0, 1)            # [T, B, ...] -> [B, T, ...]
+    for name, c in zip(a["last_mem_outputs"], carry):
+        if name is not None:
+            env[name] = c
 
 
 class Executor:
@@ -125,10 +254,13 @@ class Executor:
         # vars the block reads from the scope (persistables created earlier)
         persist_in = [name for name, v in block.vars.items()
                       if v.persistable and self.scope.has(name)]
-        # persistable vars written by ops (optimizer updates) to sync back
-        written = [n for op in block.ops for n in op.output_vars()
-                   if n in block.vars and block.vars[n].persistable]
-        written = list(dict.fromkeys(written))
+        # persistable vars written by ops (optimizer updates, BN stats) synced
+        # back after the run — including writes inside control-flow sub-blocks
+        # (those values flow to env via the loop carry; they must also be
+        # listed here or the scope silently keeps the stale value)
+        written = list(dict.fromkeys(
+            n for n in self._written_vars(program, block)
+            if n in block.vars and block.vars[n].persistable))
 
         key = (program._serial, program.version, block.idx, tuple(fetch_names),
                tuple(persist_in),
@@ -146,6 +278,19 @@ class Executor:
         return [np.asarray(v) for v in fetches]
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _written_vars(program: Program, block: Block) -> List[str]:
+        out: List[str] = []
+        for op in block.ops:
+            out.extend(op.output_vars())
+            for key in ("sub_block_idx", "true_block_idx", "false_block_idx"):
+                idx = op.attrs.get(key)
+                if idx is not None:
+                    out.extend(Executor._written_vars(program,
+                                                      program.blocks[idx]))
+        return out
+
+    # ------------------------------------------------------------------
     def _build(self, program: Program, block: Block, feed_names, persist_in,
                fetch_names, written):
         has_host_ops = any(op.type == "fill_init" for op in block.ops)
@@ -154,12 +299,8 @@ class Executor:
             env: Dict[str, Any] = {}
             env.update(feed)
             env.update(dict(zip(persist_in, persist_vals)))
-            # stash block-entry env for autodiff replay
-            entry_env = dict(env)
-            for op in block.ops:
-                if op.type == "autodiff_grad":
-                    op.attrs["_init_env"] = entry_env
-            _trace_ops(block.ops, env)
+            ctx = TraceContext(program, dict(env))
+            _trace_ops(block.ops, env, ctx)
             fetches = [env[n] for n in fetch_names]
             new_persist = [env.get(n) for n in written]
             return fetches, new_persist

@@ -25,7 +25,8 @@ class Variable:
 
     def __init__(self, block: "Block", name: str, shape: Sequence[int] = (),
                  dtype: str = "float32", persistable: bool = False,
-                 is_data: bool = False, lod_level: int = 0):
+                 is_data: bool = False, lod_level: int = 0,
+                 trainable: bool = True):
         self.block = block
         self.name = name
         self.shape = tuple(int(s) for s in shape)
@@ -33,6 +34,10 @@ class Variable:
         self.persistable = persistable
         self.is_data = is_data
         self.lod_level = lod_level
+        # persistable state that is NOT a learnable weight (BN running stats,
+        # evaluator accumulators) sets trainable=False so autodiff/optimizers
+        # skip it while the executor still syncs it to the scope
+        self.trainable = trainable
 
     def __repr__(self):
         return (f"Variable({self.name}, shape={self.shape}, dtype={self.dtype}"
@@ -41,7 +46,8 @@ class Variable:
     def to_dict(self):
         return {"name": self.name, "shape": list(self.shape),
                 "dtype": self.dtype, "persistable": self.persistable,
-                "is_data": self.is_data, "lod_level": self.lod_level}
+                "is_data": self.is_data, "lod_level": self.lod_level,
+                "trainable": self.trainable}
 
 
 class Operator:
@@ -116,7 +122,8 @@ class Block:
         return op
 
     def all_parameters(self) -> List[Variable]:
-        return [v for v in self.vars.values() if v.persistable and not v.is_data]
+        return [v for v in self.vars.values()
+                if v.persistable and not v.is_data and v.trainable]
 
     def to_dict(self):
         return {"idx": self.idx, "parent_idx": self.parent_idx,
@@ -139,14 +146,33 @@ class Program:
         Program._serial_counter += 1
         self._serial = Program._serial_counter
         self.version = 0
+        # block stack for control-flow builders (While/StaticRNN/IfElse):
+        # layer builders append ops to current_block(), which is the global
+        # block unless a sub-block guard is active (BlockDesc nesting,
+        # block_desc.h + fluid framework.py Program.current_block)
+        self._block_stack: List[int] = [0]
 
     def global_block(self) -> Block:
         return self.blocks[0]
 
-    def create_block(self, parent_idx: int = 0) -> Block:
+    def current_block(self) -> Block:
+        return self.blocks[self._block_stack[-1]]
+
+    def create_block(self, parent_idx: Optional[int] = None) -> Block:
+        if parent_idx is None:
+            parent_idx = self._block_stack[-1]
         b = Block(self, len(self.blocks), parent_idx)
         self.blocks.append(b)
         return b
+
+    @contextlib.contextmanager
+    def block_guard(self, block: "Block"):
+        """Append subsequent ops into ``block`` (control-flow sub-block)."""
+        self._block_stack.append(block.idx)
+        try:
+            yield block
+        finally:
+            self._block_stack.pop()
 
     def unique_name(self, prefix: str) -> str:
         self._name_counter += 1
@@ -164,7 +190,8 @@ class Program:
             for vd in bd["vars"]:
                 b.vars[vd["name"]] = Variable(
                     b, vd["name"], vd["shape"], vd["dtype"],
-                    vd["persistable"], vd["is_data"], vd.get("lod_level", 0))
+                    vd["persistable"], vd["is_data"], vd.get("lod_level", 0),
+                    vd.get("trainable", True))
             for od in bd["ops"]:
                 b.append_op(od["type"], od["inputs"], od["outputs"], od["attrs"])
             p.blocks.append(b)

@@ -31,7 +31,8 @@ class Optimizer:
             return self._lr_var
         b = program.global_block()
         name = program.unique_name("learning_rate")
-        v = b.create_var(name=name, shape=(), dtype="float32", persistable=True)
+        v = b.create_var(name=name, shape=(), dtype="float32",
+                         persistable=True, trainable=False)
         sb = default_startup_program().global_block()
         sb.create_var(name=name, shape=(), dtype="float32", persistable=True)
         sb.append_op("fill_init", {}, {"Out": [name]},
@@ -46,7 +47,7 @@ class Optimizer:
         name = f"{param.name}@{suffix}"
         shape = tuple(param.shape if shape is None else shape)
         v = b.create_var(name=name, shape=shape, dtype=param.dtype,
-                         persistable=True)
+                         persistable=True, trainable=False)
         sb = default_startup_program().global_block()
         sb.create_var(name=name, shape=shape, dtype=param.dtype,
                       persistable=True)
@@ -59,10 +60,13 @@ class Optimizer:
         raise NotImplementedError
 
     # -- public ------------------------------------------------------------
-    def minimize(self, loss: Variable,
-                 program: Optional[Program] = None) -> List[Tuple]:
+    def minimize(self, loss: Variable, program: Optional[Program] = None,
+                 regularization=None) -> List[Tuple]:
         program = program or default_main_program()
         pg = append_backward(loss, program=program)
+        if regularization is not None:
+            from .regularizer import append_regularization_ops
+            pg = append_regularization_ops(pg, regularization, program)
         lr = self._ensure_lr(program)
         for param, grad in pg:
             self._append_update(program, param, grad, lr)
@@ -119,3 +123,94 @@ class AdamOptimizer(Optimizer):
              "Beta2PowOut": [b2p.name]},
             {"beta1": self.beta1, "beta2": self.beta2,
              "epsilon": self.epsilon})
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon: float = 1e-6):
+        super().__init__(learning_rate)
+        self.epsilon = epsilon
+
+    def _append_update(self, program, param, grad, lr):
+        m = self._accumulator(program, param, "moment")
+        program.global_block().append_op(
+            "adagrad",
+            {"Param": [param.name], "Grad": [grad.name], "Moment": [m.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [param.name], "MomentOut": [m.name]},
+            {"epsilon": self.epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate=1.0, rho: float = 0.95,
+                 epsilon: float = 1e-6):
+        super().__init__(learning_rate)
+        self.rho, self.epsilon = rho, epsilon
+
+    def _append_update(self, program, param, grad, lr):
+        ag = self._accumulator(program, param, "avg_squared_grad")
+        au = self._accumulator(program, param, "avg_squared_update")
+        program.global_block().append_op(
+            "adadelta",
+            {"Param": [param.name], "Grad": [grad.name],
+             "AvgSquaredGrad": [ag.name], "AvgSquaredUpdate": [au.name]},
+            {"ParamOut": [param.name], "AvgSquaredGradOut": [ag.name],
+             "AvgSquaredUpdateOut": [au.name]},
+            {"rho": self.rho, "epsilon": self.epsilon})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.01, decay: float = 0.9,
+                 momentum: float = 0.0, epsilon: float = 1e-6):
+        super().__init__(learning_rate)
+        self.decay, self.momentum, self.epsilon = decay, momentum, epsilon
+
+    def _append_update(self, program, param, grad, lr):
+        ms = self._accumulator(program, param, "mean_square")
+        mom = self._accumulator(program, param, "rms_moment")
+        program.global_block().append_op(
+            "rmsprop",
+            {"Param": [param.name], "Grad": [grad.name],
+             "MeanSquare": [ms.name], "Moment": [mom.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [param.name], "MeanSquareOut": [ms.name],
+             "MomentOut": [mom.name]},
+            {"decay": self.decay, "momentum": self.momentum,
+             "epsilon": self.epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _append_update(self, program, param, grad, lr):
+        m = self._accumulator(program, param, "adamax_moment")
+        u = self._accumulator(program, param, "inf_norm")
+        b1p = self._accumulator(program, param, "beta1_pow_ax", shape=(),
+                                value=self.beta1)
+        program.global_block().append_op(
+            "adamax",
+            {"Param": [param.name], "Grad": [grad.name], "Moment": [m.name],
+             "InfNorm": [u.name], "Beta1Pow": [b1p.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [param.name], "MomentOut": [m.name],
+             "InfNormOut": [u.name], "Beta1PowOut": [b1p.name]},
+            {"beta1": self.beta1, "beta2": self.beta2,
+             "epsilon": self.epsilon})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.01, decay: float = 0.95,
+                 epsilon: float = 1e-6):
+        super().__init__(learning_rate)
+        self.decay, self.epsilon = decay, epsilon
+
+    def _append_update(self, program, param, grad, lr):
+        m = self._accumulator(program, param, "decayed_moment")
+        program.global_block().append_op(
+            "decayed_adagrad",
+            {"Param": [param.name], "Grad": [grad.name], "Moment": [m.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [param.name], "MomentOut": [m.name]},
+            {"decay": self.decay, "epsilon": self.epsilon})

@@ -243,10 +243,10 @@ def _pool2d(ins, attrs):
 @OpRegistry.register("batch_norm_infer")
 def _bn_infer(ins, attrs):
     from ..ops.norm import batch_norm
-    out = batch_norm(_x(ins), ins["Scale"][0], ins["Bias"][0],
-                     mean=ins["Mean"][0], var=ins["Variance"][0],
-                     eps=attrs.get("epsilon", 1e-5))
-    return {"Out": [out if not isinstance(out, tuple) else out[0]]}
+    y, _, _ = batch_norm(_x(ins), ins["Scale"][0], ins["Bias"][0],
+                         ins["Mean"][0], ins["Variance"][0],
+                         train=False, eps=attrs.get("epsilon", 1e-5))
+    return {"Out": [y]}
 
 
 @OpRegistry.register("layer_norm")
@@ -396,3 +396,723 @@ def _seq_last(ins, attrs):
 def _seq_first(ins, attrs):
     from ..ops.sequence import sequence_first_step
     return {"Out": [sequence_first_step(ins["X"][0], ins["Lengths"][0])]}
+
+
+# =============================================================================
+# Registry completion toward the reference's 110 op families
+# (paddle/operators/*.cc REGISTER_OP list). Compute bodies live in
+# paddle_tpu/ops/*; entries here adapt the named-slot convention.
+# =============================================================================
+
+# ------------------------------------------------------- control flow stubs --
+# Lowered structurally by the executor (_trace_while/_trace_cond/
+# _trace_static_rnn) — ref: while_op.cc, conditional_block_op.cc,
+# recurrent_op.cc. Registered so Operator construction validates.
+
+for _cf in ("while", "conditional_block", "static_rnn"):
+    def _cf_stub(ins, attrs, _n=_cf):
+        raise RuntimeError(f"'{_n}' is lowered by the executor, not run directly")
+    OpRegistry._ops[_cf] = _cf_stub
+
+
+# --------------------------------------------------- tensor arrays & compare --
+# TensorArray under XLA: a fixed-capacity [T, ...] buffer; write = dynamic
+# update at index, read = dynamic index (tensor_array_read_write_op.cc,
+# lod_tensor_to_array_op.cc — per-step dynamic arrays become static buffers).
+
+@OpRegistry.register("array_write")
+def _array_write(ins, attrs):
+    x, i = _x(ins), ins["I"][0]
+    if "Array" in ins:
+        arr = ins["Array"][0]
+    else:
+        arr = jnp.zeros((attrs["capacity"],) + x.shape, x.dtype)
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_update_index_in_dim(arr, x, i, 0)]}
+
+
+@OpRegistry.register("array_read")
+def _array_read(ins, attrs):
+    arr, i = _x(ins, "Array"), ins["I"][0]
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_index_in_dim(arr, i, 0, keepdims=False)]}
+
+
+@OpRegistry.register("array_length")
+def _array_length(ins, attrs):
+    return {"Out": [jnp.asarray(ins["Array"][0].shape[0], jnp.int64)]}
+
+
+@OpRegistry.register("lod_tensor_to_array")
+def _lod_to_array(ins, attrs):
+    # [B, T, ...] -> time-major [T, B, ...] buffer for per-step array_read
+    return {"Out": [jnp.moveaxis(_x(ins), 1, 0)]}
+
+
+@OpRegistry.register("array_to_lod_tensor")
+def _array_to_lod(ins, attrs):
+    return {"Out": [jnp.moveaxis(_x(ins), 0, 1)]}
+
+
+@OpRegistry.register("increment")
+def _increment(ins, attrs):
+    x = _x(ins)
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1), x.dtype)]}
+
+
+def _compare(fn):
+    def compute(ins, attrs):
+        return {"Out": [fn(_x(ins), ins["Y"][0])]}
+    return compute
+
+
+OpRegistry._ops["less_than"] = _compare(lambda a, b: a < b)
+OpRegistry._ops["less_equal"] = _compare(lambda a, b: a <= b)
+OpRegistry._ops["greater_than"] = _compare(lambda a, b: a > b)
+OpRegistry._ops["greater_equal"] = _compare(lambda a, b: a >= b)
+OpRegistry._ops["equal"] = _compare(lambda a, b: a == b)
+OpRegistry._ops["not_equal"] = _compare(lambda a, b: a != b)
+OpRegistry._ops["logical_and"] = _compare(jnp.logical_and)
+OpRegistry._ops["logical_or"] = _compare(jnp.logical_or)
+
+
+@OpRegistry.register("logical_not")
+def _lnot(ins, attrs):
+    return {"Out": [jnp.logical_not(_x(ins))]}
+
+
+@OpRegistry.register("assign")
+def _assign(ins, attrs):
+    return {"Out": [_x(ins)]}
+
+
+@OpRegistry.register("fill_zeros_like")
+def _zeros_like(ins, attrs):
+    return {"Out": [jnp.zeros_like(_x(ins))]}
+
+
+@OpRegistry.register("fill_constant_batch_size_like")
+def _fill_bsl(ins, attrs):
+    ref = _x(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[attrs.get("input_dim_idx", 0)]
+    return {"Out": [jnp.full(tuple(shape), attrs["value"],
+                             dtype=attrs.get("dtype", "float32"))]}
+
+
+@OpRegistry.register("is_empty")
+def _is_empty(ins, attrs):
+    return {"Out": [jnp.asarray(_x(ins).size == 0)]}
+
+
+# ------------------------------------------------------------- simple math ---
+
+@OpRegistry.register("sign")
+def _sign(ins, attrs):
+    return {"Out": [jnp.sign(_x(ins))]}
+
+
+@OpRegistry.register("minus")
+def _minus(ins, attrs):
+    return {"Out": [_x(ins) - _x(ins, "Y")]}
+
+
+@OpRegistry.register("pow")
+def _pow(ins, attrs):
+    return {"Out": [jnp.power(_x(ins), attrs.get("factor", 1.0))]}
+
+
+@OpRegistry.register("reduce_mean")
+def _rmean(ins, attrs):
+    return {"Out": [jnp.mean(_x(ins), axis=attrs.get("dim"),
+                             keepdims=attrs.get("keep_dim", False))]}
+
+
+@OpRegistry.register("reduce_max")
+def _rmax(ins, attrs):
+    return {"Out": [jnp.max(_x(ins), axis=attrs.get("dim"),
+                            keepdims=attrs.get("keep_dim", False))]}
+
+
+@OpRegistry.register("reduce_min")
+def _rmin(ins, attrs):
+    return {"Out": [jnp.min(_x(ins), axis=attrs.get("dim"),
+                            keepdims=attrs.get("keep_dim", False))]}
+
+
+@OpRegistry.register("expand")
+def _expand(ins, attrs):
+    from ..ops.math import expand
+    return {"Out": [expand(_x(ins), attrs["expand_times"])]}
+
+
+@OpRegistry.register("pad")
+def _pad(ins, attrs):
+    from ..ops.math import pad
+    return {"Out": [pad(_x(ins), attrs["paddings"],
+                        attrs.get("pad_value", 0.0))]}
+
+
+@OpRegistry.register("crop")
+def _crop(ins, attrs):
+    from ..ops.math import crop
+    return {"Out": [crop(_x(ins), attrs["offsets"], attrs["shape"])]}
+
+
+@OpRegistry.register("gather")
+def _gather(ins, attrs):
+    from ..ops.math import gather
+    return {"Out": [gather(_x(ins), ins["Index"][0], attrs.get("axis", 0))]}
+
+
+@OpRegistry.register("scatter")
+def _scatter(ins, attrs):
+    from ..ops.math import scatter
+    return {"Out": [scatter(_x(ins, "Ref"), ins["Index"][0],
+                            ins["Updates"][0],
+                            overwrite=attrs.get("overwrite", True))]}
+
+
+@OpRegistry.register("multiplex")
+def _multiplex(ins, attrs):
+    ids = ins["Ids"][0].reshape(-1)
+    stacked = jnp.stack(ins["X"], axis=0)          # [n, B, ...]
+    return {"Out": [jnp.take_along_axis(
+        stacked, ids[None, :, None].astype(jnp.int32)
+        if stacked.ndim == 3 else ids[None, :], axis=0)[0]]}
+
+
+@OpRegistry.register("clip_by_norm")
+def _clip_norm(ins, attrs):
+    from ..ops.math import clip_by_norm
+    return {"Out": [clip_by_norm(_x(ins), attrs["max_norm"])]}
+
+
+@OpRegistry.register("l1_norm")
+def _l1norm(ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(_x(ins)))]}
+
+
+@OpRegistry.register("squared_l2_norm")
+def _sql2(ins, attrs):
+    from ..ops.loss import squared_l2_norm
+    return {"Out": [squared_l2_norm(_x(ins))]}
+
+
+@OpRegistry.register("squared_l2_distance")
+def _sql2d(ins, attrs):
+    x, y = _x(ins), _x(ins, "Y")
+    d = (x - y).reshape(x.shape[0], -1)
+    return {"Out": [jnp.sum(d * d, axis=1, keepdims=True)], "sub_result": [d]}
+
+
+@OpRegistry.register("cos_sim")
+def _cos_sim(ins, attrs):
+    from ..ops.math import cos_sim
+    return {"Out": [cos_sim(_x(ins), _x(ins, "Y"))]}
+
+
+@OpRegistry.register("l2_normalize")
+def _l2n(ins, attrs):
+    from ..ops.math import l2_normalize
+    return {"Out": [l2_normalize(_x(ins), attrs.get("axis", -1))]}
+
+
+@OpRegistry.register("prelu")
+def _prelu(ins, attrs):
+    x, alpha = _x(ins), ins["Alpha"][0]
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@OpRegistry.register("conv_shift")
+def _conv_shift(ins, attrs):
+    # circular correlation (conv_shift_op.cc): X [B, M], Y [B, N] (N odd, small)
+    x, y = _x(ins), _x(ins, "Y")
+    M, N = x.shape[1], y.shape[1]
+    half = N // 2
+    idx = (jnp.arange(M)[:, None] + jnp.arange(-half, half + 1)[None, :]) % M
+    windows = x[:, idx]                             # [B, M, N]
+    return {"Out": [jnp.einsum("bmn,bn->bm", windows, y)]}
+
+
+@OpRegistry.register("bilinear_tensor_product")
+def _btp(ins, attrs):
+    # out[:, k] = x W_k y^T + b (bilinear_tensor_product_op.cc)
+    x, y, w = _x(ins), _x(ins, "Y"), ins["Weight"][0]   # w: [K, Dx, Dy]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if "Bias" in ins:
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@OpRegistry.register("interpolation")
+def _interp(ins, attrs):
+    from ..ops.math import interpolation
+    return {"Out": [interpolation(_x(ins), _x(ins, "Y"), ins["W"][0])]}
+
+
+# ------------------------------------------------------------ conv / pool ----
+
+@OpRegistry.register("depthwise_conv2d")
+def _dwconv(ins, attrs):
+    from ..ops.conv import depthwise_conv2d
+    return {"Out": [depthwise_conv2d(ins["Input"][0], ins["Filter"][0],
+                                     stride=attrs.get("strides", 1),
+                                     padding=attrs.get("paddings", 0))]}
+
+
+@OpRegistry.register("conv2d_transpose")
+def _deconv(ins, attrs):
+    from ..ops.conv import conv2d_transpose
+    return {"Out": [conv2d_transpose(ins["Input"][0], ins["Filter"][0],
+                                     stride=attrs.get("strides", 1),
+                                     padding=attrs.get("paddings", 0))]}
+
+
+@OpRegistry.register("conv3d")
+def _conv3d(ins, attrs):
+    from ..ops.conv import conv3d
+    return {"Out": [conv3d(ins["Input"][0], ins["Filter"][0],
+                           stride=attrs.get("strides", 1),
+                           padding=attrs.get("paddings", 0),
+                           dilation=attrs.get("dilations", 1),
+                           groups=attrs.get("groups", 1))]}
+
+
+@OpRegistry.register("pool3d")
+def _pool3d(ins, attrs):
+    from ..ops import pool as P
+    fn = (P.max_pool3d if attrs.get("pooling_type", "max") == "max"
+          else P.avg_pool3d)
+    return {"Out": [fn(_x(ins), attrs.get("ksize", 2),
+                       attrs.get("strides"), attrs.get("paddings", 0))]}
+
+
+@OpRegistry.register("pool2d_with_index")
+def _pool_idx(ins, attrs):
+    from ..ops.pool import max_pool2d_with_index
+    out, idx = max_pool2d_with_index(_x(ins), attrs.get("ksize", 2),
+                                     attrs.get("strides"),
+                                     attrs.get("paddings", 0))
+    return {"Out": [out], "Mask": [idx]}
+
+
+@OpRegistry.register("lrn")
+def _lrn(ins, attrs):
+    from ..ops.norm import lrn
+    return {"Out": [lrn(_x(ins), size=attrs.get("n", 5),
+                        alpha=attrs.get("alpha", 1e-4),
+                        beta=attrs.get("beta", 0.75),
+                        k=attrs.get("k", 1.0))]}
+
+
+@OpRegistry.register("maxout")
+def _maxout(ins, attrs):
+    from ..ops.conv import maxout
+    return {"Out": [maxout(_x(ins), attrs["groups"])]}
+
+
+@OpRegistry.register("roi_pool")
+def _roi(ins, attrs):
+    from ..ops.pool import roi_pool
+    return {"Out": [roi_pool(_x(ins), ins["ROIs"][0],
+                             (attrs["pooled_height"], attrs["pooled_width"]),
+                             spatial_scale=attrs.get("spatial_scale", 1.0))]}
+
+
+@OpRegistry.register("row_conv")
+def _row_conv(ins, attrs):
+    from ..ops.conv import row_conv
+    return {"Out": [row_conv(_x(ins), ins["Filter"][0])]}
+
+
+@OpRegistry.register("block_expand")
+def _block_expand(ins, attrs):
+    from ..ops.conv import im2col
+    return {"Out": [im2col(_x(ins), attrs["block"], attrs.get("strides", 1),
+                           attrs.get("paddings", 0))]}
+
+
+@OpRegistry.register("bilinear_interp")
+def _bilinear(ins, attrs):
+    from ..ops.conv import bilinear_interp
+    return {"Out": [bilinear_interp(_x(ins), attrs["out_h"], attrs["out_w"])]}
+
+
+@OpRegistry.register("spp")
+def _spp(ins, attrs):
+    from ..ops.pool import spatial_pyramid_pool
+    return {"Out": [spatial_pyramid_pool(_x(ins), attrs["pyramid_height"],
+                                         attrs.get("pooling_type", "max"))]}
+
+
+# ------------------------------------------------------------- batch norm ----
+
+@OpRegistry.register("batch_norm")
+def _batch_norm(ins, attrs):
+    """Training-capable batch norm (batch_norm_op.cc): updates running stats;
+    MeanOut/VarianceOut alias the persistable stat vars so the executor syncs
+    them back to the scope after the step."""
+    from ..ops.norm import batch_norm
+    y, new_mean, new_var = batch_norm(
+        _x(ins), ins["Scale"][0], ins["Bias"][0],
+        ins["Mean"][0], ins["Variance"][0],
+        train=not attrs.get("is_test", False),
+        momentum=attrs.get("momentum", 0.9),
+        eps=attrs.get("epsilon", 1e-5))
+    return {"Y": [y], "MeanOut": [new_mean], "VarianceOut": [new_var]}
+
+
+# ------------------------------------------------------------------ losses ---
+
+def _loss_reg(name, fn_name, x_key="X", label_key="Label", out_key="Out",
+              **fixed):
+    from ..ops import loss as L
+    fn = getattr(L, fn_name)
+
+    def compute(ins, attrs, _fn=fn):
+        kw = dict(fixed)
+        for a in ("sigma", "delta", "margin", "eps"):
+            if a in attrs:
+                kw[a] = attrs[a]
+        return {out_key: [_fn(ins[x_key][0], ins[label_key][0], **kw)]}
+    OpRegistry._ops[name] = compute
+
+
+_loss_reg("smooth_l1_loss", "smooth_l1")
+_loss_reg("huber_loss", "huber_regression")
+_loss_reg("modified_huber_loss", "modified_huber")
+_loss_reg("hinge_loss", "hinge")
+_loss_reg("log_loss", "log_loss", x_key="Predicted")
+_loss_reg("multi_binary_label_cross_entropy", "multi_binary_label_cross_entropy")
+_loss_reg("soft_binary_class_cross_entropy", "soft_binary_class_cross_entropy")
+_loss_reg("kldiv_loss", "kldiv_loss", label_key="Target")
+
+
+@OpRegistry.register("rank_loss")
+def _rank_loss(ins, attrs):
+    from ..ops.loss import rank_loss
+    return {"Out": [rank_loss(ins["Left"][0], ins["Right"][0],
+                              ins["Label"][0])]}
+
+
+@OpRegistry.register("margin_rank_loss")
+def _margin_rank(ins, attrs):
+    from ..ops.loss import margin_rank_loss
+    return {"Out": [margin_rank_loss(ins["X1"][0], ins["X2"][0],
+                                     ins["Label"][0],
+                                     margin=attrs.get("margin", 0.0))]}
+
+
+# --------------------------------------------------------------- sequences ---
+
+@OpRegistry.register("sequence_expand")
+def _seq_expand(ins, attrs):
+    from ..ops.sequence import sequence_expand
+    return {"Out": [sequence_expand(_x(ins), ins["RefLengths"][0],
+                                    attrs["max_len"])]}
+
+
+@OpRegistry.register("sequence_softmax")
+def _seq_softmax(ins, attrs):
+    x, lengths = _x(ins), ins["Lengths"][0]
+    T = x.shape[1]
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])
+    logits = jnp.where(mask, x, -1e9)
+    sm = jax.nn.softmax(logits, axis=1)
+    return {"Out": [jnp.where(mask, sm, 0.0)]}
+
+
+@OpRegistry.register("sequence_reverse")
+def _seq_rev(ins, attrs):
+    from ..ops.sequence import sequence_reverse
+    return {"Out": [sequence_reverse(_x(ins), ins["Lengths"][0])]}
+
+
+@OpRegistry.register("sequence_slice")
+def _seq_slice(ins, attrs):
+    from ..ops.sequence import sequence_slice
+    return {"Out": [sequence_slice(_x(ins), ins["Lengths"][0],
+                                   ins["Offset"][0], ins["Length"][0])]}
+
+
+@OpRegistry.register("sequence_concat")
+def _seq_concat(ins, attrs):
+    from ..ops.sequence import sequence_concat
+    out, lengths = sequence_concat(ins["X"][0], ins["XLengths"][0],
+                                   ins["Y"][0], ins["YLengths"][0])
+    return {"Out": [out], "OutLengths": [lengths]}
+
+
+@OpRegistry.register("context_projection")
+def _ctx_proj(ins, attrs):
+    from ..ops.sequence import context_projection
+    return {"Out": [context_projection(_x(ins), ins["Lengths"][0],
+                                       attrs.get("context_start", -1),
+                                       attrs.get("context_length", 3))]}
+
+
+@OpRegistry.register("lod_reset")
+def _lod_reset(ins, attrs):
+    # lengths live beside data in this design; the op passes data through and
+    # emits the new lengths (lod_reset_op.cc re-labels offsets)
+    return {"Out": [_x(ins)],
+            "OutLengths": [ins["Lengths"][0] if "Lengths" in ins
+                           else jnp.asarray(attrs["target_lengths"])]}
+
+
+# ----------------------------------------------------------------- CRF/CTC ---
+
+@OpRegistry.register("linear_chain_crf")
+def _crf(ins, attrs):
+    from ..ops.crf import crf_loss
+    t = ins["Transition"][0]   # [N+2, N] packed like the reference
+    ll = crf_loss(ins["Emission"][0], ins["Label"][0], ins["Lengths"][0],
+                  t[0], t[1], t[2:])
+    return {"LogLikelihood": [ll]}
+
+
+@OpRegistry.register("crf_decoding")
+def _crf_dec(ins, attrs):
+    from ..ops.crf import crf_decode
+    t = ins["Transition"][0]
+    tags, score = crf_decode(ins["Emission"][0], ins["Lengths"][0],
+                             t[0], t[1], t[2:])
+    return {"ViterbiPath": [tags], "Score": [score]}
+
+
+@OpRegistry.register("warpctc")
+def _ctc(ins, attrs):
+    from ..ops.ctc import ctc_loss
+    return {"Loss": [ctc_loss(ins["Logits"][0], ins["LogitsLengths"][0],
+                              ins["Label"][0], ins["LabelLengths"][0],
+                              blank=attrs.get("blank", 0))]}
+
+
+@OpRegistry.register("ctc_greedy_decode")
+def _ctc_dec(ins, attrs):
+    from ..ops.ctc import ctc_greedy_decode
+    toks, lens = ctc_greedy_decode(ins["Logits"][0], ins["LogitsLengths"][0],
+                                   blank=attrs.get("blank", 0))
+    return {"Out": [toks], "OutLengths": [lens]}
+
+
+# -------------------------------------------------------------- nce / hsig ---
+
+@OpRegistry.register("nce")
+def _nce(ins, attrs):
+    from ..ops.nce import nce_loss
+    key = jax.random.PRNGKey(attrs.get("seed", 0))
+    if "Step" in ins:       # fresh negatives per executor run
+        key = jax.random.fold_in(key, ins["Step"][0])
+    return {"Cost": [nce_loss(
+        ins["Input"][0], ins["Label"][0], ins["Weight"][0],
+        ins["Bias"][0] if "Bias" in ins else None, key,
+        num_neg_samples=attrs.get("num_neg_samples", 10))]}
+
+
+@OpRegistry.register("hierarchical_sigmoid")
+def _hsig(ins, attrs):
+    from ..ops.nce import hsigmoid_loss
+    return {"Cost": [hsigmoid_loss(
+        ins["Input"][0], ins["Label"][0], ins["InnerW"][0],
+        ins["InnerB"][0] if "InnerB" in ins else None,
+        ins["Paths"][0], ins["Codes"][0])]}
+
+
+# ----------------------------------------------------------------- metrics ---
+
+@OpRegistry.register("auc")
+def _auc(ins, attrs):
+    from ..ops.metrics import auc_from_histogram, auc_histogram
+    pos, neg = auc_histogram(ins["Out"][0], ins["Label"][0],
+                             attrs.get("num_thresholds", 200))
+    return {"AUC": [auc_from_histogram(pos, neg)],
+            "PosHist": [pos], "NegHist": [neg]}
+
+
+@OpRegistry.register("precision_recall")
+def _pr(ins, attrs):
+    from ..ops.metrics import precision_recall_counts
+    tp, fp, fn_ = precision_recall_counts(ins["Out"][0], ins["Label"][0],
+                                          attrs["num_classes"])
+    return {"TP": [tp], "FP": [fp], "FN": [fn_]}
+
+
+@OpRegistry.register("chunk_eval")
+def _chunk(ins, attrs):
+    from ..ops.metrics import chunk_count
+    c, p, l = chunk_count(ins["Inference"][0], ins["Label"][0],
+                          ins["Lengths"][0],
+                          scheme=attrs.get("chunk_scheme", "IOB"),
+                          num_chunk_types=attrs.get("num_chunk_types", 1))
+    return {"Correct": [c], "Predicted": [p], "Labeled": [l]}
+
+
+@OpRegistry.register("positive_negative_pair")
+def _pnpair(ins, attrs):
+    # pn-pair: over query groups, count concordant/discordant score pairs
+    # (positive_negative_pair_op.cc); QueryID groups rows.
+    score, label, qid = ins["Score"][0], ins["Label"][0], ins["QueryID"][0]
+    s, l, q = score.reshape(-1), label.reshape(-1), qid.reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    ds = s[:, None] - s[None, :]
+    dl = l[:, None] - l[None, :]
+    valid = same_q & (dl > 0)                       # i more relevant than j
+    pos = jnp.sum(valid & (ds > 0))
+    neg = jnp.sum(valid & (ds < 0))
+    neu = jnp.sum(valid & (ds == 0))
+    return {"PositivePair": [pos.astype(jnp.float32)],
+            "NegativePair": [neg.astype(jnp.float32)],
+            "NeutralPair": [neu.astype(jnp.float32)]}
+
+
+# --------------------------------------------------------------- detection ---
+
+@OpRegistry.register("prior_box")
+def _prior_box(ins, attrs):
+    from ..ops.detection import prior_box
+    boxes, variances = prior_box(
+        tuple(attrs["feature_hw"]), tuple(attrs["image_hw"]),
+        min_size=attrs["min_size"], max_size=attrs.get("max_size"),
+        aspect_ratios=attrs.get("aspect_ratios", (2.0,)),
+        flip=attrs.get("flip", True), clip=attrs.get("clip", True),
+        variance=attrs.get("variance", (0.1, 0.1, 0.2, 0.2)))
+    return {"Boxes": [boxes], "Variances": [variances]}
+
+
+@OpRegistry.register("multibox_loss")
+def _mb_loss(ins, attrs):
+    from ..ops.detection import multibox_loss
+    loss = jax.vmap(
+        lambda lp, cl, gb, gl, gm: multibox_loss(
+            lp, cl, ins["PriorBox"][0], ins["PriorVar"][0], gb, gl, gm,
+            neg_pos_ratio=attrs.get("neg_pos_ratio", 3.0),
+            overlap_threshold=attrs.get("overlap_threshold", 0.5))
+    )(ins["Loc"][0], ins["Conf"][0], ins["GTBox"][0], ins["GTLabel"][0],
+      ins["GTMask"][0])
+    return {"Loss": [loss]}
+
+
+@OpRegistry.register("detection_output")
+def _det_out(ins, attrs):
+    from ..ops.detection import detection_output
+    boxes, scores, valid = jax.vmap(
+        lambda lp, cl: detection_output(
+            lp, cl, ins["PriorBox"][0], ins["PriorVar"][0],
+            num_classes=attrs["num_classes"],
+            background_id=attrs.get("background_id", 0),
+            iou_threshold=attrs.get("nms_threshold", 0.45),
+            score_threshold=attrs.get("score_threshold", 0.01),
+            keep_top_k=attrs.get("keep_top_k", 100))
+    )(ins["Loc"][0], ins["Conf"][0])
+    return {"Boxes": [boxes], "Scores": [scores], "Valid": [valid]}
+
+
+# ---------------------------------------------------------------- rnn units --
+
+@OpRegistry.register("lstm_unit")
+def _lstm_unit(ins, attrs):
+    from ..ops.rnn import LSTMState, lstm_cell
+    state = LSTMState(h=ins["HPrev"][0], c=ins["CPrev"][0])
+    new = lstm_cell(_x(ins), state, ins["U"][0],
+                    ins["B"][0] if "B" in ins else None,
+                    forget_bias=attrs.get("forget_bias", 0.0))
+    return {"H": [new.h], "C": [new.c]}
+
+
+@OpRegistry.register("gru_unit")
+def _gru_unit(ins, attrs):
+    from ..ops.rnn import gru_cell
+    h = gru_cell(_x(ins), ins["HPrev"][0], ins["U"][0],
+                 ins["B"][0] if "B" in ins else None)
+    return {"H": [h]}
+
+
+# ---------------------------------------------------------- optimizer ops ----
+# One op per family like operators/{adagrad,adadelta,rmsprop,adamax,
+# decayed_adagrad,proximal_gd,proximal_adagrad}_op.cc.
+
+@OpRegistry.register("adagrad")
+def _adagrad(ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = m + g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_new) + eps)],
+            "MomentOut": [m_new]}
+
+
+@OpRegistry.register("adadelta")
+def _adadelta(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ag, au = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho, eps = attrs.get("rho", 0.95), attrs.get("epsilon", 1e-6)
+    ag_new = rho * ag + (1 - rho) * g * g
+    upd = jnp.sqrt(au + eps) / jnp.sqrt(ag_new + eps) * g
+    au_new = rho * au + (1 - rho) * upd * upd
+    return {"ParamOut": [p - upd], "AvgSquaredGradOut": [ag_new],
+            "AvgSquaredUpdateOut": [au_new]}
+
+
+@OpRegistry.register("rmsprop")
+def _rmsprop(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    rho = attrs.get("decay", 0.9)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * g * g
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": [p - mom_new], "MeanSquareOut": [ms_new],
+            "MomentOut": [mom_new]}
+
+
+@OpRegistry.register("adamax")
+def _adamax(ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, u, b1p = ins["Moment"][0], ins["InfNorm"][0], ins["Beta1Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    u_new = jnp.maximum(b2 * u, jnp.abs(g))
+    p_new = p - (lr / (1 - b1p)) * m_new / (u_new + eps)
+    return {"ParamOut": [p_new], "MomentOut": [m_new], "InfNormOut": [u_new],
+            "Beta1PowOut": [b1p * b1]}
+
+
+@OpRegistry.register("decayed_adagrad")
+def _dec_adagrad(ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_new) + eps)],
+            "MomentOut": [m_new]}
+
+
+@OpRegistry.register("proximal_gd")
+def _prox_gd(ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    prox = p - lr * g
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return {"ParamOut": [p_new]}
+
+
+@OpRegistry.register("proximal_adagrad")
+def _prox_adagrad(ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    eps = 1e-10
+    m_new = m + g * g
+    eff_lr = lr / (jnp.sqrt(m_new) + eps)
+    prox = p - eff_lr * g
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0)
+             / (1.0 + eff_lr * l2))
+    return {"ParamOut": [p_new], "MomentOut": [m_new]}

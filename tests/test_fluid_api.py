@@ -1,0 +1,137 @@
+"""Fluid API completion tests: nets, regularizer, evaluator, optimizer zoo
+(python/paddle/v2/fluid/{nets,regularizer,evaluator,optimizer}.py analogs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, nets
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    fluid.executor._global_scope = fluid.Scope()
+    yield
+
+
+def _startup(exe):
+    exe.run(fluid.default_startup_program())
+
+
+def _toy_classification(opt, n_steps=25, regularization=None):
+    x = layers.data("x", shape=(10,))
+    y = layers.data("y", shape=(), dtype="int64")
+    h = layers.fc(x, 16, act="tanh")
+    logits = layers.fc(h, 2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    opt.minimize(loss, regularization=regularization)
+    exe = fluid.Executor()
+    _startup(exe)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 10).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.int64)
+    losses = [float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+              for _ in range(n_steps)]
+    return losses, exe
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (fluid.AdagradOptimizer, {"learning_rate": 0.1}),
+    (fluid.AdadeltaOptimizer, {"learning_rate": 1.0}),
+    (fluid.RMSPropOptimizer, {"learning_rate": 0.01}),
+    (fluid.AdamaxOptimizer, {"learning_rate": 0.05}),
+    (fluid.DecayedAdagradOptimizer, {"learning_rate": 0.1}),
+])
+def test_optimizer_zoo_learns(opt_cls, kw):
+    losses, _ = _toy_classification(opt_cls(**kw))
+    assert losses[-1] < losses[0] * 0.9, (opt_cls.__name__, losses[:3], losses[-3:])
+
+
+def test_l2_regularization_shrinks_weights():
+    losses, exe = _toy_classification(
+        fluid.SGDOptimizer(0.1), n_steps=40,
+        regularization=fluid.L2Decay(0.5))
+    scope = exe.scope
+    w = [np.asarray(scope.get(n)) for n in scope.vars if n.startswith("fc_w")]
+    norm_reg = sum(float(np.square(a).sum()) for a in w)
+
+    fluid.reset_default_programs()
+    fluid.executor._global_scope = fluid.Scope()
+    losses2, exe2 = _toy_classification(fluid.SGDOptimizer(0.1), n_steps=40)
+    w2 = [np.asarray(exe2.scope.get(n)) for n in exe2.scope.vars
+          if n.startswith("fc_w")]
+    norm_plain = sum(float(np.square(a).sum()) for a in w2)
+    assert norm_reg < norm_plain
+
+
+def test_l1_regularization_runs():
+    losses, _ = _toy_classification(fluid.SGDOptimizer(0.05), n_steps=10,
+                                    regularization=fluid.L1Decay(0.01))
+    assert np.isfinite(losses[-1])
+
+
+def test_simple_img_conv_pool_trains():
+    img = layers.data("img", shape=(12, 12, 1))
+    y = layers.data("y", shape=(), dtype="int64")
+    feat = nets.simple_img_conv_pool(img, num_filters=4, filter_size=3,
+                                     pool_size=2, pool_stride=2, act="relu")
+    logits = layers.fc(feat, 2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    fluid.AdamOptimizer(0.01).minimize(loss)
+    exe = fluid.Executor()
+    _startup(exe)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 12, 12, 1).astype(np.float32)
+    ys = rng.randint(0, 2, (8,)).astype(np.int64)
+    l0 = float(exe.run(feed={"img": xs, "y": ys}, fetch_list=[loss])[0])
+    for _ in range(15):
+        out = exe.run(feed={"img": xs, "y": ys}, fetch_list=[loss])
+    assert float(out[0]) < l0
+
+
+def test_img_conv_group_with_batchnorm():
+    img = layers.data("img", shape=(8, 8, 3))
+    feat = nets.img_conv_group(img, conv_num_filter=[4, 4], pool_size=2,
+                               pool_stride=2, conv_act="relu",
+                               conv_with_batchnorm=True)
+    exe = fluid.Executor()
+    _startup(exe)
+    xs = np.random.RandomState(0).randn(4, 8, 8, 3).astype(np.float32)
+    out, = exe.run(feed={"img": xs}, fetch_list=[feat])
+    assert out.shape == (4, 4, 4, 4) and np.isfinite(out).all()
+
+
+def test_accuracy_evaluator_accumulates():
+    x = layers.data("x", shape=(4,))
+    y = layers.data("y", shape=(), dtype="int64")
+    logits = layers.fc(x, 2)
+    ev = fluid.AccuracyEvaluator(logits, y)
+    exe = fluid.Executor()
+    _startup(exe)
+    ev.reset(exe)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        xs = rng.randn(16, 4).astype(np.float32)
+        ys = rng.randint(0, 2, (16,)).astype(np.int64)
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[ev.batch_acc])
+    acc = ev.eval(exe)
+    assert 0.0 <= acc <= 1.0
+    # totals accumulated over 3 batches of 16
+    total = float(np.asarray(exe.scope.get(ev._tot_total.name)))
+    assert total == 48.0
+
+
+def test_chunk_evaluator_f1():
+    tags = layers.data("tags", shape=(6,), dtype="int32")
+    labels = layers.data("labels", shape=(6,), dtype="int32")
+    lengths = layers.data("lengths", shape=(), dtype="int32")
+    ev = fluid.ChunkEvaluator(tags, labels, lengths)
+    exe = fluid.Executor()
+    _startup(exe)
+    ev.reset(exe)
+    # identical tags -> F1 == 1
+    t = np.array([[0, 1, 1, 0, 1, 0]], np.int32)
+    exe.run(feed={"tags": t, "labels": t,
+                  "lengths": np.array([6], np.int32)}, fetch_list=[])
+    assert ev.eval(exe) == pytest.approx(1.0)

@@ -1,0 +1,311 @@
+"""Fluid control flow + expanded registry tests.
+
+Book-style coverage for round-2 additions: while/cond/static_rnn lowering
+(while_op.cc, conditional_block_op.cc, recurrent_op.cc analogs), TensorArray
+ops, training-mode batch_norm, CRF-in-IR tagger, and a while-loop greedy
+decode — the dynamic-model story the round-1 verdict flagged as absent.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    fluid.executor._global_scope = fluid.Scope()
+    yield
+
+
+def _startup(exe):
+    exe.run(fluid.default_startup_program())
+
+
+# ---------------------------------------------------------------- while ------
+
+def test_while_loop_accumulates():
+    """sum 0..9 with a while loop over IR scalars."""
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", 10)
+    acc = layers.fill_constant((), "int32", 0)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        layers.elementwise_add(acc, i)  # tmp
+        # acc += i ; i += 1 ; cond = i < n   (all writing outer vars)
+        b = fluid.default_main_program().current_block()
+        b.append_op("elementwise_add", {"X": [acc.name], "Y": [i.name]},
+                    {"Out": [acc.name]})
+        layers.increment(i)
+        layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor()
+    out, iv = exe.run(feed={}, fetch_list=[acc, i])
+    assert int(out) == 45 and int(iv) == 10
+
+
+def test_while_requires_cond_update():
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", 3)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        layers.increment(i)   # cond never updated -> structural error
+    exe = fluid.Executor()
+    with pytest.raises(ValueError, match="never updated"):
+        exe.run(feed={}, fetch_list=[i])
+
+
+def test_while_array_write_read():
+    """TensorArray in a loop: arr[t] = t*t, then read back."""
+    cap = 8
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", cap)
+    sq = layers.fill_constant((), "float32", 0.0)
+    arr = layers.array_write(sq, i, capacity=cap)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        b = fluid.default_main_program().current_block()
+        fi = layers.cast(i, "float32")
+        t2 = layers.elementwise_mul(fi, fi)
+        layers.array_write(t2, i, array=arr)
+        layers.increment(i)
+        layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor()
+    out, = exe.run(feed={}, fetch_list=[arr])
+    np.testing.assert_allclose(out, np.arange(cap, dtype=np.float32) ** 2)
+
+
+# ----------------------------------------------------------------- cond ------
+
+def test_conditional_block_both_branches():
+    x = layers.data("x", shape=())
+    out = layers.fill_constant((), "float32", 0.0)
+    thresh = layers.fill_constant((), "float32", 5.0)
+    pred = layers.greater_than(x, thresh)
+    c = fluid.Cond(pred)
+    with c.true_block():
+        doubled = layers.elementwise_add(x, x)
+        layers.assign(doubled, out)
+    with c.false_block():
+        layers.assign(x, out)
+    exe = fluid.Executor()
+    hi, = exe.run(feed={"x": np.float32(7.0)}, fetch_list=[out])
+    lo, = exe.run(feed={"x": np.float32(3.0)}, fetch_list=[out])
+    assert float(hi) == 14.0 and float(lo) == 3.0
+
+
+# ------------------------------------------------------------- static_rnn ----
+
+def test_static_rnn_matches_manual_accumulation():
+    """rnn memory h += x_t over time == cumulative sum at the last step."""
+    B, T, D = 2, 5, 3
+    x = layers.data("x", shape=(T, D))
+    rnn = fluid.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h = rnn.memory(shape=(D,), value=0.0, batch_ref=x_t)
+        h_new = layers.elementwise_add(h, x_t)
+        rnn.update_memory(h, h_new)
+        rnn.step_output(h_new)
+    out, = rnn()
+    exe = fluid.Executor()
+    xs = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+    res, = exe.run(feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(res, np.cumsum(xs, axis=1), rtol=1e-5)
+
+
+def test_static_rnn_trains_through_scan():
+    """A learnable RNN built from fc ops inside the step block trains."""
+    B, T, D, H = 4, 6, 3, 8
+    x = layers.data("x", shape=(T, D))
+    y = layers.data("y", shape=(), dtype="int64")
+    rnn = fluid.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h = rnn.memory(shape=(H,), value=0.0, batch_ref=x_t)
+        merged = layers.concat([x_t, h], axis=1)
+        h_new = layers.fc(merged, H, act="tanh")
+        rnn.update_memory(h, h_new)
+        rnn.step_output(h_new)
+    out, = rnn()
+    last = rnn.get_last_mem(
+        # memory var is the first (and only) registered memory
+        type("V", (), {"name": rnn._mem_names[0], "shape": (B, H),
+                       "dtype": "float32"})())
+    logits = layers.fc(last, 2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    fluid.AdamOptimizer(0.05).minimize(loss)
+    exe = fluid.Executor()
+    _startup(exe)
+    rng = np.random.RandomState(1)
+    xs = rng.randn(B, T, D).astype(np.float32)
+    ys = (xs.sum(axis=(1, 2)) > 0).astype(np.int64)
+    losses = [float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7
+
+
+# ------------------------------------------------------------- batch norm ----
+
+def test_batch_norm_trains_and_updates_stats():
+    """A conv+BN net must train AND move its running stats (round-1 gap:
+    only batch_norm_infer existed, so no fluid program could train BN)."""
+    img = layers.data("img", shape=(8, 8, 3))
+    label = layers.data("label", shape=(), dtype="int64")
+    c = layers.conv2d(img, num_filters=4, filter_size=3, act=None)
+    bn = layers.batch_norm(c, act="relu")
+    pool = layers.pool2d(bn, global_pooling=True)
+    logits = layers.fc(pool, 2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    fluid.SGDOptimizer(0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    _startup(exe)
+    scope = fluid.executor._global_scope
+    mean_name = [n for n in scope.vars if "bn_mean" in n][0]
+    mean0 = np.asarray(scope.get(mean_name)).copy()
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 8, 8, 3).astype(np.float32) + 2.0   # nonzero mean
+    ys = rng.randint(0, 2, size=(8,)).astype(np.int64)
+    losses = [float(exe.run(feed={"img": xs, "label": ys},
+                            fetch_list=[loss])[0]) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    mean1 = np.asarray(scope.get(mean_name))
+    assert np.abs(mean1 - mean0).max() > 1e-3, "running mean never updated"
+    # eval mode uses the running stats (is_test path compiles and runs)
+    out = np.asarray(exe.run(feed={"img": xs, "label": ys},
+                             fetch_list=[loss])[0])
+    assert np.isfinite(out)
+
+
+# ---------------------------------------------------------------- CRF IR -----
+
+def test_crf_tagger_in_ir_trains_and_decodes():
+    """BiLSTM-CRF book shape: linear_chain_crf trains through Executor.run,
+    crf_decoding recovers training tags on an easy problem."""
+    B, T, D, N = 8, 6, 5, 3
+    x = layers.data("x", shape=(T, D))
+    tags = layers.data("tags", shape=(T,), dtype="int32")
+    lengths = layers.data("lengths", shape=(), dtype="int32")
+    emission = layers.fc(layers.reshape(x, (-1, D)), N)
+    emission = layers.reshape(emission, (B, T, N))
+    nll, trans = layers.linear_chain_crf(emission, tags, lengths)
+    loss = layers.mean(nll)
+    fluid.AdamOptimizer(0.1).minimize(loss)
+    path = layers.crf_decoding(emission, lengths, trans)
+
+    exe = fluid.Executor()
+    _startup(exe)
+    rng = np.random.RandomState(0)
+    # easy mapping: tag = argmax of first N dims of x
+    xs = rng.randn(B, T, D).astype(np.float32)
+    ys = np.argmax(xs[:, :, :N], axis=-1).astype(np.int32)
+    ls = np.full((B,), T, np.int32)
+    losses = []
+    for _ in range(60):
+        out = exe.run(feed={"x": xs, "tags": ys, "lengths": ls},
+                      fetch_list=[loss])
+        losses.append(float(out[0]))
+    assert losses[-1] < losses[0] * 0.5
+    decoded, = exe.run(feed={"x": xs, "tags": ys, "lengths": ls},
+                       fetch_list=[path])
+    assert (decoded == ys).mean() > 0.9
+
+
+# ------------------------------------------------- while-loop greedy decode --
+
+def test_while_loop_greedy_decode():
+    """Gen-2 generation story: a decoder loop in IR (array ops + while +
+    top_k) emits the argmax token chain of a fixed transition matrix."""
+    V, T = 5, 6
+    logits_table = layers.data("table", shape=(V,))     # [V, V] rows
+    start = layers.data("start", shape=())              # int32 scalar feed
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", T)
+    cur = layers.cast(start, "int64")
+    toks = layers.array_write(cur, i, capacity=T)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        b = fluid.default_main_program().current_block()
+        row = b.create_var(shape=(V,), dtype="float32")
+        b.append_op("gather", {"X": [logits_table.name], "Index": [cur.name]},
+                    {"Out": [row.name]})
+        _, idx = layers.topk(row, 1)
+        nxt = layers.cast(layers.reshape(idx, ()), "int64")
+        layers.assign(nxt, cur)
+        layers.increment(i)
+        layers.array_write(cur, i, array=toks)
+        layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    table = rng.randn(V, V).astype(np.float32)
+    out, = exe.run(feed={"table": table, "start": np.int32(2)},
+                   fetch_list=[toks])
+    # reference chain on host
+    want = [2]
+    for _ in range(T - 1):
+        want.append(int(np.argmax(table[want[-1]])))
+    np.testing.assert_array_equal(out[:T], np.asarray(want, np.int64)[:T])
+
+
+# ------------------------------------------------------------ new op smoke ---
+
+def test_new_optimizer_ops_registered_and_run():
+    from paddle_tpu.fluid.registry import OpRegistry
+    for name in ("adagrad", "adadelta", "rmsprop", "adamax", "decayed_adagrad",
+                 "proximal_gd", "proximal_adagrad", "batch_norm",
+                 "linear_chain_crf", "crf_decoding", "warpctc", "nce",
+                 "hierarchical_sigmoid", "auc", "chunk_eval", "sequence_expand",
+                 "gather", "scatter", "pad", "crop", "conv3d", "pool3d",
+                 "conv2d_transpose", "lrn", "maxout", "roi_pool", "row_conv",
+                 "while", "conditional_block", "static_rnn", "array_write",
+                 "array_read", "less_than", "increment"):
+        assert OpRegistry.has(name), f"op '{name}' missing from registry"
+    assert len(OpRegistry.registered()) >= 110
+
+
+def test_bn_stats_not_trainable_and_not_decayed():
+    """BN running stats must be excluded from parameters: optimizers and
+    regularizers would otherwise update/decay them (review r2 finding)."""
+    img = layers.data("img", shape=(4, 4, 2))
+    bn = layers.batch_norm(layers.conv2d(img, 2, 3, padding=1))
+    loss = layers.mean(bn)
+    import paddle_tpu.fluid as F
+    params = [v.name for v in
+              F.default_main_program().global_block().all_parameters()]
+    assert not any("bn_mean" in n or "bn_var" in n for n in params)
+    F.SGDOptimizer(0.1).minimize(loss, regularization=F.L2Decay(0.5))
+    exe = F.Executor()
+    exe.run(F.default_startup_program())
+    xs = np.random.RandomState(0).randn(4, 4, 4, 2).astype(np.float32)
+    for _ in range(5):
+        exe.run(feed={"img": xs}, fetch_list=[loss])
+    var_name = [n for n in exe.scope.vars if "bn_var" in n][0]
+    v = np.asarray(exe.scope.get(var_name))
+    assert v.min() > 0.1, "running variance was decayed toward zero"
+
+
+def test_persistable_written_in_while_subblock_syncs():
+    """A persistable counter incremented inside a while body must reach the
+    scope after run() (review r2 finding: written-scan skipped sub-blocks)."""
+    import paddle_tpu.fluid as F
+    main = F.default_main_program()
+    g = main.global_block()
+    counter = g.create_var(name="counter", shape=(), dtype="float32",
+                           persistable=True, trainable=False)
+    F.executor._global_scope.set("counter", np.float32(0.0))
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", 4)
+    cond = layers.less_than(i, n)
+    one = layers.fill_constant((), "float32", 1.0)
+    with F.While(cond).block():
+        b = main.current_block()
+        b.append_op("elementwise_add", {"X": [counter.name], "Y": [one.name]},
+                    {"Out": [counter.name]})
+        layers.increment(i)
+        layers.less_than(i, n, cond=cond)
+    exe = F.Executor()
+    exe.run(feed={}, fetch_list=[i])
+    assert float(np.asarray(exe.scope.get("counter"))) == 4.0
