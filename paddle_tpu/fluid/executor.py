@@ -258,9 +258,19 @@ class Executor:
         # back after the run — including writes inside control-flow sub-blocks
         # (those values flow to env via the loop carry; they must also be
         # listed here or the scope silently keeps the stale value)
+        top_written = {n for op in block.ops for n in op.output_vars()}
         written = list(dict.fromkeys(
             n for n in self._written_vars(program, block)
             if n in block.vars and block.vars[n].persistable))
+        # a persistable written ONLY in a sub-block must already have a value
+        # (scope or feed): the loop carry is derived from pre-existing env
+        # entries, so an uninitialized one would be silently dropped
+        for n in written:
+            if n not in top_written and n not in feed and not self.scope.has(n):
+                raise ValueError(
+                    f"persistable '{n}' is written inside a control-flow "
+                    "sub-block but has no initial value; initialize it in the "
+                    "scope (or a startup program) first")
 
         key = (program._serial, program.version, block.idx, tuple(fetch_names),
                tuple(persist_in),

@@ -517,14 +517,13 @@ class StaticRNN:
             ref_name = batch_ref.name
             if ref_name in self._step_in_names:
                 ref_name = self._outer_inputs[self._step_in_names.index(ref_name)]
-            with self.main.block_guard(self._parent):
-                b = self._parent
-                boot = b.create_var(shape=(batch_ref.shape[0],) + tuple(shape),
-                                    dtype=batch_ref.dtype)
-                b.append_op("fill_constant_batch_size_like",
-                            {"Input": [ref_name]}, {"Out": [boot.name]},
-                            {"shape": (1,) + tuple(shape), "value": value,
-                             "dtype": batch_ref.dtype})
+            b = self._parent   # boot op lives in the parent block
+            boot = b.create_var(shape=(batch_ref.shape[0],) + tuple(shape),
+                                dtype=batch_ref.dtype)
+            b.append_op("fill_constant_batch_size_like",
+                        {"Input": [ref_name]}, {"Out": [boot.name]},
+                        {"shape": (1,) + tuple(shape), "value": value,
+                         "dtype": batch_ref.dtype})
             init = boot
         v = self._sub.create_var(shape=tuple(init.shape), dtype=init.dtype)
         self._boot_mems.append(init.name)

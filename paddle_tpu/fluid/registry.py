@@ -440,7 +440,7 @@ def _array_read(ins, attrs):
 
 @OpRegistry.register("array_length")
 def _array_length(ins, attrs):
-    return {"Out": [jnp.asarray(ins["Array"][0].shape[0], jnp.int64)]}
+    return {"Out": [jnp.asarray(ins["Array"][0].shape[0], jnp.int32)]}
 
 
 @OpRegistry.register("lod_tensor_to_array")
@@ -575,11 +575,10 @@ def _scatter(ins, attrs):
 
 @OpRegistry.register("multiplex")
 def _multiplex(ins, attrs):
-    ids = ins["Ids"][0].reshape(-1)
+    # out[b] = X[ids[b]][b] (multiplex_op.cc row selection)
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
     stacked = jnp.stack(ins["X"], axis=0)          # [n, B, ...]
-    return {"Out": [jnp.take_along_axis(
-        stacked, ids[None, :, None].astype(jnp.int32)
-        if stacked.ndim == 3 else ids[None, :], axis=0)[0]]}
+    return {"Out": [stacked[ids, jnp.arange(ids.shape[0])]]}
 
 
 @OpRegistry.register("clip_by_norm")
@@ -832,8 +831,10 @@ def _seq_rev(ins, attrs):
 @OpRegistry.register("sequence_slice")
 def _seq_slice(ins, attrs):
     from ..ops.sequence import sequence_slice
-    return {"Out": [sequence_slice(_x(ins), ins["Lengths"][0],
-                                   ins["Offset"][0], ins["Length"][0])]}
+    x = _x(ins)
+    return {"Out": [sequence_slice(x, ins["Lengths"][0], ins["Offset"][0],
+                                   ins["Length"][0],
+                                   attrs.get("max_out", x.shape[1]))]}
 
 
 @OpRegistry.register("sequence_concat")

@@ -78,7 +78,7 @@ def iou_matrix(a: jax.Array, b: jax.Array) -> jax.Array:
 def encode_boxes(gt: jax.Array, priors: jax.Array,
                  variances: jax.Array) -> jax.Array:
     """Ground truth -> regression targets relative to priors (SSD encoding)."""
-    p_wh = priors[:, 2:] - priors[:, :2]
+    p_wh = jnp.maximum(priors[:, 2:] - priors[:, :2], 1e-8)
     p_c = (priors[:, :2] + priors[:, 2:]) / 2
     g_wh = jnp.maximum(gt[:, 2:] - gt[:, :2], 1e-8)
     g_c = (gt[:, :2] + gt[:, 2:]) / 2
@@ -142,8 +142,12 @@ def multibox_loss(loc_pred: jax.Array, conf_logits: jax.Array,
     matched, pos = match_priors(priors, gt_boxes, gt_mask, overlap_threshold)
     n_pos = jnp.sum(pos.astype(jnp.float32))
 
-    # localization: smooth L1 over positive priors
+    # localization: smooth L1 over positive priors. Targets for negatives are
+    # replaced by the prediction itself (zero loss) BEFORE the loss — padded
+    # gt slots hold arbitrary bytes and NaN * 0 would still poison the sum.
     targets = encode_boxes(gt_boxes[matched], priors, variances)
+    targets = jnp.where(pos[:, None], targets,
+                        jax.lax.stop_gradient(loc_pred))
     loc_l = jnp.sum(smooth_l1(loc_pred, targets), axis=-1)
     loc_loss = jnp.sum(loc_l * pos) / jnp.maximum(n_pos, 1.0)
 

@@ -129,10 +129,7 @@ def test_static_rnn_trains_through_scan():
         rnn.update_memory(h, h_new)
         rnn.step_output(h_new)
     out, = rnn()
-    last = rnn.get_last_mem(
-        # memory var is the first (and only) registered memory
-        type("V", (), {"name": rnn._mem_names[0], "shape": (B, H),
-                       "dtype": "float32"})())
+    last = rnn.get_last_mem(h)   # h stays in scope after the with-block
     logits = layers.fc(last, 2)
     loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
     fluid.AdamOptimizer(0.05).minimize(loss)
@@ -223,7 +220,9 @@ def test_while_loop_greedy_decode():
     logits_table = layers.data("table", shape=(V,))     # [V, V] rows
     start = layers.data("start", shape=())              # int32 scalar feed
     i = layers.fill_constant((), "int32", 0)
-    n = layers.fill_constant((), "int32", T)
+    # T-1 decode steps: slot 0 holds the start token, the loop's post-increment
+    # array_write fills slots 1..T-1 (an i==T write would be silently clamped)
+    n = layers.fill_constant((), "int32", T - 1)
     cur = layers.cast(start, "int64")
     toks = layers.array_write(cur, i, capacity=T)
     cond = layers.less_than(i, n)

@@ -1,0 +1,423 @@
+"""Registry-wide op sweep: every registered op gets at least an execution
+spec, and differentiable ops get a numeric-gradient check.
+
+This is the parametrized analog of the reference's per-op test files
+(python/paddle/v2/fluid/tests/test_*_op.py, ~100 files driven by op_test.py's
+get_numeric_gradient, and gserver/tests/test_LayerGrad.cpp). The key gate:
+``test_every_registered_op_is_covered`` FAILS when a new op is registered
+without a spec here, so registry growth stays test-gated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from op_test import check_grad
+from paddle_tpu.fluid.registry import OpRegistry
+
+R = np.random.RandomState(7)
+
+
+def f32(*shape):
+    return R.randn(*shape).astype(np.float32)
+
+
+def pos32(*shape):
+    return (R.rand(*shape).astype(np.float32) + 0.1)
+
+
+B, T, D, N, V, H = 2, 4, 3, 3, 8, 3
+LENGTHS = np.array([4, 2], np.int32)
+
+# Each spec: inputs dict, attrs dict, optional:
+#   grad: list of (slot, index) float inputs to numeric-grad check
+#   out: output slot to scalarize for grad (default: first returned)
+SPECS = {
+    # -- basic math ----------------------------------------------------------
+    "elementwise_add": dict(ins={"X": [f32(B, D)], "Y": [f32(B, D)]},
+                            grad=[("X", 0), ("Y", 0)]),
+    "elementwise_sub": dict(ins={"X": [f32(B, D)], "Y": [f32(B, D)]},
+                            grad=[("X", 0)]),
+    "elementwise_mul": dict(ins={"X": [f32(B, D)], "Y": [f32(B, D)]},
+                            grad=[("X", 0), ("Y", 0)]),
+    "elementwise_div": dict(ins={"X": [f32(B, D)], "Y": [pos32(B, D)]},
+                            grad=[("X", 0)]),
+    "mul": dict(ins={"X": [f32(B, D)], "Y": [f32(D, H)]},
+                grad=[("X", 0), ("Y", 0)]),
+    "matmul": dict(ins={"X": [f32(B, D)], "Y": [f32(D, H)]},
+                   grad=[("X", 0), ("Y", 0)]),
+    "scale": dict(ins={"X": [f32(B, D)]}, attrs={"scale": 2.0, "bias": 1.0},
+                  grad=[("X", 0)]),
+    "mean": dict(ins={"X": [f32(B, D)]}, grad=[("X", 0)]),
+    "sum": dict(ins={"X": [f32(B, D), f32(B, D)]}, grad=[("X", 0), ("X", 1)]),
+    "minus": dict(ins={"X": [f32(B, D)], "Y": [f32(B, D)]}, grad=[("X", 0)]),
+    "sign": dict(ins={"X": [f32(B, D)]}),
+    "pow": dict(ins={"X": [pos32(B, D)]}, attrs={"factor": 2.0},
+                grad=[("X", 0)]),
+    "reduce_sum": dict(ins={"X": [f32(B, T, D)]}, attrs={"dim": 1},
+                       grad=[("X", 0)]),
+    "reduce_mean": dict(ins={"X": [f32(B, T, D)]}, attrs={"dim": 1},
+                        grad=[("X", 0)]),
+    "reduce_max": dict(ins={"X": [f32(B, T, D)]}, attrs={"dim": 1}),
+    "reduce_min": dict(ins={"X": [f32(B, T, D)]}, attrs={"dim": 1}),
+    "reshape": dict(ins={"X": [f32(B, T, D)]}, attrs={"shape": (B, T * D)},
+                    grad=[("X", 0)]),
+    "transpose": dict(ins={"X": [f32(B, T, D)]}, attrs={"axis": (1, 0, 2)},
+                      grad=[("X", 0)]),
+    "concat": dict(ins={"X": [f32(B, D), f32(B, D)]}, attrs={"axis": 1},
+                   grad=[("X", 0)]),
+    "split": dict(ins={"X": [f32(B, 6)]},
+                  attrs={"num_or_sections": 2, "axis": 1}),
+    "cast": dict(ins={"X": [f32(B, D)]}, attrs={"dtype": "float32"}),
+    "clip": dict(ins={"X": [f32(B, D)]}, attrs={"min": -0.5, "max": 0.5}),
+    "clip_by_norm": dict(ins={"X": [f32(B, D)]}, attrs={"max_norm": 1.0},
+                         grad=[("X", 0)]),
+    "expand": dict(ins={"X": [f32(B, 1, D)]},
+                   attrs={"expand_times": (1, T, 1)}, grad=[("X", 0)]),
+    "pad": dict(ins={"X": [f32(B, D)]},
+                attrs={"paddings": ((0, 0), (1, 2)), "pad_value": 0.0},
+                grad=[("X", 0)]),
+    "crop": dict(ins={"X": [f32(B, 5)]},
+                 attrs={"offsets": (0, 1), "shape": (B, 3)}, grad=[("X", 0)]),
+    "gather": dict(ins={"X": [f32(V, D)],
+                        "Index": [np.array([1, 3, 5], np.int32)]},
+                   grad=[("X", 0)]),
+    "scatter": dict(ins={"Ref": [f32(V, D)],
+                         "Index": [np.array([1, 3], np.int32)],
+                         "Updates": [f32(2, D)]}, grad=[("Ref", 0)]),
+    "multiplex": dict(ins={"Ids": [np.array([0, 1], np.int32)],
+                           "X": [f32(B, D), f32(B, D)]}, grad=[("X", 0)]),
+    "l1_norm": dict(ins={"X": [f32(B, D)]}),
+    "squared_l2_norm": dict(ins={"X": [f32(B, D)]}, grad=[("X", 0)]),
+    "squared_l2_distance": dict(ins={"X": [f32(B, D)], "Y": [f32(B, D)]},
+                                grad=[("X", 0)]),
+    "cos_sim": dict(ins={"X": [f32(B, D)], "Y": [f32(B, D)]},
+                    grad=[("X", 0), ("Y", 0)]),
+    "l2_normalize": dict(ins={"X": [f32(B, D)]}, grad=[("X", 0)]),
+    "prelu": dict(ins={"X": [f32(B, D)], "Alpha": [pos32(1)]},
+                  grad=[("X", 0)]),
+    "conv_shift": dict(ins={"X": [f32(B, 6)], "Y": [f32(B, 3)]},
+                       grad=[("X", 0), ("Y", 0)]),
+    "bilinear_tensor_product": dict(
+        ins={"X": [f32(B, D)], "Y": [f32(B, D)], "Weight": [f32(2, D, D)],
+             "Bias": [f32(2)]}, grad=[("X", 0), ("Weight", 0)]),
+    "interpolation": dict(ins={"X": [f32(B, D)], "Y": [f32(B, D)],
+                               "W": [pos32(B)]}, grad=[("X", 0)]),
+    # -- fills / random / logic ---------------------------------------------
+    "fill_constant": dict(ins={}, attrs={"shape": (B, D), "value": 1.5}),
+    "fill_zeros_like": dict(ins={"X": [f32(B, D)]}),
+    "fill_constant_batch_size_like": dict(
+        ins={"Input": [f32(B, D)]},
+        attrs={"shape": (1, 5), "value": 0.5}),
+    "gaussian_random": dict(ins={}, attrs={"shape": (B, D), "seed": 1}),
+    "uniform_random": dict(ins={}, attrs={"shape": (B, D), "seed": 1}),
+    "dropout": dict(ins={"X": [f32(B, D)]},
+                    attrs={"dropout_prob": 0.5, "is_test": True}),
+    "assign": dict(ins={"X": [f32(B, D)]}),
+    "increment": dict(ins={"X": [np.int32(3)]}, attrs={"step": 2}),
+    "is_empty": dict(ins={"X": [f32(B, D)]}),
+    "less_than": dict(ins={"X": [f32(B)], "Y": [f32(B)]}),
+    "less_equal": dict(ins={"X": [f32(B)], "Y": [f32(B)]}),
+    "greater_than": dict(ins={"X": [f32(B)], "Y": [f32(B)]}),
+    "greater_equal": dict(ins={"X": [f32(B)], "Y": [f32(B)]}),
+    "equal": dict(ins={"X": [f32(B)], "Y": [f32(B)]}),
+    "not_equal": dict(ins={"X": [f32(B)], "Y": [f32(B)]}),
+    "logical_and": dict(ins={"X": [np.array([True, False])],
+                             "Y": [np.array([True, True])]}),
+    "logical_or": dict(ins={"X": [np.array([True, False])],
+                            "Y": [np.array([False, False])]}),
+    "logical_not": dict(ins={"X": [np.array([True, False])]}),
+    # -- arrays --------------------------------------------------------------
+    "array_write": dict(ins={"X": [f32(D)], "I": [np.int32(1)]},
+                        attrs={"capacity": 4}),
+    "array_read": dict(ins={"Array": [f32(4, D)], "I": [np.int32(2)]}),
+    "array_length": dict(ins={"Array": [f32(4, D)]}),
+    "lod_tensor_to_array": dict(ins={"X": [f32(B, T, D)]}),
+    "array_to_lod_tensor": dict(ins={"X": [f32(T, B, D)]}),
+    "lod_reset": dict(ins={"X": [f32(B, T)], "Lengths": [LENGTHS]}),
+    # -- activations ---------------------------------------------------------
+    **{a: dict(ins={"X": [f32(B, D)]}, grad=[("X", 0)])
+       for a in ("sigmoid", "tanh", "gelu", "softsign", "square",
+                 "softrelu", "stanh", "swish", "softmax", "log_softmax")},
+    **{a: dict(ins={"X": [f32(B, D)]})  # kinked/discontinuous: no grad check
+       for a in ("relu", "brelu", "leaky_relu", "elu", "abs", "abs_act",
+                 "soft_shrink", "hard_shrink", "thresholded_relu",
+                 "hard_sigmoid")},
+    "sqrt": dict(ins={"X": [pos32(B, D)]}, grad=[("X", 0)]),
+    "log": dict(ins={"X": [pos32(B, D)]}, grad=[("X", 0)]),
+    "reciprocal": dict(ins={"X": [pos32(B, D)]}, grad=[("X", 0)]),
+    "exponential": dict(ins={"X": [f32(B, D)]}, grad=[("X", 0)]),
+    # -- embedding / conv / pool / norm --------------------------------------
+    "lookup_table": dict(ins={"W": [f32(V, D)],
+                              "Ids": [np.array([[1, 2], [3, 4]], np.int32)]},
+                         grad=[("W", 0)]),
+    "conv2d": dict(ins={"Input": [f32(B, 5, 5, 2)],
+                        "Filter": [f32(3, 3, 2, 4)]},
+                   grad=[("Input", 0), ("Filter", 0)]),
+    "depthwise_conv2d": dict(ins={"Input": [f32(B, 5, 5, 2)],
+                                  "Filter": [f32(3, 3, 1, 2)]},
+                             grad=[("Filter", 0)]),
+    "conv2d_transpose": dict(ins={"Input": [f32(B, 3, 3, 2)],
+                                  "Filter": [f32(3, 3, 2, 4)]},
+                             grad=[("Filter", 0)]),
+    "conv3d": dict(ins={"Input": [f32(B, 4, 4, 4, 1)],
+                        "Filter": [f32(2, 2, 2, 1, 2)]},
+                   grad=[("Filter", 0)]),
+    "pool2d": dict(ins={"X": [f32(B, 4, 4, 2)]}, attrs={"ksize": 2}),
+    "pool3d": dict(ins={"X": [f32(B, 4, 4, 4, 1)]}, attrs={"ksize": 2}),
+    "pool2d_with_index": dict(ins={"X": [f32(B, 4, 4, 2)]},
+                              attrs={"ksize": 2}),
+    "lrn": dict(ins={"X": [f32(B, 4, 4, 5)]}, grad=[("X", 0)]),
+    "maxout": dict(ins={"X": [f32(B, 4, 4, 6)]}, attrs={"groups": 2}),
+    "roi_pool": dict(ins={"X": [f32(8, 8, 2)],   # single image [H, W, C]
+                          "ROIs": [np.array([[0, 0, 4, 4]], np.float32)]},
+                     attrs={"pooled_height": 2, "pooled_width": 2}),
+    "row_conv": dict(ins={"X": [f32(B, T, D)], "Filter": [f32(3, D)]},
+                     grad=[("Filter", 0)]),
+    "block_expand": dict(ins={"X": [f32(B, 4, 4, 2)]}, attrs={"block": 2}),
+    "bilinear_interp": dict(ins={"X": [f32(B, 4, 4, 2)]},
+                            attrs={"out_h": 8, "out_w": 8}, grad=[("X", 0)]),
+    "spp": dict(ins={"X": [f32(B, 6, 6, 2)]}, attrs={"pyramid_height": 2}),
+    "batch_norm": dict(ins={"X": [f32(B, T, 2)], "Scale": [pos32(2)],
+                            "Bias": [f32(2)], "Mean": [f32(2) * 0],
+                            "Variance": [pos32(2)]},
+                       out="Y", grad=[("X", 0), ("Scale", 0), ("Bias", 0)]),
+    "batch_norm_infer": dict(ins={"X": [f32(B, T, 2)], "Scale": [pos32(2)],
+                                  "Bias": [f32(2)], "Mean": [f32(2) * 0],
+                                  "Variance": [pos32(2)]}),
+    "layer_norm": dict(ins={"X": [f32(B, D)], "Scale": [pos32(D)],
+                            "Bias": [f32(D)]}, grad=[("X", 0), ("Scale", 0)]),
+    # -- losses --------------------------------------------------------------
+    "cross_entropy": dict(
+        ins={"X": [np.abs(f32(B, N)) + 0.2], "Label": [np.array([0, 2])]},
+        out="Y", grad=[("X", 0)]),
+    "softmax_with_cross_entropy": dict(
+        ins={"Logits": [f32(B, N)], "Label": [np.array([0, 2])]},
+        out="Loss", grad=[("Logits", 0)]),
+    "sigmoid_cross_entropy_with_logits": dict(
+        ins={"X": [f32(B, N)], "Label": [R.rand(B, N).astype(np.float32)]},
+        grad=[("X", 0)]),
+    "square_error": dict(ins={"X": [f32(B, 1)], "Label": [f32(B, 1)]},
+                         grad=[("X", 0)]),
+    "smooth_l1_loss": dict(ins={"X": [f32(B, D)], "Label": [f32(B, D)]},
+                           grad=[("X", 0)]),
+    "huber_loss": dict(ins={"X": [f32(B, 1)], "Label": [f32(B, 1)]}),
+    "modified_huber_loss": dict(
+        ins={"X": [f32(B, 1)],
+             "Label": [np.array([[1.0], [-1.0]], np.float32)]}),
+    "hinge_loss": dict(ins={"X": [f32(B, 1)],
+                            "Label": [np.array([[1.0], [-1.0]], np.float32)]}),
+    "log_loss": dict(ins={"Predicted": [R.rand(B, 1).astype(np.float32) * 0.8
+                                        + 0.1],
+                          "Label": [np.array([[1.0], [0.0]], np.float32)]},
+                     grad=[("Predicted", 0)]),
+    "rank_loss": dict(ins={"Left": [f32(B, 1)], "Right": [f32(B, 1)],
+                           "Label": [np.array([[1.0], [0.0]], np.float32)]},
+                      grad=[("Left", 0)]),
+    "margin_rank_loss": dict(
+        ins={"X1": [f32(B, 1)], "X2": [f32(B, 1)],
+             "Label": [np.array([[1.0], [-1.0]], np.float32)]},
+        attrs={"margin": 0.1}),
+    "multi_binary_label_cross_entropy": dict(
+        ins={"X": [f32(B, N)],
+             "Label": [R.randint(0, 2, (B, N)).astype(np.float32)]},
+        grad=[("X", 0)]),
+    "soft_binary_class_cross_entropy": dict(
+        ins={"X": [R.rand(B, N).astype(np.float32) * 0.8 + 0.1],
+             "Label": [R.rand(B, N).astype(np.float32)]}, grad=[("X", 0)]),
+    "kldiv_loss": dict(
+        ins={"X": [np.log(R.dirichlet(np.ones(N), B).astype(np.float32))],
+             "Target": [R.dirichlet(np.ones(N), B).astype(np.float32)]}),
+    # -- metrics -------------------------------------------------------------
+    "accuracy": dict(ins={"Out": [f32(B, N)], "Label": [np.array([0, 2])]}),
+    "top_k": dict(ins={"X": [f32(B, V)]}, attrs={"k": 3}),
+    "auc": dict(ins={"Out": [R.rand(8).astype(np.float32)],
+                     "Label": [R.randint(0, 2, 8).astype(np.int32)]}),
+    "precision_recall": dict(
+        ins={"Out": [R.randint(0, N, 8).astype(np.int32)],
+             "Label": [R.randint(0, N, 8).astype(np.int32)]},
+        attrs={"num_classes": N}),
+    "chunk_eval": dict(
+        ins={"Inference": [R.randint(0, 2, (B, T)).astype(np.int32)],
+             "Label": [R.randint(0, 2, (B, T)).astype(np.int32)],
+             "Lengths": [LENGTHS]}),
+    "positive_negative_pair": dict(
+        ins={"Score": [R.rand(6).astype(np.float32)],
+             "Label": [R.randint(0, 3, 6).astype(np.float32)],
+             "QueryID": [np.array([0, 0, 0, 1, 1, 1], np.int32)]}),
+    # -- sequences -----------------------------------------------------------
+    "sequence_pool": dict(ins={"X": [f32(B, T, D)], "Lengths": [LENGTHS]},
+                          attrs={"pool_type": "average"}, grad=[("X", 0)]),
+    "sequence_last_step": dict(ins={"X": [f32(B, T, D)],
+                                    "Lengths": [LENGTHS]}),
+    "sequence_first_step": dict(ins={"X": [f32(B, T, D)],
+                                     "Lengths": [LENGTHS]}),
+    "sequence_expand": dict(ins={"X": [f32(B, D)], "RefLengths": [LENGTHS]},
+                            attrs={"max_len": T}),
+    "sequence_softmax": dict(ins={"X": [f32(B, T)], "Lengths": [LENGTHS]}),
+    "sequence_reverse": dict(ins={"X": [f32(B, T, D)],
+                                  "Lengths": [LENGTHS]}),
+    "sequence_slice": dict(ins={"X": [f32(B, T, D)], "Lengths": [LENGTHS],
+                                "Offset": [np.array([0, 0], np.int32)],
+                                "Length": [np.array([2, 2], np.int32)]}),
+    "sequence_concat": dict(ins={"X": [f32(B, T, D)], "XLengths": [LENGTHS],
+                                 "Y": [f32(B, T, D)], "YLengths": [LENGTHS]}),
+    "context_projection": dict(ins={"X": [f32(B, T, D)],
+                                    "Lengths": [LENGTHS]},
+                               attrs={"context_start": -1,
+                                      "context_length": 3}),
+    "sequence_conv": dict(ins={"X": [f32(B, T, D)], "Lengths": [LENGTHS],
+                               "Filter": [f32(3 * D, H)]},
+                          grad=[("Filter", 0)]),
+    # -- recurrent -----------------------------------------------------------
+    "lstm": dict(ins={"X": [f32(B, T, D)], "Lengths": [LENGTHS],
+                      "W": [f32(D, 4 * H)], "U": [f32(H, 4 * H)],
+                      "B": [f32(4 * H)]}, out="Out",
+                 grad=[("W", 0), ("U", 0)]),
+    "gru": dict(ins={"X": [f32(B, T, D)], "Lengths": [LENGTHS],
+                     "W": [f32(D, 3 * H)], "U": [f32(H, 3 * H)],
+                     "B": [f32(3 * H)]}, out="Out", grad=[("W", 0)]),
+    "lstm_unit": dict(ins={"X": [f32(B, 4 * H)], "HPrev": [f32(B, H)],
+                           "CPrev": [f32(B, H)], "U": [f32(H, 4 * H)],
+                           "B": [f32(4 * H)]}, out="H",
+                      grad=[("X", 0), ("U", 0)]),
+    "gru_unit": dict(ins={"X": [f32(B, 3 * H)], "HPrev": [f32(B, H)],
+                          "U": [f32(H, 3 * H)], "B": [f32(3 * H)]}, out="H",
+                     grad=[("X", 0)]),
+    # -- CRF / CTC / NCE -----------------------------------------------------
+    "linear_chain_crf": dict(
+        ins={"Emission": [f32(B, T, N)],
+             "Label": [R.randint(0, N, (B, T)).astype(np.int32)],
+             "Lengths": [LENGTHS], "Transition": [f32(N + 2, N)]},
+        out="LogLikelihood", grad=[("Emission", 0), ("Transition", 0)]),
+    "crf_decoding": dict(
+        ins={"Emission": [f32(B, T, N)], "Lengths": [LENGTHS],
+             "Transition": [f32(N + 2, N)]}),
+    "warpctc": dict(
+        ins={"Logits": [jax.nn.log_softmax(jnp.asarray(f32(B, 6, 5)))],
+             "LogitsLengths": [np.array([6, 5], np.int32)],
+             "Label": [R.randint(1, 5, (B, 2)).astype(np.int32)],
+             "LabelLengths": [np.array([2, 1], np.int32)]},
+        out="Loss", grad=[("Logits", 0)]),
+    "ctc_greedy_decode": dict(
+        ins={"Logits": [f32(B, 6, 5)],
+             "LogitsLengths": [np.array([6, 5], np.int32)]}),
+    "nce": dict(ins={"Input": [f32(B, D)],
+                     "Label": [R.randint(0, V, B).astype(np.int32)],
+                     "Weight": [f32(V, D)], "Bias": [f32(V)]},
+                attrs={"num_neg_samples": 3}, out="Cost"),
+    "hierarchical_sigmoid": dict(
+        ins={"Input": [f32(B, D)],
+             "Label": [R.randint(0, 4, B).astype(np.int32)],
+             "InnerW": [f32(8, D)],
+             "Paths": [R.randint(0, 8, (4, 3)).astype(np.int32)],
+             "Codes": [R.randint(0, 2, (4, 3)).astype(np.int32)]},
+        out="Cost", grad=[("Input", 0), ("InnerW", 0)]),
+    # -- detection -----------------------------------------------------------
+    "prior_box": dict(ins={}, attrs={"feature_hw": (2, 2),
+                                     "image_hw": (16, 16),
+                                     "min_size": 4.0}),
+    "multibox_loss": dict(
+        ins={"Loc": [f32(1, 4, 4)],
+             "Conf": [f32(1, 4, N)],
+             "PriorBox": [R.rand(4, 4).astype(np.float32)],
+             "PriorVar": [np.tile(np.float32([0.1, 0.1, 0.2, 0.2]), (4, 1))],
+             "GTBox": [R.rand(1, 2, 4).astype(np.float32)],
+             "GTLabel": [np.array([[1, 2]], np.int32)],
+             "GTMask": [np.array([[1.0, 0.0]], np.float32)]},
+        out="Loss"),
+    "detection_output": dict(
+        ins={"Loc": [f32(1, 4, 4)], "Conf": [f32(1, 4, N)],
+             "PriorBox": [R.rand(4, 4).astype(np.float32)],
+             "PriorVar": [np.tile(np.float32([0.1, 0.1, 0.2, 0.2]), (4, 1))]},
+        attrs={"num_classes": N}),
+    # -- optimizer ops -------------------------------------------------------
+    "sgd": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                     "LearningRate": [np.float32(0.1)]}),
+    "momentum": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                          "Velocity": [f32(D) * 0],
+                          "LearningRate": [np.float32(0.1)]}),
+    "adam": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                      "Moment1": [f32(D) * 0], "Moment2": [pos32(D)],
+                      "Beta1Pow": [np.float32(0.9)],
+                      "Beta2Pow": [np.float32(0.999)],
+                      "LearningRate": [np.float32(0.1)]}),
+    "adagrad": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                         "Moment": [pos32(D)],
+                         "LearningRate": [np.float32(0.1)]}),
+    "adadelta": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                          "AvgSquaredGrad": [pos32(D)],
+                          "AvgSquaredUpdate": [pos32(D)]}),
+    "rmsprop": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                         "MeanSquare": [pos32(D)], "Moment": [f32(D) * 0],
+                         "LearningRate": [np.float32(0.1)]}),
+    "adamax": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                        "Moment": [f32(D) * 0], "InfNorm": [pos32(D)],
+                        "Beta1Pow": [np.float32(0.9)],
+                        "LearningRate": [np.float32(0.1)]}),
+    "decayed_adagrad": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                                 "Moment": [pos32(D)],
+                                 "LearningRate": [np.float32(0.1)]}),
+    "proximal_gd": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                             "LearningRate": [np.float32(0.1)]},
+                        attrs={"l1": 0.01, "l2": 0.01}),
+    "proximal_adagrad": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                                  "Moment": [pos32(D)],
+                                  "LearningRate": [np.float32(0.1)]},
+                             attrs={"l1": 0.01, "l2": 0.01}),
+}
+
+# ops that cannot be run standalone (structural / host-side)
+EXEMPT = {"while", "conditional_block", "static_rnn", "autodiff_grad",
+          "fill_init"}
+
+
+def test_every_registered_op_is_covered():
+    missing = [op for op in OpRegistry.registered()
+               if op not in SPECS and op not in EXEMPT]
+    assert not missing, f"registered ops without sweep specs: {missing}"
+
+
+@pytest.mark.parametrize("op_type", sorted(SPECS))
+def test_op_executes_finite(op_type):
+    spec = SPECS[op_type]
+    compute = OpRegistry.get(op_type)
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in spec["ins"].items()}
+    outs = compute(ins, dict(spec.get("attrs", {})))
+    assert isinstance(outs, dict) and outs, f"{op_type} returned {outs!r}"
+    for key, vals in outs.items():
+        for v in vals:
+            arr = np.asarray(v)
+            if np.issubdtype(arr.dtype, np.floating):
+                assert np.isfinite(arr).all(), f"{op_type}.{key} not finite"
+
+
+GRAD_CASES = [(op, slot, idx) for op, spec in SPECS.items()
+              for slot, idx in spec.get("grad", [])]
+
+
+@pytest.mark.parametrize("op_type,slot,idx",
+                         GRAD_CASES,
+                         ids=[f"{o}:{s}{i}" for o, s, i in GRAD_CASES])
+def test_op_numeric_gradient(op_type, slot, idx):
+    spec = SPECS[op_type]
+    compute = OpRegistry.get(op_type)
+    attrs = dict(spec.get("attrs", {}))
+    out_key = spec.get("out")
+
+    keys = [(k, i) for k, vs in spec["ins"].items() for i in range(len(vs))]
+    flat_args = [np.asarray(spec["ins"][k][i]) for k, i in keys]
+    wrt = keys.index((slot, idx))
+
+    def f(*args):
+        ins = {}
+        for (k, i), a in zip(keys, args):
+            ins.setdefault(k, []).append(jnp.asarray(a))
+        outs = compute(ins, attrs)
+        key = out_key or next(iter(outs))
+        return jnp.sum(outs[key][0])
+
+    check_grad(f, flat_args, wrt=wrt, rtol=7e-2, atol=5e-3)
