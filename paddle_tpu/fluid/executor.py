@@ -96,6 +96,9 @@ def _trace_ops(ops, env: Dict[str, Any], ctx: TraceContext):
         if op.type == "static_rnn":
             _trace_static_rnn(op, env, ctx)
             continue
+        if op.type == "beam_search_gen":
+            _trace_beam_search_gen(op, env, ctx)
+            continue
         compute = OpRegistry.get(op.type)
         ins = {k: [env[n] for n in vs] for k, vs in op.inputs.items()}
         outs = compute(ins, op.attrs)
@@ -227,6 +230,50 @@ def _trace_static_rnn(op, env, ctx: TraceContext):
             env[name] = c
 
 
+def _trace_beam_search_gen(op, env, ctx: TraceContext):
+    """Lower a beam_search_gen op: the user's step sub-block becomes the
+    step_fn of the on-device masked-top-k beam decode (ops/beam_search.py).
+
+    The reference runs beam search on CPU with per-step frame cloning and
+    Python callbacks (RecurrentGradientMachine::beamSearch:1020); here the
+    whole decode is one lax.scan — memories and static (encoder) inputs ride
+    the beam 'cell' so they tile across beams together.
+    """
+    from ..ops.beam_search import beam_search
+    a = op.attrs
+    sub = ctx.program.blocks[a["sub_block_idx"]]
+    embed_w = env[a["embed_param"]]
+    boots = tuple(env[n] for n in a["boot_mems"])
+    statics = tuple(env[n] for n in a["static_outer"])
+    B = (boots[0].shape[0] if boots else statics[0].shape[0])
+    K = a["beam_size"]
+    V = embed_w.shape[0]
+    # statics are invariant across beams AND steps: tile to [B*K, ...] ONCE
+    # and close over them — carrying them in the scan cell would reshape and
+    # beam-gather the whole encoder tensor every decode step for no effect
+    tiled = tuple(jnp.broadcast_to(s[:, None], (B, K) + s.shape[1:])
+                  .reshape((B * K,) + s.shape[1:]) for s in statics)
+
+    def step_fn(mems, tokens):
+        env2 = dict(env)
+        env2.update(zip(a["mem_names"], mems))
+        env2.update(zip(a["static_in_names"], tiled))
+        env2[a["token_embed_name"]] = jnp.take(embed_w, tokens, axis=0)
+        _trace_ops(sub.ops, env2, ctx)
+        probs = env2[a["prob_name"]]
+        logp = jnp.log(jnp.maximum(probs, 1e-9))
+        new_mems = tuple(env2[n] for n in a["mem_update_names"])
+        return logp, new_mems
+
+    toks, scores = beam_search(
+        boots, step_fn, batch_size=B,
+        beam_size=K, max_len=a["max_length"], vocab_size=V,
+        bos_id=a["bos_id"], eos_id=a["eos_id"],
+        length_penalty=a.get("length_penalty", 0.0))
+    env[op.outputs["Tokens"][0]] = toks
+    env[op.outputs["Scores"][0]] = scores
+
+
 class Executor:
     """exe.run(program, feed=..., fetch_list=...) (fluid/executor.py:7-20)."""
 
@@ -244,7 +291,8 @@ class Executor:
         from .framework import default_main_program
         program = program or default_main_program()
         feed = {k: jnp.asarray(v) for k, v in (feed or {}).items()}
-        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+        # anything with a .name (Variable, v2 LayerOutput) or a plain string
+        fetch_names = [v if isinstance(v, str) else v.name
                        for v in (fetch_list or [])]
         block = program.global_block()
         if "__step__" in block.vars and "__step__" not in feed:

@@ -806,3 +806,22 @@ def chunk_eval(inference: Variable, label: Variable, lengths: Variable,
                 {"chunk_scheme": chunk_scheme,
                  "num_chunk_types": num_chunk_types})
     return c, p, l
+
+
+def squeeze(x: Variable, axis: int) -> Variable:
+    b = _block()
+    shape = tuple(s for i, s in enumerate(x.shape) if i != axis % len(x.shape))
+    out = b.create_var(shape=shape, dtype=x.dtype)
+    b.append_op("squeeze", {"X": [x.name]}, {"Out": [out.name]},
+                {"axis": axis})
+    return out
+
+
+def unsqueeze(x: Variable, axis: int) -> Variable:
+    b = _block()
+    shape = list(x.shape)
+    shape.insert(axis % (len(x.shape) + 1), 1)
+    out = b.create_var(shape=tuple(shape), dtype=x.dtype)
+    b.append_op("unsqueeze", {"X": [x.name]}, {"Out": [out.name]},
+                {"axis": axis})
+    return out

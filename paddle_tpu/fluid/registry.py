@@ -409,7 +409,7 @@ def _seq_first(ins, attrs):
 # _trace_static_rnn) — ref: while_op.cc, conditional_block_op.cc,
 # recurrent_op.cc. Registered so Operator construction validates.
 
-for _cf in ("while", "conditional_block", "static_rnn"):
+for _cf in ("while", "conditional_block", "static_rnn", "beam_search_gen"):
     def _cf_stub(ins, attrs, _n=_cf):
         raise RuntimeError(f"'{_n}' is lowered by the executor, not run directly")
     OpRegistry._ops[_cf] = _cf_stub
@@ -1117,3 +1117,13 @@ def _prox_adagrad(ins, attrs):
     p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0)
              / (1.0 + eff_lr * l2))
     return {"ParamOut": [p_new], "MomentOut": [m_new]}
+
+
+@OpRegistry.register("squeeze")
+def _squeeze(ins, attrs):
+    return {"Out": [jnp.squeeze(_x(ins), axis=attrs.get("axis"))]}
+
+
+@OpRegistry.register("unsqueeze")
+def _unsqueeze(ins, attrs):
+    return {"Out": [jnp.expand_dims(_x(ins), axis=attrs["axis"])]}

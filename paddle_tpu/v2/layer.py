@@ -50,9 +50,18 @@ def data(name: str, type: InputType) -> LayerOutput:
     return LayerOutput(v, None, type)
 
 
-def fc(input: LayerOutput, size: int, act: Optional[str] = None,
-       bias_attr: bool = True) -> LayerOutput:
-    return LayerOutput(FL.fc(input.var, size, act=act, bias_attr=bias_attr))
+def fc(input, size: int, act: Optional[str] = None,
+       bias_attr: bool = True, name: Optional[str] = None) -> LayerOutput:
+    """Accepts a single layer or a list (concatenated, like the reference's
+    multi-input fc). ``name`` registers the output for memory() binding
+    inside a recurrent_group/beam_search step."""
+    if isinstance(input, (list, tuple)):
+        var = FL.concat([i.var for i in input], axis=-1)
+    else:
+        var = input.var
+    out = FL.fc(var, size, act=act, bias_attr=bias_attr)
+    _register_named(name, out)
+    return LayerOutput(out)
 
 
 def embedding(input: LayerOutput, size: int) -> LayerOutput:
@@ -170,3 +179,237 @@ def cross_entropy_cost(input: LayerOutput, label: LayerOutput) -> LayerOutput:
 def square_error_cost(input: LayerOutput, label: LayerOutput) -> LayerOutput:
     d = FL.elementwise_sub(input.var, label.var)
     return LayerOutput(FL.mean(FL.elementwise_mul(d, d)))
+
+
+# =============================================================================
+# recurrent_group / memory / StaticInput / beam generation
+# (trainer_config_helpers/layers.py:3939 recurrent_group, :3909 StaticInput,
+# memory; RecurrentGradientMachine.cpp:964 generateSequence, :1020 beamSearch).
+# TPU-native lowering: recurrent_group -> one lax.scan (fluid StaticRNN op);
+# generation -> the on-device masked-top-k beam decode (ops/beam_search.py)
+# with the user's step net traced as the per-step function.
+# =============================================================================
+
+import contextlib as _ctxlib
+
+from .. import fluid as _fluid
+
+
+class StaticInput:
+    """Non-scanned input visible unchanged at every step (layers.py:3909).
+    In generation it is tiled across beams together with the memories."""
+
+    def __init__(self, input: LayerOutput):
+        self.layer = input
+
+
+class GeneratedInput:
+    """The generation feedback input: at step t the decoder receives the
+    embedding of the token emitted at t-1 (GeneratedInput in the reference's
+    beam-gen DSL). ``embedding_param`` shares a training-time embedding
+    table; otherwise a fresh [vocab, embedding_size] table is created."""
+
+    def __init__(self, size: int, embedding_size: int, embedding_param=None):
+        self.vocab_size = size
+        self.embedding_size = embedding_size
+        self.embedding_param = embedding_param
+
+
+class _RGContext:
+    def __init__(self, kind, rnn=None, sub=None):
+        self.kind = kind               # "rg" | "beam"
+        self.rnn = rnn
+        self.sub = sub
+        self.batch_ref = None          # a step-input var for zero boots
+        self.memories = []             # (name, mem Variable, boot_name|None)
+        self.named_outputs = {}        # name -> Variable
+
+
+_rg_stack: List[_RGContext] = []
+
+
+def _active_rg() -> Optional[_RGContext]:
+    return _rg_stack[-1] if _rg_stack else None
+
+
+@_ctxlib.contextmanager
+def _push_rg(ctx: _RGContext):
+    _rg_stack.append(ctx)
+    try:
+        yield ctx
+    finally:
+        _rg_stack.pop()
+
+
+def _register_named(name: Optional[str], var: Variable):
+    ctx = _active_rg()
+    if ctx is not None and name:
+        ctx.named_outputs[name] = var
+
+
+def memory(name: str, size: int,
+           boot_layer: Optional[LayerOutput] = None) -> LayerOutput:
+    """Previous-step value of the step-net output called ``name``
+    (layers.py memory semantics: the layer with the matching name updates
+    this memory). Booted from ``boot_layer`` (an outer-graph layer — the
+    MemoryFrameLine bootLayer, RecurrentGradientMachine.h:329) or zeros."""
+    ctx = _active_rg()
+    if ctx is None:
+        raise ValueError("memory() is only valid inside a recurrent_group "
+                         "or beam_search step function")
+    if ctx.kind == "rg":
+        if boot_layer is not None:
+            mem = ctx.rnn.memory(init=boot_layer.var)
+        else:
+            mem = ctx.rnn.memory(shape=(size,), value=0.0,
+                                 batch_ref=ctx.batch_ref)
+        ctx.memories.append((name, mem, None))
+        return LayerOutput(mem)
+    # beam: inner var fed from the (beam-tiled) cell each step
+    if boot_layer is None:
+        raise ValueError("generation memories need boot_layer= (decoder "
+                         "state boots from the encoder)")
+    v = ctx.sub.create_var(shape=(-1, size), dtype="float32")
+    ctx.memories.append((name, v, boot_layer.var.name))
+    return LayerOutput(v)
+
+
+def identity(input: LayerOutput, name: Optional[str] = None) -> LayerOutput:
+    """Name a step-net output so a memory() can bind to it (the reference
+    binds by layer name; our builders auto-name, so this is the explicit
+    binding point)."""
+    _register_named(name, input.var)
+    return input
+
+
+def recurrent_group(step, input, reverse: bool = False):
+    """User-composed step network scanned over a sequence — the signature
+    capability of RecurrentGradientMachine, compiled to ONE lax.scan.
+
+    ``input``: a sequence LayerOutput, or a list mixing sequence layers and
+    StaticInput wrappers. ``step(*step_args)`` builds the per-step net with
+    v2 layers; memories declared via ``memory(name=...)`` update from the
+    step output registered under the same name (fc(..., name=...) or
+    identity(..., name=...)).
+    """
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    seq_inputs = [i for i in inputs if isinstance(i, LayerOutput)]
+    if not seq_inputs:
+        raise ValueError("recurrent_group needs at least one sequence input")
+    lengths = next((i.lengths for i in seq_inputs if i.lengths is not None),
+                   None)
+    if reverse:
+        if any(i.lengths is None for i in seq_inputs):
+            raise ValueError(
+                "recurrent_group(reverse=True) needs sequence inputs with "
+                "lengths (sequence_reverse is length-aware); wrap plain "
+                "tensors in a LayerOutput carrying the lengths var")
+        inputs = [_seq_op("sequence_reverse", i, seq_out=True)
+                  if isinstance(i, LayerOutput) else i for i in inputs]
+
+    rnn = _fluid.StaticRNN()
+    ctx = _RGContext("rg", rnn=rnn)
+    with rnn.step(), _push_rg(ctx):
+        args = []
+        for i in inputs:
+            if isinstance(i, StaticInput):
+                args.append(i.layer)          # outer var, closed over
+            else:
+                x_t = rnn.step_input(i.var)
+                if ctx.batch_ref is None:
+                    ctx.batch_ref = x_t
+                args.append(LayerOutput(x_t))
+        outs = step(*args)
+        outs = [outs] if isinstance(outs, LayerOutput) else list(outs)
+        for name, mem, _ in ctx.memories:
+            if name not in ctx.named_outputs:
+                raise ValueError(
+                    f"memory '{name}' has no matching named step output; "
+                    f"name one with fc(..., name='{name}') or identity()")
+            rnn.update_memory(mem, LayerOutput(ctx.named_outputs[name]).var)
+        for o in outs:
+            rnn.step_output(o.var)
+    result = rnn()
+    wrapped = []
+    for v in result:
+        lo = LayerOutput(v, lengths)
+        if reverse:
+            lo = _seq_op("sequence_reverse", lo, seq_out=True)
+        wrapped.append(lo)
+    return wrapped[0] if len(wrapped) == 1 else wrapped
+
+
+def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
+                max_length: int = 20, length_penalty: float = 0.0):
+    """Beam-search generation over a user step net (layers.py beam_search /
+    generateSequence:964). Returns (tokens, scores) LayerOutputs with shapes
+    [B, beam, max_length] / [B, beam], best-first.
+
+    ``input``: one GeneratedInput (prev-token embedding feedback) plus any
+    StaticInputs (encoder outputs etc. — tiled across beams). Memories boot
+    from outer layers via memory(..., boot_layer=...). The step must return
+    per-class *probabilities* [_, vocab] (softmax output, like the
+    reference's generating sub-model).
+    """
+    main = default_main_program()
+    gens = [i for i in input if isinstance(i, GeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    g = gens[0]
+    if g.embedding_param is not None:
+        embed_w = g.embedding_param
+    else:
+        embed_w = FL._create_parameter(
+            "gen_embed_w", (g.vocab_size, g.embedding_size), "float32",
+            I.normal(0.0, 0.01))
+
+    parent = main.current_block()
+    sub = main.create_block()
+    ctx = _RGContext("beam", sub=sub)
+    static_outer, static_inner = [], []
+    with main.block_guard(sub), _push_rg(ctx):
+        tok_embed = sub.create_var(shape=(-1, g.embedding_size),
+                                   dtype="float32")
+        args = []
+        for i in input:
+            if isinstance(i, GeneratedInput):
+                args.append(LayerOutput(tok_embed))
+                continue
+            lo = i.layer
+            inner = sub.create_var(shape=lo.var.shape, dtype=lo.var.dtype)
+            static_outer.append(lo.var.name)
+            static_inner.append(inner.name)
+            inner_len = None
+            if lo.lengths is not None:
+                inner_len = sub.create_var(shape=lo.lengths.shape,
+                                           dtype=lo.lengths.dtype)
+                static_outer.append(lo.lengths.name)
+                static_inner.append(inner_len.name)
+            args.append(LayerOutput(inner, inner_len))
+        out = step(*args)
+        for name, _, _ in ctx.memories:
+            if name not in ctx.named_outputs:
+                raise ValueError(f"memory '{name}' has no matching named "
+                                 "step output")
+
+    tokens = parent.create_var(shape=(-1, beam_size, max_length),
+                               dtype="int32")
+    scores = parent.create_var(shape=(-1, beam_size), dtype="float32")
+    parent.append_op(
+        "beam_search_gen",
+        {"Embed": [embed_w.name]},
+        {"Tokens": [tokens.name], "Scores": [scores.name]},
+        {"sub_block_idx": sub.idx,
+         "embed_param": embed_w.name,
+         "token_embed_name": tok_embed.name,
+         "static_outer": static_outer,
+         "static_in_names": static_inner,
+         "boot_mems": [boot for _, _, boot in ctx.memories],
+         "mem_names": [m.name for _, m, _ in ctx.memories],
+         "mem_update_names": [ctx.named_outputs[n].name
+                              for n, _, _ in ctx.memories],
+         "prob_name": out.var.name,
+         "beam_size": beam_size, "max_length": max_length,
+         "bos_id": bos_id, "eos_id": eos_id,
+         "length_penalty": length_penalty})
+    return LayerOutput(tokens), LayerOutput(scores)

@@ -68,3 +68,33 @@ def vgg_16_network(input_image: LayerOutput, num_classes: int = 1000,
     h = L.fc(h, 512, act="relu")
     h = L.fc(h, 512, act="relu")
     return L.fc(h, num_classes)
+
+
+def simple_attention(encoded_sequence: LayerOutput,
+                     encoded_proj: LayerOutput,
+                     decoder_state: LayerOutput) -> LayerOutput:
+    """Bahdanau-style attention context (networks.py:654 simple_attention).
+
+    For use inside a recurrent_group / beam_search step: ``encoded_sequence``
+    [B, T, H] and ``encoded_proj`` [B, T, A] come in as StaticInputs (with
+    lengths); ``decoder_state`` is the current [B, S] memory. Returns the
+    [B, H] context vector. The reference expands the decoder state over the
+    sequence and runs sequence_softmax over the scores — identical math here,
+    as fixed-shape masked ops.
+    """
+    A = encoded_proj.var.shape[-1]
+    # project decoder state to attention space: [B, A]
+    dp = FL.fc(decoder_state.var, A, bias_attr=False)
+    dp3 = FL.reshape(dp, (-1, 1, A))
+    summed = FL.elementwise_add(encoded_proj.var, dp3)     # broadcast over T
+    e = FL.activation(summed, "tanh")
+    # per-step score: contract the attention dim with a learned vector
+    v = FL._create_parameter("att_v", (A, 1), "float32",
+                             I.uniform(-0.1, 0.1))
+    scores3 = FL.matmul(e, v)                              # [B, T, 1]
+    scores = FL.squeeze(scores3, -1)                       # [B, T]
+    weights = FL.sequence_softmax(scores, encoded_sequence.lengths)
+    w3 = FL.unsqueeze(weights, -1)                         # [B, T, 1]
+    weighted = FL.elementwise_mul(encoded_sequence.var, w3)
+    context = FL.reduce_sum(weighted, dim=1)               # [B, H]
+    return LayerOutput(context)

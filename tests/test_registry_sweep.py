@@ -138,6 +138,10 @@ SPECS = {
     "lod_tensor_to_array": dict(ins={"X": [f32(B, T, D)]}),
     "array_to_lod_tensor": dict(ins={"X": [f32(T, B, D)]}),
     "lod_reset": dict(ins={"X": [f32(B, T)], "Lengths": [LENGTHS]}),
+    "squeeze": dict(ins={"X": [f32(B, T, 1)]}, attrs={"axis": -1},
+                    grad=[("X", 0)]),
+    "unsqueeze": dict(ins={"X": [f32(B, T)]}, attrs={"axis": -1},
+                      grad=[("X", 0)]),
     # -- activations ---------------------------------------------------------
     **{a: dict(ins={"X": [f32(B, D)]}, grad=[("X", 0)])
        for a in ("sigmoid", "tanh", "gelu", "softsign", "square",
@@ -371,8 +375,8 @@ SPECS = {
 }
 
 # ops that cannot be run standalone (structural / host-side)
-EXEMPT = {"while", "conditional_block", "static_rnn", "autodiff_grad",
-          "fill_init"}
+EXEMPT = {"while", "conditional_block", "static_rnn", "beam_search_gen",
+          "autodiff_grad", "fill_init"}
 
 
 def test_every_registered_op_is_covered():
