@@ -1,9 +1,11 @@
-from .lod import (SeqBatch, bucket_length, lengths_from_lod, lod_from_lengths,
-                  pack_sequences, sequence_mask)
+from .lod import (NestedSeqBatch, SeqBatch, bucket_length, lengths_from_lod,
+                  lod_from_lengths, pack_nested_sequences, pack_sequences,
+                  sequence_mask)
 from .place import CPUPlace, DeviceContext, Place, TPUPlace, default_place
 
 __all__ = [
-    "SeqBatch", "sequence_mask", "pack_sequences", "bucket_length",
+    "SeqBatch", "NestedSeqBatch", "sequence_mask", "pack_sequences",
+    "pack_nested_sequences", "bucket_length",
     "lod_from_lengths", "lengths_from_lod",
     "Place", "TPUPlace", "CPUPlace", "DeviceContext", "default_place",
 ]

@@ -37,22 +37,20 @@ import numpy as np
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class SeqBatch:
-    """A padded ragged batch: data [B, T, ...] + lengths [B]."""
+    """A padded ragged batch: data [B, T, ...] + lengths [B]. Two-level
+    nesting lives in :class:`NestedSeqBatch` below."""
 
     data: jax.Array
     lengths: jax.Array
-    # host-side nested offsets for sub-sequences (gen-2 LoD levels beyond the first);
-    # static metadata, not traced.
-    lod: Optional[Tuple[Tuple[int, ...], ...]] = None
 
     # -- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
-        return (self.data, self.lengths), self.lod
+        return (self.data, self.lengths), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         data, lengths = children
-        return cls(data, lengths, aux)
+        return cls(data, lengths)
 
     # -- shape helpers -----------------------------------------------------
     @property
@@ -116,3 +114,121 @@ def lod_from_lengths(lengths: Sequence[int]) -> Tuple[int, ...]:
 
 def lengths_from_lod(offsets: Sequence[int]) -> Tuple[int, ...]:
     return tuple(int(offsets[i + 1] - offsets[i]) for i in range(len(offsets) - 1))
+
+
+# =============================================================================
+# Nested sequences (2-level LoD) — the reference's subSequenceStartPositions
+# (parameter/Argument.h:84-90) / multi-level LoDTensor (framework/lod_tensor.h:57)
+# under the static-shape regime: one more padded axis instead of offset vectors.
+# =============================================================================
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class NestedSeqBatch:
+    """A padded batch of sequences of sub-sequences.
+
+    * ``data``:        [B, S, T, ...] — S = max sub-sequences per example,
+                       T = max sub-sequence length
+    * ``sub_lengths``: [B, S] int32 — valid length of each sub-sequence
+                       (0 for padding sub-sequences)
+    * ``seq_lengths``: [B] int32 — number of valid sub-sequences per example
+
+    The sub-sequence axis IS a sequence axis: after per-sub-sequence reduction
+    (pool / last-step / inner RNN) the result [B, S, D] + seq_lengths is an
+    ordinary :class:`SeqBatch` over sub-sequence summaries — this is how the
+    reference's nested recurrent_group composes (config_parser.py:319-387).
+    """
+
+    data: jax.Array
+    sub_lengths: jax.Array
+    seq_lengths: jax.Array
+
+    def tree_flatten(self):
+        return (self.data, self.sub_lengths, self.seq_lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- shape helpers -----------------------------------------------------
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_subseqs(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def max_sublen(self) -> int:
+        return self.data.shape[2]
+
+    def inner_mask(self, dtype=jnp.float32) -> jax.Array:
+        """[B, S, T] validity of each timestep."""
+        pos = jnp.arange(self.max_sublen, dtype=self.sub_lengths.dtype)
+        return (pos[None, None, :] < self.sub_lengths[:, :, None]).astype(dtype)
+
+    def outer_mask(self, dtype=jnp.float32) -> jax.Array:
+        """[B, S] validity of each sub-sequence."""
+        return sequence_mask(self.seq_lengths, self.max_subseqs, dtype)
+
+    # -- level moves -------------------------------------------------------
+    def inner_flat(self) -> SeqBatch:
+        """View sub-sequences as a flat batch [B*S, T, ...] — the input shape
+        for any single-level sequence op (inner RNN, pooling, conv). Padding
+        sub-sequences ride along with length 0 and mask to nothing."""
+        d = self.data.reshape((self.batch_size * self.max_subseqs,)
+                              + self.data.shape[2:])
+        return SeqBatch(d, self.sub_lengths.reshape(-1))
+
+    def outer(self, per_subseq: jax.Array) -> SeqBatch:
+        """Lift per-sub-sequence values [B*S, ...] (from an op applied to
+        ``inner_flat()``) to the outer sequence [B, S, ...] + seq_lengths."""
+        return SeqBatch(
+            per_subseq.reshape((self.batch_size, self.max_subseqs)
+                               + per_subseq.shape[1:]),
+            self.seq_lengths)
+
+
+def pack_nested_sequences(nested, max_subseqs: Optional[int] = None,
+                          max_sublen: Optional[int] = None, pad_value=0,
+                          bucket: bool = True) -> NestedSeqBatch:
+    """Host-side: list (batch) of lists (sub-sequences) of [len, ...] arrays
+    -> NestedSeqBatch. The 2-level analog of :func:`pack_sequences`."""
+    if not nested:
+        raise ValueError("pack_nested_sequences: empty batch")
+    nested = [[np.asarray(s) for s in sample] for sample in nested]
+    B = len(nested)
+    S = max(1, max(len(sample) for sample in nested))
+    T = max(1, max((s.shape[0] for sample in nested for s in sample),
+                   default=1))
+    if max_subseqs is not None:
+        S = max_subseqs
+    elif bucket:
+        # bucket the sub-seq axis too — every distinct S is a new compiled shape
+        S = bucket_length(S, buckets=(2, 4, 8, 16, 32, 64))
+    if max_sublen is not None:
+        T = max_sublen
+    elif bucket:
+        T = bucket_length(T)
+    # feature shape/dtype from the first NON-empty sub-sequence (an empty
+    # leading sub-sequence must not dictate the layout)
+    first = next((s for sample in nested for s in sample if s.shape[0] > 0),
+                 None)
+    if first is None:
+        first = next((s for sample in nested for s in sample), None)
+    if first is None:
+        raise ValueError("pack_nested_sequences: no sub-sequences in batch")
+    feat = first.shape[1:]
+    data = np.full((B, S, T) + feat, pad_value, dtype=first.dtype)
+    sub_lengths = np.zeros((B, S), np.int32)
+    seq_lengths = np.zeros((B,), np.int32)
+    for b, sample in enumerate(nested):
+        seq_lengths[b] = min(len(sample), S)
+        for s, sub in enumerate(sample[:S]):
+            n = min(sub.shape[0], T)
+            if n > 0:
+                data[b, s, :n] = sub[:n]
+            sub_lengths[b, s] = n
+    return NestedSeqBatch(jnp.asarray(data), jnp.asarray(sub_lengths),
+                          jnp.asarray(seq_lengths))

@@ -22,7 +22,8 @@ from typing import Any, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.lod import SeqBatch, bucket_length, pack_sequences
+from ..core.lod import (NestedSeqBatch, SeqBatch, bucket_length,
+                        pack_nested_sequences, pack_sequences)
 
 
 @dataclass
@@ -41,8 +42,8 @@ class SeqSlot:
     """A variable-length sequence of scalars (ids) or vectors.
 
     elem_dim None -> id sequence (int32); else vector sequence [len, elem_dim].
-    nested=True accepts list-of-list-of-elem (sub-sequences are flattened and
-    the inner offsets kept in SeqBatch.lod, LoDTensor level-2 analog).
+    nested=True accepts list-of-list-of-elem and produces a NestedSeqBatch
+    ([B, S, T] + sub/seq lengths — the 2-level-LoD analog).
     """
     elem_dim: Optional[int] = None
     nested: bool = False
@@ -93,20 +94,13 @@ class DataFeeder:
             return self._convert_sparse(slot, col)
         raise TypeError(f"unknown slot {slot!r}")
 
-    def _convert_seq(self, slot: SeqSlot, col) -> SeqBatch:
+    def _convert_seq(self, slot: SeqSlot, col):
         if slot.nested:
-            # flatten sub-sequences; record inner offsets as LoD level
-            flat, lod = [], []
-            for sample in col:
-                offs = [0]
-                items: List = []
-                for sub in sample:
-                    items.extend(sub)
-                    offs.append(len(items))
-                flat.append(np.asarray(items, dtype=slot.np_dtype))
-                lod.append(tuple(offs))
-            sb = pack_sequences(flat)
-            return SeqBatch(sb.data, sb.lengths, tuple(lod))
+            # 2-level LoD: padded [B, S, T] + per-subseq and per-seq lengths
+            # (subSequenceStartPositions analog, Argument.h:84-90)
+            nested = [[np.asarray(sub, dtype=slot.np_dtype) for sub in sample]
+                      for sample in col]
+            return pack_nested_sequences(nested)
         seqs = [np.asarray(s, dtype=slot.np_dtype) for s in col]
         return pack_sequences(seqs)
 

@@ -1127,3 +1127,33 @@ def _squeeze(ins, attrs):
 @OpRegistry.register("unsqueeze")
 def _unsqueeze(ins, attrs):
     return {"Out": [jnp.expand_dims(_x(ins), axis=attrs["axis"])]}
+
+
+@OpRegistry.register("nested_seq_pool")
+def _nested_pool(ins, attrs):
+    from ..core.lod import NestedSeqBatch
+    from ..ops.sequence import nested_seq_pool
+    nb = NestedSeqBatch(_x(ins), ins["SubLengths"][0], ins["SeqLengths"][0])
+    return {"Out": [nested_seq_pool(nb, attrs.get("pool_type", "average")).data]}
+
+
+@OpRegistry.register("nested_last_step")
+def _nested_last(ins, attrs):
+    from ..core.lod import NestedSeqBatch
+    from ..ops.sequence import nested_last_step
+    nb = NestedSeqBatch(_x(ins), ins["SubLengths"][0], ins["SeqLengths"][0])
+    return {"Out": [nested_last_step(nb).data]}
+
+
+@OpRegistry.register("nested_lstm")
+def _nested_lstm(ins, attrs):
+    """Inner LSTM per sub-sequence (state resets at sub-seq boundaries —
+    the nested recurrent_group semantics of sequence_nest_rnn*.py)."""
+    from ..core.lod import NestedSeqBatch
+    from ..ops.rnn import lstm
+    from ..ops.sequence import nested_rnn
+    nb = NestedSeqBatch(_x(ins), ins["SubLengths"][0], ins["SeqLengths"][0])
+    out, last = nested_rnn(lstm, nb, ins["W"][0], ins["U"][0],
+                           ins["B"][0] if "B" in ins else None,
+                           reverse=attrs.get("reverse", False))
+    return {"Out": [out], "LastH": [last.data]}

@@ -39,12 +39,15 @@ def sequence_pool(x: jax.Array, lengths: jax.Array, pool_type: str = "average") 
     if pool_type == "sqrt":
         xm, _ = _mask(x, lengths)
         return jnp.sum(xm, axis=1) / jnp.sqrt(shape_n)
+    empty = (lengths == 0).reshape((-1,) + (1,) * (x.ndim - 2))
     if pool_type == "max":
         xm, _ = _mask(x, lengths, fill=-jnp.inf)
-        return jnp.max(xm, axis=1)
+        # length-0 rows (nested-seq padding) pool to 0, not -inf — an inf here
+        # turns into NaN the moment a mask multiplies it
+        return jnp.where(empty, 0.0, jnp.max(xm, axis=1))
     if pool_type == "min":
         xm, _ = _mask(x, lengths, fill=jnp.inf)
-        return jnp.min(xm, axis=1)
+        return jnp.where(empty, 0.0, jnp.min(xm, axis=1))
     if pool_type == "last":
         idx = jnp.maximum(lengths - 1, 0)
         return jnp.take_along_axis(
@@ -166,3 +169,60 @@ def sequence_conv(x: jax.Array, lengths: jax.Array, filt: jax.Array,
         out = out + b
     m = sequence_mask(lengths, x.shape[1], out.dtype)
     return out * m[..., None]
+
+
+# =============================================================================
+# Nested-sequence (2-level LoD) ops — sub-sequence pooling/expansion and the
+# nested scan group (gserver SubNestedSequence / sequence_nest_rnn configs,
+# config_parser.py:319-387; Argument.h:84-90 subSequenceStartPositions).
+# Pattern: drop to inner_flat() for the single-level op, lift back via outer().
+# =============================================================================
+
+from ..core.lod import NestedSeqBatch  # noqa: E402
+
+
+def nested_seq_pool(nb: NestedSeqBatch, pool_type: str = "average"):
+    """Pool each sub-sequence -> SeqBatch [B, S, ...] over sub-sequence
+    summaries (the inner step of a nested recurrent_group that feeds the
+    outer group)."""
+    flat = nb.inner_flat()
+    pooled = sequence_pool(flat.data, flat.lengths, pool_type)
+    return nb.outer(pooled)
+
+
+def nested_last_step(nb: NestedSeqBatch):
+    flat = nb.inner_flat()
+    return nb.outer(sequence_last_step(flat.data, flat.lengths))
+
+
+def nested_first_step(nb: NestedSeqBatch):
+    flat = nb.inner_flat()
+    return nb.outer(sequence_first_step(flat.data, flat.lengths))
+
+
+def sub_seq_expand(outer_vals: jax.Array, nb: NestedSeqBatch) -> jax.Array:
+    """Broadcast one value per sub-sequence [B, S, D] to every inner step
+    [B, S, T, D], zeroed on invalid steps (SequenceExpand at the sub-seq
+    level — e.g. handing an outer memory to every word of a sentence)."""
+    tiled = jnp.broadcast_to(outer_vals[:, :, None],
+                             outer_vals.shape[:2] + (nb.max_sublen,)
+                             + outer_vals.shape[2:])
+    m = nb.inner_mask(tiled.dtype)
+    return tiled * m.reshape(m.shape + (1,) * (tiled.ndim - 3))
+
+
+def nested_rnn(rnn_fn, nb: NestedSeqBatch, *args, **kwargs):
+    """Run a single-level masked RNN (ops.rnn.lstm / gru / simple_rnn)
+    independently over every sub-sequence: state does NOT flow across
+    sub-sequence boundaries — exactly the nested recurrent_group semantics
+    the reference tests in sequence_nest_rnn*.py (each inner group restarts
+    from its boot memory).
+
+    Returns (outputs as [B, S, T, H], last-state lifted to [B, S, H] SeqBatch).
+    """
+    flat = nb.inner_flat()
+    out, last = rnn_fn(flat.data, flat.lengths, *args, **kwargs)
+    B, S = nb.batch_size, nb.max_subseqs
+    out_n = out.reshape((B, S) + out.shape[1:])
+    h = last.h if hasattr(last, "h") else last
+    return out_n, nb.outer(h)

@@ -41,3 +41,13 @@ def sparse_binary_vector(dim: int) -> InputType:
 
 def sparse_float_vector(dim: int) -> InputType:
     return InputType(SparseSlot(dim, with_values=True))
+
+
+def integer_value_sub_sequence(value_range: int) -> InputType:
+    """2-level LoD id input (the reference's *_sub_sequence types feeding
+    nested recurrent groups) -> NestedSeqBatch."""
+    return InputType(SeqSlot(nested=True), is_seq=True, vocab=value_range)
+
+
+def dense_vector_sub_sequence(dim: int) -> InputType:
+    return InputType(SeqSlot(elem_dim=dim, nested=True), is_seq=True)

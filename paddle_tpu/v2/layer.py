@@ -23,6 +23,7 @@ class LayerOutput:
     var: Variable
     lengths: Optional[Variable] = None      # set for sequence outputs
     input_type: Optional[InputType] = None
+    sub_lengths: Optional[Variable] = None  # set for nested (2-level LoD) data
 
     @property
     def name(self):
@@ -30,9 +31,18 @@ class LayerOutput:
 
 
 def data(name: str, type: InputType) -> LayerOutput:
-    """paddle.v2.layer.data analog; sequence types get a lengths feed var."""
+    """paddle.v2.layer.data analog; sequence types get a lengths feed var,
+    nested (sub-sequence) types additionally a [S] sub-lengths feed var."""
     if type.is_seq:
         elem = getattr(type.slot, "elem_dim", None)
+        nested = getattr(type.slot, "nested", False)
+        if nested:
+            shape = (-1, -1) if elem is None else (-1, -1, elem)
+            dtype = "int32" if elem is None else "float32"
+            v = FL.data(name, shape=shape, dtype=dtype)        # [B, S, T(, D)]
+            sublens = FL.data(name + "__sublen__", shape=(-1,), dtype="int32")
+            lens = FL.data(name + "__len__", shape=(), dtype="int32")
+            return LayerOutput(v, lens, type, sub_lengths=sublens)
         if elem is None:
             v = FL.data(name, shape=(-1,), dtype="int32")
         else:
@@ -70,7 +80,8 @@ def embedding(input: LayerOutput, size: int) -> LayerOutput:
         raise ValueError("embedding needs a data layer typed "
                          "integer_value[_sequence](vocab_size)")
     out = FL.embedding(input.var, (t.vocab, size))
-    return LayerOutput(out, input.lengths, input.input_type)
+    return LayerOutput(out, input.lengths, input.input_type,
+                       sub_lengths=input.sub_lengths)
 
 
 def _seq_op(op_type, input: LayerOutput, extra_attrs=None, out_shape=None,
@@ -413,3 +424,54 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
          "bos_id": bos_id, "eos_id": eos_id,
          "length_penalty": length_penalty})
     return LayerOutput(tokens), LayerOutput(scores)
+
+
+# ------------------------------------------------ nested (2-level LoD) layers
+
+def _nested_inputs(input: LayerOutput):
+    if input.sub_lengths is None:
+        raise ValueError("layer requires nested sequence input "
+                         "(integer_value_sub_sequence / "
+                         "dense_vector_sub_sequence data)")
+    return {"X": [input.var.name], "SubLengths": [input.sub_lengths.name],
+            "SeqLengths": [input.lengths.name]}
+
+
+def nested_pooling(input: LayerOutput, pooling_type: str = "average"
+                   ) -> LayerOutput:
+    """Pool each sub-sequence -> ordinary sequence of sub-seq summaries
+    [B, S, D] + outer lengths (SubNestedSequence pooling analog)."""
+    b = default_main_program().current_block()
+    out = b.create_var(shape=(-1, -1, input.var.shape[-1]), dtype="float32")
+    b.append_op("nested_seq_pool", _nested_inputs(input), {"Out": [out.name]},
+                {"pool_type": pooling_type})
+    return LayerOutput(out, input.lengths)
+
+
+def nested_last_seq(input: LayerOutput) -> LayerOutput:
+    b = default_main_program().current_block()
+    out = b.create_var(shape=(-1, -1, input.var.shape[-1]), dtype="float32")
+    b.append_op("nested_last_step", _nested_inputs(input), {"Out": [out.name]})
+    return LayerOutput(out, input.lengths)
+
+
+def nested_lstmemory(input: LayerOutput, size: int,
+                     reverse: bool = False) -> LayerOutput:
+    """Inner LSTM over every sub-sequence (memory resets at boundaries);
+    returns the sequence of per-sub-sequence last states [B, S, size] —
+    ready for an outer recurrent layer (the nested recurrent_group stack)."""
+    b = default_main_program().current_block()
+    in_dim = input.var.shape[-1]
+    w = FL._create_parameter("nlstm_w", (in_dim, 4 * size), "float32",
+                             I.uniform(-0.08, 0.08))
+    u = FL._create_parameter("nlstm_u", (size, 4 * size), "float32",
+                             I.uniform(-0.08, 0.08))
+    bias = FL._create_parameter("nlstm_b", (4 * size,), "float32", I.zeros)
+    ins = _nested_inputs(input)
+    ins.update({"W": [w.name], "U": [u.name], "B": [bias.name]})
+    out = b.create_var(shape=(-1, -1, -1, size), dtype="float32")
+    last = b.create_var(shape=(-1, -1, size), dtype="float32")
+    b.append_op("nested_lstm", ins,
+                {"Out": [out.name], "LastH": [last.name]},
+                {"reverse": reverse})
+    return LayerOutput(last, input.lengths)

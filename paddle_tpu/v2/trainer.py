@@ -32,9 +32,14 @@ class _V2Feeder:
     def __call__(self, rows) -> Dict[str, np.ndarray]:
         cols = self.feeder.feed(rows)
         feed: Dict[str, np.ndarray] = {}
+        from ..core.lod import NestedSeqBatch
         for dl, col in zip(self.layers, cols):
             base = dl.var.name
-            if isinstance(col, SeqBatch):
+            if isinstance(col, NestedSeqBatch):
+                feed[base] = col.data
+                feed[base + "__sublen__"] = col.sub_lengths
+                feed[base + "__len__"] = col.seq_lengths
+            elif isinstance(col, SeqBatch):
                 feed[base] = col.data
                 feed[base + "__len__"] = col.lengths
             elif isinstance(col, tuple):      # sparse (ids, vals)

@@ -65,9 +65,13 @@ def test_feeder_dense_index_seq_sparse():
 def test_feeder_nested_seq():
     feeder = DataFeeder([SeqSlot(nested=True)])
     rows = [([[1, 2], [3]],), ([[4]],)]
-    (sb,) = feeder.feed(rows)
-    assert sb.lod == ((0, 2, 3), (0, 1))
-    assert int(sb.lengths[0]) == 3
+    (nb,) = feeder.feed(rows)
+    # 2-level LoD: [B, S, T] + sub/seq lengths (Argument.h:84-90 analog)
+    assert nb.data.shape[:2] == (2, 2)
+    np.testing.assert_array_equal(np.asarray(nb.seq_lengths), [2, 1])
+    np.testing.assert_array_equal(np.asarray(nb.sub_lengths),
+                                  [[2, 1], [1, 0]])
+    np.testing.assert_array_equal(np.asarray(nb.data[0, 0, :2]), [1, 2])
 
 
 def test_double_buffer_order_and_errors():

@@ -1,0 +1,179 @@
+"""Nested-sequence (2-level LoD) tests.
+
+The analog of the reference's nested-sequence machinery and its equivalence
+tests (parameter/Argument.h:84-90 subSequenceStartPositions,
+gserver/tests/sequence_nest_rnn*.py: nested recurrent groups must match the
+flattened computation when the data is equivalent).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import NestedSeqBatch, pack_nested_sequences
+from paddle_tpu.ops import rnn as R
+from paddle_tpu.ops import sequence as S
+
+
+def _toy_nested():
+    r = np.random.RandomState(0)
+    nested = [
+        [r.randn(3, 4).astype(np.float32), r.randn(2, 4).astype(np.float32)],
+        [r.randn(1, 4).astype(np.float32)],
+    ]
+    return nested, pack_nested_sequences(nested, bucket=False)
+
+
+def test_pack_nested_roundtrip():
+    nested, nb = _toy_nested()
+    assert nb.data.shape == (2, 2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(nb.seq_lengths), [2, 1])
+    np.testing.assert_array_equal(np.asarray(nb.sub_lengths), [[3, 2], [1, 0]])
+    np.testing.assert_allclose(np.asarray(nb.data[0, 1, :2]), nested[0][1])
+    # masks agree with lengths
+    assert float(nb.inner_mask().sum()) == 3 + 2 + 1
+    assert float(nb.outer_mask().sum()) == 2 + 1
+
+
+def test_nested_pool_matches_manual():
+    nested, nb = _toy_nested()
+    pooled = S.nested_seq_pool(nb, "average")
+    # valid entries equal per-subsequence means
+    np.testing.assert_allclose(np.asarray(pooled.data[0, 0]),
+                               nested[0][0].mean(0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pooled.data[0, 1]),
+                               nested[0][1].mean(0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pooled.data[1, 0]),
+                               nested[1][0].mean(0), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(pooled.lengths), [2, 1])
+    last = S.nested_last_step(nb)
+    np.testing.assert_allclose(np.asarray(last.data[0, 0]), nested[0][0][-1],
+                               rtol=1e-6)
+
+
+def test_sub_seq_expand_broadcasts_and_masks():
+    _, nb = _toy_nested()
+    vals = jnp.arange(2 * 2 * 5, dtype=jnp.float32).reshape(2, 2, 5)
+    ex = S.sub_seq_expand(vals, nb)
+    assert ex.shape == (2, 2, 3, 5)
+    np.testing.assert_allclose(np.asarray(ex[0, 0, 2]), np.asarray(vals[0, 0]))
+    # masked: subseq (1,1) is padding -> zeros everywhere
+    np.testing.assert_allclose(np.asarray(ex[1, 1]), 0.0)
+
+
+def test_nested_rnn_matches_per_subsequence_rnn():
+    """sequence_nest_rnn equivalence: the inner RNN restarts per sub-sequence,
+    so running it nested must equal running it on each sub-sequence alone."""
+    nested, nb = _toy_nested()
+    r = np.random.RandomState(1)
+    D, H = 4, 6
+    w = jnp.asarray(r.randn(D, 4 * H).astype(np.float32) * 0.3)
+    u = jnp.asarray(r.randn(H, 4 * H).astype(np.float32) * 0.3)
+    b = jnp.zeros((4 * H,), jnp.float32)
+
+    out_n, last_n = S.nested_rnn(R.lstm, nb, w, u, b)
+    assert out_n.shape == (2, 2, 3, H)
+    for bi, sample in enumerate(nested):
+        for si, sub in enumerate(sample):
+            ref_out, ref_state = R.lstm(
+                jnp.asarray(sub)[None], jnp.asarray([sub.shape[0]], jnp.int32),
+                w, u, b)
+            np.testing.assert_allclose(
+                np.asarray(out_n[bi, si, :sub.shape[0]]),
+                np.asarray(ref_out[0]), rtol=2e-5, atol=2e-6)
+            np.testing.assert_allclose(np.asarray(last_n.data[bi, si]),
+                                       np.asarray(ref_state.h[0]),
+                                       rtol=2e-5, atol=2e-6)
+
+
+def test_nested_vs_flattened_single_subsequence():
+    """With exactly one sub-sequence per example, the nested path must equal
+    the flat single-level path (the degenerate-equivalence the reference's
+    nested/flat config pairs rely on)."""
+    r = np.random.RandomState(2)
+    seqs = [r.randn(5, 3).astype(np.float32), r.randn(2, 3).astype(np.float32)]
+    nb = pack_nested_sequences([[s] for s in seqs], bucket=False)
+    from paddle_tpu.core import pack_sequences
+    sb = pack_sequences(seqs, bucket=False)
+
+    pooled_nested = S.nested_seq_pool(nb, "sum")
+    pooled_flat = S.sequence_pool(sb.data, sb.lengths, "sum")
+    np.testing.assert_allclose(np.asarray(pooled_nested.data[:, 0]),
+                               np.asarray(pooled_flat), rtol=1e-6)
+
+
+def test_hierarchical_model_trains():
+    """Inner LSTM over words per sentence -> outer LSTM over sentence
+    vectors -> classifier: the nested recurrent_group composition, end to end
+    with gradients."""
+    r = np.random.RandomState(3)
+    B, S_, T, D, H = 4, 3, 5, 4, 8
+    data = r.randn(B, S_, T, D).astype(np.float32)
+    sub_lengths = r.randint(1, T + 1, (B, S_)).astype(np.int32)
+    seq_lengths = r.randint(1, S_ + 1, (B,)).astype(np.int32)
+    for bi in range(B):   # zero-out padding subseqs for realism
+        sub_lengths[bi, seq_lengths[bi]:] = 0
+    nb = NestedSeqBatch(jnp.asarray(data), jnp.asarray(sub_lengths),
+                        jnp.asarray(seq_lengths))
+    labels = jnp.asarray((data.sum((1, 2, 3)) > 0).astype(np.int32))
+
+    def init(key):
+        ks = jax.random.split(key, 6)
+        s = 0.3
+        return {
+            "wi": jax.random.normal(ks[0], (D, 4 * H)) * s,
+            "ui": jax.random.normal(ks[1], (H, 4 * H)) * s,
+            "wo": jax.random.normal(ks[2], (H, 4 * H)) * s,
+            "uo": jax.random.normal(ks[3], (H, 4 * H)) * s,
+            "cw": jax.random.normal(ks[4], (H, 2)) * s,
+            "cb": jnp.zeros((2,)),
+        }
+
+    def loss_fn(p, nb, labels):
+        _, sent = S.nested_rnn(R.lstm, nb, p["wi"], p["ui"], None)
+        out, state = R.lstm(sent.data, sent.lengths, p["wo"], p["uo"], None)
+        logits = state.h @ p["cw"] + p["cb"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+
+    p = init(jax.random.PRNGKey(0))
+    g = jax.jit(jax.value_and_grad(loss_fn))
+    losses = []
+    for _ in range(80):
+        l, grads = g(p, nb, labels)
+        losses.append(float(l))
+        p = jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, grads)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_v2_nested_pipeline_end_to_end():
+    """integer_value_sub_sequence data -> embedding -> inner LSTM ->
+    outer LSTM -> classify, fed through the v2 trainer feed path."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers as FL
+    from paddle_tpu.v2 import layer as L
+    from paddle_tpu.v2.data_type import integer_value_sub_sequence
+    from paddle_tpu.v2.trainer import _V2Feeder
+
+    fluid.reset_default_programs()
+    fluid.executor._global_scope = fluid.Scope()
+    V, E, H = 10, 5, 6
+    docs = L.data("docs", integer_value_sub_sequence(V))
+    label = FL.data("label", shape=(), dtype="int64")
+    emb = L.embedding(docs, E)                  # nested-ness propagates
+    sents = L.nested_lstmemory(emb, H)          # [B, S, H] outer sequence
+    doc_vec = L.last_seq(L.lstmemory(sents, H))
+    logits = FL.fc(doc_vec.var, 2)
+    loss = FL.mean(FL.softmax_with_cross_entropy(logits, label))
+    fluid.AdamOptimizer(0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    tr = _V2Feeder([docs])
+    rows = [([[1, 2, 3], [4, 5]],), ([[6], [7, 8], [9, 1]],),
+            ([[2, 2]],), ([[3], [3, 3, 3]],)]
+    feed = tr(rows)
+    feed["label"] = np.array([0, 1, 0, 1], np.int64)
+    losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
+              for _ in range(25)]
+    assert losses[-1] < losses[0], (losses[0], losses[-1])

@@ -140,6 +140,21 @@ SPECS = {
     "lod_reset": dict(ins={"X": [f32(B, T)], "Lengths": [LENGTHS]}),
     "squeeze": dict(ins={"X": [f32(B, T, 1)]}, attrs={"axis": -1},
                     grad=[("X", 0)]),
+    "nested_seq_pool": dict(
+        ins={"X": [f32(B, 2, T, D)],
+             "SubLengths": [np.array([[3, 2], [1, 0]], np.int32)],
+             "SeqLengths": [np.array([2, 1], np.int32)]},
+        attrs={"pool_type": "max"}, grad=[("X", 0)]),
+    "nested_last_step": dict(
+        ins={"X": [f32(B, 2, T, D)],
+             "SubLengths": [np.array([[3, 2], [1, 0]], np.int32)],
+             "SeqLengths": [np.array([2, 1], np.int32)]}),
+    "nested_lstm": dict(
+        ins={"X": [f32(B, 2, T, D)],
+             "SubLengths": [np.array([[3, 2], [1, 0]], np.int32)],
+             "SeqLengths": [np.array([2, 1], np.int32)],
+             "W": [f32(D, 4 * H)], "U": [f32(H, 4 * H)],
+             "B": [f32(4 * H)]}, out="Out", grad=[("W", 0)]),
     "unsqueeze": dict(ins={"X": [f32(B, T)]}, attrs={"axis": -1},
                       grad=[("X", 0)]),
     # -- activations ---------------------------------------------------------
