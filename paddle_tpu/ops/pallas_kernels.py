@@ -9,6 +9,13 @@ Pallas kernel that keeps the whole inner loop in VMEM next to the MXU/VPU
   KV tiles entirely in VMEM; the [T, T] score matrix never touches HBM. This
   is the single biggest HBM-bandwidth win for long sequences and the kernel
   under ring attention's per-chip step.
+* Backward is real Pallas too: a dq kernel (grid over Q blocks, streaming KV
+  tiles) and a dk/dv kernel (grid over KV blocks, streaming Q tiles), both
+  recomputing the probability tiles in VMEM from the saved logsumexp — the
+  [T, T] matrix never exists in HBM in either direction.
+* :func:`flash_attention_with_lse` — forward-only variant returning the
+  per-row logsumexp, the building block ring attention uses to merge partial
+  attention results across ring steps (parallel/ring_attention.py).
 
 Kernels run with ``interpret=True`` off-TPU so the same code is testable on the
 CPU mesh (tests/test_pallas.py); numerics match the jnp reference path.
@@ -17,12 +24,12 @@ CPU mesh (tests/test_pallas.py); numerics match the jnp reference path.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+import math
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
 
@@ -34,15 +41,21 @@ def _on_tpu() -> bool:
         return False
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-               causal: bool, seq_len: int, true_len: int):
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                   scale: float, causal: bool, seq_len: int, true_len: int):
     """One (batch*head, q-block) program: stream KV tiles, online softmax.
 
-    q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, block_q, D].
+    q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, block_q, D];
+    lse_ref: [1, block_q, 1] (f32 logsumexp residual for the backward pass;
+    kept 3D with a trailing unit dim so the block obeys TPU tiling rules).
     """
     _, block_q, d = q_ref.shape
     qi = pl.program_id(1)
-    q = q_ref[0] * scale
+    q = q_ref[0].astype(jnp.float32) * scale
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
     n_k = seq_len // block_k
@@ -72,9 +85,222 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
     m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc0, m0, l0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
 
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                      *, block_k: int, scale: float, causal: bool,
+                      seq_len: int, true_len: int):
+    """dq for one (batch*head, q-block): recompute p tiles from saved lse.
+
+    dS = P * (dO·Vᵀ − delta);   dQ = scale · dS·K.
+    """
+    _, block_q, d = q_ref.shape
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                # [block_q, 1]
+    delta = delta_ref[0]                            # [block_q, 1]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    n_k = seq_len // block_k
+
+    def body(ki, dq):
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        valid = k_pos < true_len
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG)
+        p = jnp.exp(s - lse)                        # [block_q, block_k]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq = dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dq
+
+    dq = jax.lax.fori_loop(0, n_k, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, *, block_q: int, scale: float,
+                       causal: bool, seq_len: int, true_len: int):
+    """dk/dv for one (batch*head, kv-block): stream Q tiles.
+
+    dV = Pᵀ·dO;   dK = scale · dSᵀ·Q.
+    Padded query rows contribute nothing because dO (and hence delta) is
+    zero-padded, making dS vanish there; padded key columns are masked.
+    """
+    _, block_k, d = k_ref.shape
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    valid_k = k_pos < true_len
+
+    n_q = seq_len // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        valid = valid_k
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG)
+        p = jnp.exp(s - lse)                        # [block_q, block_k]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, n_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)             # scale folded into q
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# layout helpers + pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+def _blocks(T: int, block_q: int, block_k: int) -> Tuple[int, int, int]:
+    blk_q = min(block_q, max(8, T))
+    blk_k = min(block_k, max(8, T))
+    # padded length must tile exactly under BOTH block sizes
+    step = math.lcm(blk_q, blk_k)
+    Tp = -(-T // step) * step
+    return blk_q, blk_k, Tp
+
+
+def _to_bh(x, Tp):
+    """[B, T, H, D] -> [B*H, Tp, D] (zero pad)."""
+    B, T, H, D = x.shape
+    x = jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+    if Tp > T:
+        x = jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0)))
+    return x
+
+
+def _from_bh(x, B, T, H, D):
+    """[B*H, Tp, D] -> [B, T, H, D]."""
+    return jnp.moveaxis(x[:, :T].reshape(B, H, T, D), 1, 2)
+
+
+def _row_to_bh(x, Tp):
+    """[B, T, H] -> [B*H, Tp, 1] (zero pad; trailing unit dim for TPU tiling)."""
+    B, T, H = x.shape
+    x = jnp.moveaxis(x, 2, 1).reshape(B * H, T)
+    if Tp > T:
+        x = jnp.pad(x, ((0, 0), (0, Tp - T)))
+    return x[..., None]
+
+
+def _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Returns (o [B,T,H,D], lse [B,T,H] f32)."""
+    B, T, H, D = q.shape
+    blk_q, blk_k, Tp = _blocks(T, block_q, block_k)
+    qb, kb, vb = _to_bh(q, Tp), _to_bh(k, Tp), _to_bh(v, Tp)
+    kernel = functools.partial(_fa_fwd_kernel, block_k=blk_k, scale=scale,
+                               causal=causal, seq_len=Tp, true_len=T)
+    grid = (B * H, Tp // blk_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, Tp, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Tp, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tp, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+    o = _from_bh(out, B, T, H, D)
+    lse = jnp.moveaxis(lse[:, :T, 0].reshape(B, H, T), 1, 2)
+    return o, lse
+
+
+def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                 interpret, delta=None):
+    """Returns (dq, dk, dv) with the same [B,T,H,D] layout as q/k/v."""
+    B, T, H, D = q.shape
+    blk_q, blk_k, Tp = _blocks(T, block_q, block_k)
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)
+    qb, kb, vb, dob = (_to_bh(x, Tp) for x in (q, k, v, do))
+    lseb, deltab = _row_to_bh(lse, Tp), _row_to_bh(delta, Tp)
+
+    q_spec = pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0))
+    full_spec = pl.BlockSpec((1, Tp, D), lambda bh, i: (bh, 0, 0))
+    row_q_spec = pl.BlockSpec((1, blk_q, 1), lambda bh, qi: (bh, qi, 0))
+    row_full_spec = pl.BlockSpec((1, Tp, 1), lambda bh, i: (bh, 0, 0))
+    k_spec = pl.BlockSpec((1, blk_k, D), lambda bh, ki: (bh, ki, 0))
+
+    dq_kernel = functools.partial(_fa_bwd_dq_kernel, block_k=blk_k,
+                                  scale=scale, causal=causal, seq_len=Tp,
+                                  true_len=T)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, Tp // blk_q),
+        in_specs=[q_spec, full_spec, full_spec, q_spec, row_q_spec,
+                  row_q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+        interpret=interpret,
+    )(qb, kb, vb, dob, lseb, deltab)
+
+    dkv_kernel = functools.partial(_fa_bwd_dkv_kernel, block_q=blk_q,
+                                   scale=scale, causal=causal, seq_len=Tp,
+                                   true_len=T)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Tp // blk_k),
+        in_specs=[full_spec, k_spec, k_spec, full_spec, row_full_spec,
+                  row_full_spec],
+        out_specs=[k_spec, k_spec],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Tp, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, Tp, D), v.dtype)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lseb, deltab)
+
+    return (_from_bh(dq, B, T, H, D), _from_bh(dk, B, T, H, D),
+            _from_bh(dv, B, T, H, D))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, scale: Optional[float] = None,
@@ -83,15 +309,54 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Fused attention. q/k/v: [B, T, H, D] -> [B, T, H, D].
 
     T is padded to a block multiple internally; padded keys are masked in the
-    kernel. Differentiable: the VJP recomputes attention via the dense jnp
-    path (a dedicated backward kernel is future work — forward is where the
-    [T, T] HBM blowup lives).
+    kernel. Fully differentiable: the VJP runs dedicated Pallas dq and dk/dv
+    kernels that recompute probability tiles in VMEM from the saved logsumexp
+    — no [T, T] matrix in HBM in either direction.
     """
     D = q.shape[-1]
     scale_v = scale if scale is not None else D ** -0.5
     if interpret is None:
         interpret = not _on_tpu()
     return _flash(q, k, v, causal, scale_v, block_q, block_k, bool(interpret))
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                             causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Forward-only attention returning ``(o, lse)`` with lse: [B, T, H] f32.
+
+    Building block for ring attention: partial results over disjoint KV shards
+    merge exactly via logaddexp (parallel/ring_attention.py). Not
+    differentiable — ring attention installs its own VJP that reuses the
+    Pallas backward kernels per ring step.
+    """
+    D = q.shape[-1]
+    scale_v = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _fa_fwd_call(q, k, v, causal, scale_v, block_q, block_k,
+                        bool(interpret))
+
+
+def flash_block_grads(q, k, v, o, lse, do, *, causal: bool = False,
+                      scale: Optional[float] = None, block_q: int = 128,
+                      block_k: int = 128, interpret: Optional[bool] = None,
+                      delta=None):
+    """Raw (dq, dk, dv) for one attention block given saved (o, lse).
+
+    Used by ring attention's hand-written backward, where each ring step is
+    one such block with externally-merged softmax statistics. Pass ``delta``
+    (= rowsum(dO·O), [B,T,H] f32) to avoid recomputing it per step — it is
+    loop-invariant across ring steps.
+    """
+    D = q.shape[-1]
+    scale_v = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _fa_bwd_call(q, k, v, o, lse, do, causal, scale_v, block_q,
+                        block_k, bool(interpret), delta=delta)
 
 
 def _attention_reference(q, k, v, causal, scale):
@@ -106,52 +371,19 @@ def _attention_reference(q, k, v, causal, scale):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    B, T, H, D = q.shape
-    import math
-    blk_q = min(block_q, max(8, T))
-    blk_k = min(block_k, max(8, T))
-    # padded length must tile exactly under BOTH block sizes (the kernel
-    # iterates seq_len // block_k tiles)
-    step = math.lcm(blk_q, blk_k)
-    Tp = -(-T // step) * step
-    pad = Tp - T
-
-    # [B, T, H, D] -> [B*H, T, D]
-    def to_bh(x):
-        x = jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
-        if pad:
-            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
-        return x
-
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
-    kernel = functools.partial(_fa_kernel, block_k=blk_k, scale=scale,
-                               causal=causal, seq_len=Tp, true_len=T)
-    grid = (B * H, Tp // blk_q)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, Tp, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, Tp, D), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
-        interpret=interpret,
-    )(qb, kb, vb)
-    out = out[:, :T]
-    return jnp.moveaxis(out.reshape(B, H, T, D), 1, 2)
+    o, _ = _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+    o, lse = _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _attention_reference(q, k, v, causal,
-                                                          scale), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _fa_bwd_call(q, k, v, o, lse, g, causal, scale, block_q, block_k,
+                        interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

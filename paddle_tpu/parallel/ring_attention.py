@@ -7,6 +7,14 @@ have: sequences sharded over a mesh axis, attention computed exactly via a ring
 of ``ppermute`` steps with online-softmax (flash-style) accumulation, so each
 chip only ever holds 1/N of the KV cache and the KV blocks ride the ICI ring.
 
+Per-step compute runs the Pallas flash kernel (ops/pallas_kernels.py), so the
+[T_local, T_local] score tile lives only in VMEM. The backward pass is
+hand-written: because flash-attention block gradients factor over key blocks
+given the *global* logsumexp and delta = rowsum(dO·O), each ring step computes
+one block's (dq, dk, dv) with the Pallas backward kernels while the dk/dv
+accumulators ride the ring alongside their KV block — after n steps every
+accumulator is back home with contributions from all devices.
+
 Layout: q/k/v are [batch, time_local, heads, head_dim] inside ``shard_map`` over
 the ``seq`` axis; time_local = T_global / n_shards.
 """
@@ -19,7 +27,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import pallas_kernels as pk
 
 _NEG = -1e30
 
@@ -83,45 +93,158 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
+def _merge_partials(o1, lse1, o2, lse2):
+    """Exactly combine two attention partials over disjoint key sets.
+
+    o_i are softmax-normalised within their key set, lse_i the corresponding
+    logsumexp [B,T,H]. Returns the merged (o, lse).
+    """
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = w1 + w2
+    safe = jnp.where(denom == 0.0, 1.0, denom)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / safe[..., None]
+    return o, m + jnp.log(safe)
+
+
+def _step_attention(q, k, v, diag, causal, scale, interpret):
+    """One ring step's partial attention: Pallas flash kernel, (o_f32, lse).
+
+    ``diag`` (traced bool) selects the causally-masked kernel when this step
+    holds the device's own KV block.
+    """
+    if not causal:
+        o, lse = pk.flash_attention_with_lse(q, k, v, causal=False,
+                                             scale=scale, interpret=interpret)
+        return o.astype(jnp.float32), lse
+    o, lse = lax.cond(
+        diag,
+        lambda args: pk.flash_attention_with_lse(*args, causal=True,
+                                                 scale=scale,
+                                                 interpret=interpret),
+        lambda args: pk.flash_attention_with_lse(*args, causal=False,
+                                                 scale=scale,
+                                                 interpret=interpret),
+        (q, k, v))
+    return o.astype(jnp.float32), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
-                   causal: bool = False, scale: Optional[float] = None) -> jax.Array:
+                   causal: bool = False, scale: Optional[float] = None,
+                   interpret: Optional[bool] = None) -> jax.Array:
     """Exact attention with KV rotating around the ``axis_name`` ring.
 
     Call inside shard_map with q/k/v time-sharded: [B, T_local, H, D]. Each of
-    the n ring steps computes attention of the local Q block against the
-    currently-held KV block, then passes KV to the neighbour (ppermute over
-    ICI). Online softmax keeps the result exact.
+    the n ring steps runs the Pallas flash kernel on the local Q block against
+    the currently-held KV block, then passes KV to the neighbour (ppermute
+    over ICI); partials merge exactly via logaddexp. Causal steps where the
+    held block is entirely in the future are masked out (a zigzag layout that
+    balances that work is a future optimisation).
     """
+    o, _ = _ring_forward(q, k, v, axis_name, causal, scale, interpret)
+    return o
+
+
+def _ring_forward(q, k, v, axis_name, causal, scale, interpret):
     B, T, H, D = q.shape
-    scale = scale if scale is not None else D ** -0.5
+    scale_v = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = not pk._on_tpu()
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
-    t_local = jnp.arange(T)
-    q_pos = my * T + t_local
 
     # derive accumulator initials from q so the fori_loop carry keeps q's
     # device-varying type under shard_map's varying-axes check
     o = (q * 0).astype(jnp.float32)
-    l = (q[..., 0] * 0).astype(jnp.float32).transpose(0, 2, 1)
-    m = l + _NEG
+    lse = (q[..., 0] * 0).astype(jnp.float32) + _NEG    # [B,T,H]
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(i, carry):
-        o, l, m, k, v = carry
-        src = (my - i) % n                       # whose KV block we hold now
-        scores = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+        o, lse, k, v = carry
+        src = (my - i) % n                   # whose KV block we hold now
+        o_i, lse_i = _step_attention(q, k, v, src == my, causal, scale_v,
+                                     interpret)
         if causal:
-            k_pos = src * T + t_local
-            mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, _NEG)
-        o, l, m = _online_update(o, l, m, scores, v)
+            # blocks strictly in the future contribute nothing
+            skip = src > my
+            lse_i = jnp.where(skip, _NEG, lse_i)
+        o, lse = _merge_partials(o, lse, o_i, lse_i)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
-        return o, l, m, k, v
+        return o, lse, k, v
 
-    o, l, m, k, v = lax.fori_loop(0, n, body, (o, l, m, k, v))
-    l = jnp.where(l == 0.0, 1.0, l)
-    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    o, lse, k, v = lax.fori_loop(0, n, body, (o, lse, k, v))
+    return o.astype(q.dtype), lse
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale, interpret):
+    o, lse = _ring_forward(q, k, v, axis_name, causal, scale, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, interpret, res, g):
+    q, k, v, o, lse = res
+    B, T, H, D = q.shape
+    scale_v = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = not pk._on_tpu()
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # loop-invariant across ring steps: compute once, pass into each block
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    def block_grads(k_blk, v_blk, diag):
+        """(dq, dk, dv) for the local Q against one KV block, using the
+        global lse/delta (flash block gradients factor over key blocks)."""
+        if not causal:
+            return pk.flash_block_grads(q, k_blk, v_blk, o, lse, g,
+                                        causal=False, scale=scale_v,
+                                        interpret=interpret, delta=delta)
+        return lax.cond(
+            diag,
+            lambda args: pk.flash_block_grads(q, *args, o, lse, g,
+                                              causal=True, scale=scale_v,
+                                              interpret=interpret,
+                                              delta=delta),
+            lambda args: pk.flash_block_grads(q, *args, o, lse, g,
+                                              causal=False, scale=scale_v,
+                                              interpret=interpret,
+                                              delta=delta),
+            (k_blk, v_blk))
+
+    dq0 = (q * 0).astype(jnp.float32)
+    dk0 = (k * 0).astype(jnp.float32)
+    dv0 = (v * 0).astype(jnp.float32)
+
+    def body(i, carry):
+        dq, k_blk, v_blk, dk, dv = carry
+        src = (my - i) % n
+        dq_i, dk_i, dv_i = block_grads(k_blk, v_blk, src == my)
+        if causal:
+            skip = src > my
+            dq_i = jnp.where(skip, 0.0, dq_i.astype(jnp.float32))
+            dk_i = jnp.where(skip, 0.0, dk_i.astype(jnp.float32))
+            dv_i = jnp.where(skip, 0.0, dv_i.astype(jnp.float32))
+        dq = dq + dq_i.astype(jnp.float32)
+        dk = dk + dk_i.astype(jnp.float32)
+        dv = dv + dv_i.astype(jnp.float32)
+        # dk/dv accumulators travel WITH their KV block; after n hops each is
+        # back at its home device having collected every device's contribution
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return dq, k_blk, v_blk, dk, dv
+
+    dq, _, _, dk, dv = lax.fori_loop(0, n, body, (dq0, k, v, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_self_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
@@ -131,18 +254,20 @@ def ring_self_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
     q/k/v: [B, T_global, H, D] (replicated or already seq-sharded on dim 1).
     """
     spec = P(None, seq_axis, None, None)
+    # check_vma=False: pallas_call out_shapes carry no varying-mesh-axes info
     fn = jax.shard_map(
         partial(ring_attention, axis_name=seq_axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
     return fn(q, k, v)
 
 
 def ulysses_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
                       causal: bool = False):
     """DeepSpeed-Ulysses-style sequence parallelism: all_to_all re-shards
-    time-sharded q/k/v to head-sharded, runs full attention locally over the
-    whole sequence, then all_to_alls back. Complements ring attention when
-    heads >= shards: two a2a's instead of n ppermute steps.
+    time-sharded q/k/v to head-sharded, runs the Pallas flash kernel locally
+    over the whole sequence, then all_to_alls back. Complements ring attention
+    when heads >= shards: two a2a's instead of n ppermute steps.
     """
     spec = P(None, seq_axis, None, None)
 
@@ -151,10 +276,9 @@ def ulysses_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
         q = lax.all_to_all(q, seq_axis, split_axis=2, concat_axis=1, tiled=True)
         k = lax.all_to_all(k, seq_axis, split_axis=2, concat_axis=1, tiled=True)
         v = lax.all_to_all(v, seq_axis, split_axis=2, concat_axis=1, tiled=True)
-        o = blockwise_attention(q, k, v, block_size=max(q.shape[1] // 4, 128),
-                                causal=causal)
+        o = pk.flash_attention(q, k, v, causal=causal)
         return lax.all_to_all(o, seq_axis, split_axis=1, concat_axis=2, tiled=True)
 
     fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+                       out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -45,3 +45,48 @@ def test_flash_attention_jits_and_grads():
 
     g = jax.jit(jax.grad(loss))(q)
     assert np.isfinite(np.asarray(g)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("T", [32, 48])   # 48 exercises the padded-tail path
+def test_flash_backward_kernels_match_reference(causal, T):
+    """The Pallas dq / dkv kernels vs autodiff through dense attention —
+    the grad-side analog of the MKLDNN equivalence discipline."""
+    rng = jax.random.PRNGKey(7)
+    kq, kk, kv, kg = jax.random.split(rng, 4)
+    B, H, D = 2, 2, 16
+    q = jax.random.normal(kq, (B, T, H, D))
+    k = jax.random.normal(kk, (B, T, H, D))
+    v = jax.random.normal(kv, (B, T, H, D))
+    g = jax.random.normal(kg, (B, T, H, D))
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=16,
+                                       block_k=16, interpret=True) * g)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_full_attention(q, k, v, causal) * g)
+
+    got = jax.grad(f, (0, 1, 2))(q, k, v)
+    want = jax.grad(f_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_backward_no_dense_scores_in_jaxpr():
+    """The [T, T] score matrix must not materialise in HBM in the backward
+    jaxpr (the round-1 fallback recomputed dense attention)."""
+    T = 64
+    q = jnp.zeros((1, T, 1, 16))
+
+    def loss(q):
+        return jnp.sum(flash_attention(q, q, q, block_q=16, block_k=16,
+                                       interpret=True))
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss))(q)
+    for eqn in jaxpr.jaxpr.eqns:
+        for var in eqn.outvars:
+            shape = getattr(var.aval, "shape", ())
+            assert not (len(shape) >= 2 and shape[-1] == T and
+                        shape[-2] == T), f"dense [T,T] tensor in bwd: {eqn}"
