@@ -1,18 +1,26 @@
-"""Flagship benchmark — prints ONE JSON line.
+"""Flagship benchmarks — prints one JSON line per metric.
 
-Benchmarks LSTM text-classification ms/batch against the reference's published K40m
-number (BASELINE.md: 83 ms/batch @ bs=64, hidden=256 — benchmark/README.md:115-119).
-vs_baseline > 1 means we are faster than the reference by that factor.
+All three BASELINE.md headline configs run on the default jax device (the
+real TPU chip under the driver): ResNet-50 images/sec, seq2seq NMT tokens/sec,
+and — LAST, as the flagship line with a published reference number — LSTM
+text-classification ms/batch vs the K40m baseline (BASELINE.md: 83 ms/batch
+@ bs=64, hidden=256 — benchmark/README.md:115-119). vs_baseline > 1 means we
+are faster than the reference by that factor.
+
+Methodology notes live in each benchmarks/*.py docstring (varied lengths,
+train-mode BN with stat updates, distinct rotating device-staged batches,
+on-device-loop differencing timing).
 """
 
 from __future__ import annotations
 
 import json
 import time
+import traceback
 
 
 def bench_mlp_fallback():
-    """Used until the LSTM bench path exists."""
+    """Emergency fallback if every real bench fails."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models import MnistMLP
@@ -44,12 +52,21 @@ def bench_mlp_fallback():
 
 
 def main():
-    try:
-        from benchmarks.lstm_textcls import run as run_lstm  # noqa
-        result = run_lstm()
-    except Exception:
-        result = bench_mlp_fallback()
-    print(json.dumps(result))
+    flagship_ok = False
+    # secondary metrics first; the flagship (has a published baseline) last so
+    # it is the line the driver's tail-parser records
+    for name in ("resnet50", "seq2seq_nmt", "lstm_textcls"):
+        try:
+            mod = __import__(f"benchmarks.{name}", fromlist=["run"])
+            print(json.dumps(mod.run()), flush=True)
+            if name == "lstm_textcls":
+                flagship_ok = True
+        except Exception:
+            traceback.print_exc()
+    if not flagship_ok:
+        # guarantee the LAST line is flagship-or-fallback, never a secondary
+        # metric masquerading as the flagship in the driver's tail-parse
+        print(json.dumps(bench_mlp_fallback()), flush=True)
 
 
 if __name__ == "__main__":

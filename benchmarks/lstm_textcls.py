@@ -5,6 +5,16 @@ Exact config of ``benchmark/paddle/rnn/rnn.py``: vocab 30000, embedding 128,
 batch 64. Published number: 83 ms/batch on 1x K40m
 (benchmark/README.md:115-119).
 
+Methodology (honest-bench notes):
+* Lengths VARY per sample (uniform 30..100, IMDB-like), so the masked
+  variable-length path — the whole point of the LoD story — does real work
+  every step. The reference's IMDB runs were variable-length too (padding-free
+  LoD batching), so this is the comparable configuration.
+* Eight distinct batches are staged on device and rotated through the loop so
+  no step reuses the previous step's data.
+* Timing: N chained training steps in ONE on-device ``fori_loop`` dispatch,
+  short/long-loop differencing to cancel the remote-tunnel dispatch latency.
+
 Measures the full training step (fwd+bwd+Adam update) steady-state ms/batch on
 the default jax device; ``vs_baseline`` = reference_ms / our_ms (>1 == faster).
 """
@@ -21,7 +31,9 @@ VOCAB = 30000
 EMBED = 128
 HIDDEN = 256
 SEQ_LEN = 100
+MIN_LEN = 30
 BATCH = 64
+NBUF = 8          # distinct staged batches rotated through the loop
 BASELINE_MS = 83.0
 
 
@@ -54,30 +66,34 @@ def build():
         params, state = opt.update(grads, state, params)
         return params, state, loss
 
-    step = jax.jit(step_fn)
-
     @jax.jit
     def run_n(params, state, data, lengths, labels, n):
-        # n chained steps in ONE dispatch: timing is device compute, immune to
-        # the remote-tunnel per-call dispatch latency
-        def body(_, carry):
+        # n chained steps in ONE dispatch, rotating over NBUF distinct staged
+        # batches: timing is device compute, immune to the remote-tunnel
+        # per-call dispatch latency, and no step sees repeated data
+        def body(i, carry):
             params, state, _ = carry
-            return step_fn(params, state, data, lengths, labels)
+            j = i % NBUF
+            d = jax.lax.dynamic_index_in_dim(data, j, 0, keepdims=False)
+            ln = jax.lax.dynamic_index_in_dim(lengths, j, 0, keepdims=False)
+            lb = jax.lax.dynamic_index_in_dim(labels, j, 0, keepdims=False)
+            return step_fn(params, state, d, ln, lb)
         loss0 = jnp.float32(0)
         return jax.lax.fori_loop(0, n, body, (params, state, loss0))
 
     rs = np.random.RandomState(0)
-    data = jnp.asarray(rs.randint(0, VOCAB, (BATCH, SEQ_LEN)), jnp.int32)
-    lengths = jnp.full((BATCH,), SEQ_LEN, jnp.int32)
-    labels = jnp.asarray(rs.randint(0, 2, (BATCH,)), jnp.int32)
-    return step, run_n, params, state, (data, lengths, labels)
+    data = jnp.asarray(rs.randint(0, VOCAB, (NBUF, BATCH, SEQ_LEN)), jnp.int32)
+    lengths = jnp.asarray(rs.randint(MIN_LEN, SEQ_LEN + 1, (NBUF, BATCH)),
+                          jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 2, (NBUF, BATCH)), jnp.int32)
+    return run_n, params, state, (data, lengths, labels)
 
 
 def run(iters: int = 100, repeats: int = 3):
     """Difference a short and a long on-device loop so the fixed dispatch +
     host-fetch latency (large under the remote tunnel, where block_until_ready
     is unreliable) cancels; float(loss) forces completion."""
-    step, run_n, params, state, batch = build()
+    run_n, params, state, batch = build()
     run_n(params, state, *batch, 2)          # compile
 
     def timed(n):
@@ -89,9 +105,12 @@ def run(iters: int = 100, repeats: int = 3):
     t_short = min(timed(2) for _ in range(repeats))
     t_long = min(timed(iters + 2) for _ in range(repeats))
     ms = max(t_long - t_short, 1e-9) / iters * 1e3
-    return {"metric": "lstm_textcls_train_ms_per_batch_bs64_h256_len100",
+    # metric key carries the methodology (len30-100 varied) — renamed from the
+    # round-1 all-len-100 key so trend tracking can't silently mix semantics
+    return {"metric": "lstm_textcls_train_ms_per_batch_bs64_h256_len30-100",
             "value": round(ms, 3), "unit": "ms/batch",
-            "vs_baseline": round(BASELINE_MS / ms, 3)}
+            "vs_baseline": round(BASELINE_MS / ms, 3),
+            "note": "varied lengths 30..100, 8 distinct rotating batches"}
 
 
 if __name__ == "__main__":

@@ -3,7 +3,19 @@
 benchmark/paddle/image/resnet.py layer_num=50, batch 64, 224x224x3).
 
 bf16 compute (MXU native) with f32 params/optimizer — the TPU-idiomatic mixed
-precision; same on-device-loop timing discipline as lstm_textcls.
+precision.
+
+Methodology (honest-bench notes):
+* TRAIN-mode batch norm: per-batch statistics are computed and the running
+  stats are updated and merged back every step (`nn.apply_stat_updates`), so
+  the measured step includes all BN-stat work.
+* Four distinct input batches are staged on device and rotated through the
+  loop, so BN statistics do real, different work each step. (In deployment the
+  host->HBM infeed overlaps compute via data/prefetch.py DoubleBuffer; staging
+  keeps the remote-tunnel transfer out of the timed region while preserving
+  per-step data variation.)
+* Timing: N chained steps in one on-device ``fori_loop`` dispatch with
+  short/long differencing, as in lstm_textcls.
 """
 
 from __future__ import annotations
@@ -17,9 +29,11 @@ import numpy as np
 BATCH = 64
 IMAGE = 224
 CLASSES = 1000
+NBUF = 4          # distinct staged batches rotated through the loop
 
 
 def build(batch: int = BATCH, bf16: bool = True):
+    from paddle_tpu import nn
     from paddle_tpu.models import ResNet
     from paddle_tpu.optimizer import Momentum
 
@@ -29,32 +43,42 @@ def build(batch: int = BATCH, bf16: bool = True):
     state = opt.init(params)
 
     def loss_fn(params, x, y):
+        mut = {}
         if bf16:
             p16 = jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.bfloat16)
                 if a.dtype == jnp.float32 else a, params)
-            logits = model(p16, x.astype(jnp.bfloat16)).astype(jnp.float32)
+            logits = model(p16, x.astype(jnp.bfloat16), train=True,
+                           mutable=mut).astype(jnp.float32)
         else:
-            logits = model(params, x)
+            logits = model(params, x, train=True, mutable=mut)
         logp = jax.nn.log_softmax(logits)
-        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+        return loss, mut
 
     def step_fn(params, state, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        (loss, mut), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, x, y)
         params, state = opt.update(grads, state, params)
+        # merge the train-mode BN running-stat updates back (f32 master copy)
+        mut = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), mut)
+        params = nn.apply_stat_updates(params, mut)
         return params, state, loss
 
     @jax.jit
-    def run_n(params, state, x, y, n):
-        def body(_, carry):
+    def run_n(params, state, xs, ys, n):
+        def body(i, carry):
             params, state, _ = carry
+            j = i % NBUF
+            x = jax.lax.dynamic_index_in_dim(xs, j, 0, keepdims=False)
+            y = jax.lax.dynamic_index_in_dim(ys, j, 0, keepdims=False)
             return step_fn(params, state, x, y)
         return jax.lax.fori_loop(0, n, body, (params, state, jnp.float32(0)))
 
     rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.rand(batch, IMAGE, IMAGE, 3), jnp.float32)
-    y = jnp.asarray(rs.randint(0, CLASSES, batch), jnp.int32)
-    return run_n, params, state, (x, y)
+    xs = jnp.asarray(rs.rand(NBUF, batch, IMAGE, IMAGE, 3), jnp.float32)
+    ys = jnp.asarray(rs.randint(0, CLASSES, (NBUF, batch)), jnp.int32)
+    return run_n, params, state, (xs, ys)
 
 
 def run(iters: int = 20, repeats: int = 2, batch: int = BATCH):
@@ -71,9 +95,11 @@ def run(iters: int = 20, repeats: int = 2, batch: int = BATCH):
     t_long = min(timed(iters + 1) for _ in range(repeats))
     sec = max(t_long - t_short, 1e-9) / iters
     ips = batch / sec
-    return {"metric": "resnet50_train_images_per_sec_bs64_224",
+    # key carries train-mode-BN semantics (r1 measured inference-mode BN)
+    return {"metric": "resnet50_train_images_per_sec_bs64_224_trainbn",
             "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": None}  # no published reference ResNet number (BASELINE.md)
+            "vs_baseline": None,  # no published reference ResNet number
+            "note": "train-mode BN with stat updates, 4 distinct rotating batches"}
 
 
 if __name__ == "__main__":

@@ -2,9 +2,15 @@
 north-star (BASELINE.json tokens/sec/chip; the reference's benchmark README
 deferred its seq2seq numbers, benchmark/README.md:141,168).
 
-Config: vocab 30k/30k, embed 512, hidden 512, src/trg length 32, batch 64 —
-a standard GNMT-small-ish shape. Counts target tokens/sec through the full
-training step.
+Config: vocab 30k/30k, embed 512, hidden 512, src/trg padded length 32,
+batch 64 — a standard GNMT-small-ish shape.
+
+Methodology (honest-bench notes):
+* Source/target lengths VARY per sample (uniform 16..32), so the masked
+  variable-length path does real work; tokens/sec counts the TRUE number of
+  target tokens processed (sum of target lengths), not padded positions.
+* Four distinct batches staged on device, rotated through the loop.
+* Timing: on-device fori_loop with short/long differencing (see lstm_textcls).
 """
 
 from __future__ import annotations
@@ -19,7 +25,9 @@ SRC_VOCAB = TRG_VOCAB = 30000
 EMBED = 512
 HIDDEN = 512
 SEQ = 32
+MIN_LEN = 16
 BATCH = 64
+NBUF = 4
 
 
 def build():
@@ -43,22 +51,29 @@ def build():
         return params, state, loss
 
     @jax.jit
-    def run_n(params, state, src, slen, tin, tout, tlen, n):
-        def body(_, carry):
+    def run_n(params, state, srcs, slens, tins, touts, tlens, n):
+        def body(i, carry):
             params, state, _ = carry
-            return step_fn(params, state, src, slen, tin, tout, tlen)
+            j = i % NBUF
+            pick = lambda a: jax.lax.dynamic_index_in_dim(a, j, 0,
+                                                          keepdims=False)
+            return step_fn(params, state, pick(srcs), pick(slens),
+                           pick(tins), pick(touts), pick(tlens))
         return jax.lax.fori_loop(0, n, body, (params, state, jnp.float32(0)))
 
     rs = np.random.RandomState(0)
-    src = jnp.asarray(rs.randint(3, SRC_VOCAB, (BATCH, SEQ)), jnp.int32)
-    tin = jnp.asarray(rs.randint(3, TRG_VOCAB, (BATCH, SEQ)), jnp.int32)
-    tout = jnp.asarray(rs.randint(3, TRG_VOCAB, (BATCH, SEQ)), jnp.int32)
-    lens = jnp.full((BATCH,), SEQ, jnp.int32)
-    return run_n, params, state, (src, lens, tin, tout, lens)
+    srcs = jnp.asarray(rs.randint(3, SRC_VOCAB, (NBUF, BATCH, SEQ)), jnp.int32)
+    tins = jnp.asarray(rs.randint(3, TRG_VOCAB, (NBUF, BATCH, SEQ)), jnp.int32)
+    touts = jnp.asarray(rs.randint(3, TRG_VOCAB, (NBUF, BATCH, SEQ)), jnp.int32)
+    slens = jnp.asarray(rs.randint(MIN_LEN, SEQ + 1, (NBUF, BATCH)), jnp.int32)
+    tlens = jnp.asarray(rs.randint(MIN_LEN, SEQ + 1, (NBUF, BATCH)), jnp.int32)
+    # true target tokens per step, averaged over the rotation
+    tokens_per_step = float(np.asarray(tlens).sum()) / NBUF
+    return run_n, params, state, (srcs, slens, tins, touts, tlens), tokens_per_step
 
 
 def run(iters: int = 30, repeats: int = 2):
-    run_n, params, state, b = build()
+    run_n, params, state, b, tokens_per_step = build()
     run_n(params, state, *b, 1)
 
     def timed(n):
@@ -70,10 +85,11 @@ def run(iters: int = 30, repeats: int = 2):
     t_short = min(timed(1) for _ in range(repeats))
     t_long = min(timed(iters + 1) for _ in range(repeats))
     sec = max(t_long - t_short, 1e-9) / iters
-    tokens = BATCH * SEQ
-    return {"metric": "seq2seq_nmt_train_tokens_per_sec_h512_len32_bs64",
-            "value": round(tokens / sec, 1), "unit": "tokens/sec",
-            "vs_baseline": None}  # reference published no seq2seq number
+    # true-token semantics + varied lengths are in the key (vs r1's padded-len32)
+    return {"metric": "seq2seq_nmt_train_true_tokens_per_sec_h512_len16-32_bs64",
+            "value": round(tokens_per_step / sec, 1), "unit": "tokens/sec",
+            "vs_baseline": None,  # reference published no seq2seq number
+            "note": "varied lengths 16..32, true-token count, 4 rotating batches"}
 
 
 if __name__ == "__main__":
