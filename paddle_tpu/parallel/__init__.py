@@ -22,7 +22,7 @@ from .sharding import (replicate, shard, shard_batch, shard_params,
                        with_sharding_constraint, ShardingRules)
 from .collectives import (all_reduce, all_gather, reduce_scatter, broadcast,
                           all_to_all, permute_ring, axis_index)
-from .data_parallel import DataParallel
+from .data_parallel import DataParallel, Zero1DataParallel, Zero1State
 from .tensor_parallel import ColumnParallelLinear, RowParallelLinear, ShardedEmbedding
 from .ring_attention import (ring_attention, blockwise_attention,
                              ring_self_attention, ulysses_attention)
@@ -36,6 +36,8 @@ __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
     "permute_ring", "axis_index",
     "DataParallel",
+    "Zero1DataParallel",
+    "Zero1State",
     "ColumnParallelLinear", "RowParallelLinear", "ShardedEmbedding",
     "ring_attention", "blockwise_attention", "ring_self_attention",
     "ulysses_attention",

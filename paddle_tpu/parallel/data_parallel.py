@@ -17,9 +17,11 @@ optimizer state, ParameterServer2.h:383 doOperation).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import make_mesh
@@ -34,13 +36,12 @@ class DataParallel:
 
     def __init__(self, loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
                  axis: str = "data", param_rules: Optional[ShardingRules] = None,
-                 zero1: bool = False, donate: bool = True):
+                 donate: bool = True):
         self.loss_fn = loss_fn
         self.opt = optimizer
         self.mesh = mesh if mesh is not None else make_mesh(data=-1)
         self.axis = axis
         self.rules = param_rules
-        self.zero1 = zero1
 
         def _step(params, opt_state, *batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
@@ -56,24 +57,8 @@ class DataParallel:
         params = shard_params(params, self.mesh, self.rules)
         if opt_state is None:
             opt_state = self.opt.init(params)
-        if self.zero1:
-            opt_state = self._shard_opt_state(opt_state)
-        else:
-            opt_state = jax.device_put(opt_state, replicate(self.mesh))
+        opt_state = jax.device_put(opt_state, replicate(self.mesh))
         return params, opt_state
-
-    def _shard_opt_state(self, opt_state):
-        """ZeRO-1: slot buffers sharded over the data axis on dim 0 when divisible."""
-        n = self.mesh.shape[self.axis]
-
-        def put(x):
-            if getattr(x, "ndim", 0) >= 1 and x.shape[0] % n == 0 and x.shape[0] >= n:
-                spec = P(self.axis, *([None] * (x.ndim - 1)))
-            else:
-                spec = P()
-            return jax.device_put(x, NamedSharding(self.mesh, spec))
-
-        return jax.tree_util.tree_map(put, opt_state)
 
     def shard_batch(self, batch):
         return shard_batch(batch, self.mesh, self.axis)
@@ -84,3 +69,151 @@ class DataParallel:
         (use :meth:`shard_batch`) or will be sharded by XLA on first use."""
         with self.mesh:
             return self._step(params, opt_state, *batch)
+
+
+class Zero1State(NamedTuple):
+    """ZeRO-1 training state: the f32 master copy of all trainable parameters
+    lives as ONE flat vector sharded over the data axis; optimizer slots share
+    that sharding; non-trainable ``stats`` leaves stay replicated."""
+    flat: jax.Array          # [N_padded] f32, sharded P(axis)
+    opt_state: Any           # {"step": scalar, "slots": {"flat": ...}} P(axis)
+    stats: Tuple[Any, ...]   # replicated non-trainable leaves, original order
+
+
+class Zero1DataParallel:
+    """TRUE ZeRO-1 data parallelism (partitioned optimizer states).
+
+    Semantics recovered from the reference's parameter server, where each
+    pserver owns a shard of every parameter block and runs the optimizer on
+    its shard only (ParameterServer2.h:383 doOperation; ParameterClient2
+    splits parameters into blocks hashed across pservers):
+
+    * each device owns 1/n of one flat f32 master parameter vector and the
+      optimizer slots FOR THAT SHARD ONLY (n× slot-memory saving),
+    * per step inside one jitted shard_map: all_gather(param shards) →
+      local fwd/bwd → **reduce_scatter**(grads) → shard-local optimizer
+      update → next step's all_gather broadcasts the new params,
+    * final parameters match plain DP / single-device training exactly
+      (equivalence-tested like test_CompareSparse.cpp).
+
+    loss_fn(params, *batch) -> scalar loss (mean over ITS batch rows).
+    """
+
+    def __init__(self, loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
+                 axis: str = "data"):
+        if getattr(optimizer, "grad_clip", None) is not None and \
+                optimizer.grad_clip[0] in ("norm", "global_norm"):
+            raise ValueError(
+                "norm-based grad clip inside the shard-local optimizer would "
+                "clip by the LOCAL shard's norm (not per-leaf / global); "
+                "clip in loss_fn or use grad_clip=('value', ...)")
+        self.loss_fn = loss_fn
+        self.opt = optimizer
+        self.mesh = mesh if mesh is not None else make_mesh(data=-1)
+        self.axis = axis
+        self.n = self.mesh.shape[axis]
+        self._stepfns = {}        # batch treedef -> compiled shard_map step
+
+    # -- flat <-> pytree ----------------------------------------------------
+    def _build_template(self, params):
+        from ..optimizer.optimizers import _is_stat_path
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        self._treedef = treedef
+        self._is_stat = [_is_stat_path(path) for path, _ in flat]
+        train = [leaf for (path, leaf), st in zip(flat, self._is_stat) if not st]
+        self._shapes = [l.shape for l in train]
+        self._dtypes = [l.dtype for l in train]
+        self._sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in train]
+        total = sum(self._sizes)
+        self._padded = -(-total // self.n) * self.n
+        self._offsets = np.cumsum([0] + self._sizes).tolist()
+
+    def _flatten(self, leaves):
+        """Trainable leaves -> [N_padded] f32."""
+        parts = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+        flat = jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.float32)
+        pad = self._padded - flat.shape[0]
+        return jnp.pad(flat, (0, pad)) if pad else flat
+
+    def _unflatten(self, flat, stats):
+        """[N_padded] f32 + replicated stat leaves -> params pytree."""
+        train = [flat[o:o + s].reshape(shape).astype(dt)
+                 for o, s, shape, dt in zip(self._offsets, self._sizes,
+                                            self._shapes, self._dtypes)]
+        it_t, it_s = iter(train), iter(stats)
+        leaves = [next(it_s) if st else next(it_t) for st in self._is_stat]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def _train_leaves(self, tree):
+        flat = jax.tree_util.tree_leaves(tree)
+        return [l for l, st in zip(flat, self._is_stat) if not st]
+
+    def _stat_leaves(self, tree):
+        flat = jax.tree_util.tree_leaves(tree)
+        return tuple(l for l, st in zip(flat, self._is_stat) if st)
+
+    # -- placement ----------------------------------------------------------
+    def init(self, params) -> Zero1State:
+        self._build_template(params)
+        flat = self._flatten(self._train_leaves(params))
+        flat = jax.device_put(flat, NamedSharding(self.mesh, P(self.axis)))
+        opt_state = self.opt.init({"flat": flat})   # slots inherit the sharding
+        opt_state = jax.tree_util.tree_map(
+            lambda x: x if getattr(x, "ndim", 0) >= 1 else
+            jax.device_put(x, replicate(self.mesh)), opt_state)
+        stats = jax.device_put(self._stat_leaves(params), replicate(self.mesh))
+        return Zero1State(flat, opt_state, stats)
+
+    def params(self, state: Zero1State):
+        """Materialise the full parameter pytree (for eval / checkpointing)."""
+        return self._unflatten(jax.device_get(state.flat), state.stats)
+
+    def shard_batch(self, batch):
+        return shard_batch(batch, self.mesh, self.axis)
+
+    # -- the hot loop --------------------------------------------------------
+    def _make_step(self, state: Zero1State, batch):
+        axis, n = self.axis, self.n
+        flat_spec = P(axis)
+        state_spec = jax.tree_util.tree_map(
+            lambda x: P(axis) if getattr(x, "ndim", 0) >= 1 else P(),
+            state.opt_state)
+        stats_spec = jax.tree_util.tree_map(lambda x: P(), state.stats)
+        batch_specs = tuple(
+            jax.tree_util.tree_map(
+                lambda l: P(axis, *([None] * (jnp.ndim(l) - 1)))
+                if jnp.ndim(l) >= 1 else P(), b)
+            for b in batch)
+
+        def local_step(flat_shard, opt_state, stats, *batch):
+            full = jax.lax.all_gather(flat_shard, axis, tiled=True)
+            params = self._unflatten(full, stats)
+            loss, grads = jax.value_and_grad(self.loss_fn)(params, *batch)
+            gflat = self._flatten(self._train_leaves(grads))
+            # mean over the data axis, scattered so each device only keeps
+            # (and updates) its own 1/n shard
+            g_shard = jax.lax.psum_scatter(gflat, axis, scatter_dimension=0,
+                                           tiled=True) / n
+            new_p, new_state = self.opt.update({"flat": g_shard}, opt_state,
+                                               {"flat": flat_shard})
+            return new_p["flat"], new_state, jax.lax.pmean(loss, axis)
+
+        fn = jax.shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(flat_spec, state_spec, stats_spec) + batch_specs,
+            out_specs=(flat_spec, state_spec, P()),
+            check_vma=False)
+        return jax.jit(fn, donate_argnums=(0, 1))
+
+    def step(self, state: Zero1State, *batch):
+        """One global-batch ZeRO-1 step -> (new_state, loss)."""
+        # key on leaf ranks too: in_specs bake each leaf's rank, so same-tree
+        # batches with different ranks must not share a compiled step
+        key = (str(jax.tree_util.tree_structure(batch)),
+               tuple(jnp.ndim(l) for l in jax.tree_util.tree_leaves(batch)))
+        if key not in self._stepfns:
+            self._stepfns[key] = self._make_step(state, batch)
+        with self.mesh:
+            flat, opt_state, loss = self._stepfns[key](
+                state.flat, state.opt_state, state.stats, *batch)
+        return Zero1State(flat, opt_state, state.stats), loss

@@ -66,3 +66,29 @@ def make_global_array(local_rows: np.ndarray, mesh: Mesh, axis: str = "data"):
     if jax.process_count() == 1:
         return jax.device_put(local_rows, sharding)
     return jax.make_array_from_process_local_data(sharding, local_rows)
+
+
+def make_global_batch(mesh: Mesh, batch, axis: str = "data"):
+    """Assemble a per-process host-local batch into global device arrays.
+
+    Each process passes ITS slice of the global batch (rows
+    ``process_batch_slice(global_bs)``); returns jax Arrays sharded
+    ``P(axis)`` over the global mesh — the multi-host analog of
+    DataParallel.shard_batch (replaces the reference's per-trainer
+    DataProvider feed, trainer flags trainer_id/num_gradient_servers).
+    """
+    return jax.tree_util.tree_map(
+        lambda x: make_global_array(np.asarray(x), mesh, axis), batch)
+
+
+def replicate_from_host(mesh: Mesh, tree):
+    """Place identical host data (e.g. initial params) replicated over a
+    multi-process mesh — every process must pass the same values (SPMD)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P()), x, x.shape)
+
+    return jax.tree_util.tree_map(put, tree)

@@ -1,0 +1,76 @@
+"""Worker process for the multi-process data-parallel equivalence test.
+
+Launched by tests/test_multiprocess_dp.py as N separate OS processes, each
+owning 2 virtual CPU devices — the real multi-host code path
+(jax.distributed + Gloo collectives across processes), the in-process
+analog of the reference's pserver tests that spin real trainers against real
+localhost servers (gserver/tests/test_CompareSparse.cpp:64-73).
+
+Usage: python mp_dp_worker.py <process_id> <num_processes> <port> <out.npz>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, out = (int(sys.argv[1]), int(sys.argv[2]),
+                             int(sys.argv[3]), sys.argv[4])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc,
+                               process_id=pid)
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from paddle_tpu import nn, parallel as pp
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.parallel import multihost
+
+    n_dev = len(jax.devices())              # nproc * 2
+    mesh = multihost.global_mesh(data=n_dev)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16, act="relu")
+            self.fc2 = nn.Linear(16, 2)
+
+        def __call__(self, params, x, **kw):
+            return self.fc2(params["fc2"], self.fc1(params["fc1"], x))
+
+    model = Net()
+
+    def loss(params, x, y):
+        logits = model(params, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], 1).mean()
+
+    # deterministic global data; every process slices out its own rows
+    rs = np.random.RandomState(0)
+    GB = 32
+    X = rs.randn(GB, 8).astype(np.float32)
+    Y = rs.randint(0, 2, GB).astype(np.int32)
+    sl = multihost.process_batch_slice(GB)
+
+    params0 = model.init(jax.random.PRNGKey(7))
+    params = multihost.replicate_from_host(mesh, jax.device_get(params0))
+    dp = pp.DataParallel(loss, SGD(0.1), mesh=mesh)
+    opt_state = multihost.replicate_from_host(
+        mesh, jax.device_get(dp.opt.init(params0)))
+
+    bx, by = multihost.make_global_batch(mesh, (X[sl], Y[sl]))
+    for _ in range(5):
+        params, opt_state, l = dp.step(params, opt_state, bx, by)
+
+    if pid == 0:
+        flat = {k: np.asarray(v)
+                for k, v in nn.Module.named_parameters(jax.device_get(params))}
+        np.savez(out, **flat)
+    jax.effects_barrier()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Multi-process data parallelism: REAL cross-process collectives.
+
+Spawns two OS processes (2 virtual CPU devices each) that join one
+jax.distributed job and train the same toy net over a 4-device global mesh,
+then checks the result equals single-process training on the full batch —
+the shape of the reference's in-process distributed tests
+(gserver/tests/test_CompareSparse.cpp:55-110: same config under {local,
+multi-trainer, remote pserver}, final parameter buffers compared).
+"""
+
+import os
+import subprocess
+import socket
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "mp_dp_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_reference():
+    from paddle_tpu import nn
+    from paddle_tpu.optimizer import SGD
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16, act="relu")
+            self.fc2 = nn.Linear(16, 2)
+
+        def __call__(self, params, x, **kw):
+            return self.fc2(params["fc2"], self.fc1(params["fc1"], x))
+
+    model = Net()
+
+    def loss(params, x, y):
+        logits = model(params, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], 1).mean()
+
+    rs = np.random.RandomState(0)
+    GB = 32
+    X = jnp.asarray(rs.randn(GB, 8), jnp.float32)
+    Y = jnp.asarray(rs.randint(0, 2, GB), jnp.int32)
+    params = model.init(jax.random.PRNGKey(7))
+    opt = SGD(0.1)
+    state = opt.init(params)
+    for _ in range(5):
+        _, grads = jax.value_and_grad(loss)(params, X, Y)
+        params, state = opt.update(grads, state, params)
+    return dict(nn.Module.named_parameters(jax.device_get(params)))
+
+
+def test_two_process_dp_matches_single(tmp_path):
+    port = _free_port()
+    out = str(tmp_path / "mp_params.npz")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), "2", str(port), out],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    logs = []
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=240)
+            logs.append(stdout.decode(errors="replace"))
+    finally:
+        for p in procs:       # a hung peer must not outlive the test
+            if p.poll() is None:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), "\n---\n".join(logs)
+
+    got = np.load(out)
+    want = _single_process_reference()
+    assert set(got.files) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], np.asarray(want[k]),
+                                   rtol=2e-5, atol=2e-5, err_msg=k)

@@ -104,21 +104,46 @@ def test_data_parallel_matches_single_device():
         np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5), k1
 
 
-def test_data_parallel_zero1_matches():
+def test_zero1_matches_plain_dp():
+    """TRUE ZeRO-1 (reduce-scatter grads, shard-local optimizer, all-gather
+    params) must train identically to plain replicated-optimizer DP."""
     x, y = _toy_data()
     model = _Net()
     loss = _loss_fn(model)
     dp0 = pp.DataParallel(loss, Adam(1e-2), mesh=pp.make_mesh(data=8))
-    dp1 = pp.DataParallel(loss, Adam(1e-2), mesh=pp.make_mesh(data=8), zero1=True)
+    z = pp.Zero1DataParallel(loss, Adam(1e-2), mesh=pp.make_mesh(data=8))
     pa, sa = dp0.init(model.init(jax.random.PRNGKey(2)))
-    pb, sb = dp1.init(model.init(jax.random.PRNGKey(2)))
+    zs = z.init(model.init(jax.random.PRNGKey(2)))
     ba = dp0.shard_batch((x, y))
     for _ in range(3):
         pa, sa, _ = dp0.step(pa, sa, *ba)
-        pb, sb, _ = dp1.step(pb, sb, *ba)
+        zs, _ = z.step(zs, *ba)
+    pb = z.params(zs)
     for (_, a), (_, b) in zip(Module.named_parameters(jax.device_get(pa)),
-                              Module.named_parameters(jax.device_get(pb))):
-        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+                              Module.named_parameters(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_zero1_optimizer_state_is_partitioned():
+    """The point of ZeRO-1: every optimizer slot buffer (and the master flat
+    param vector) is sharded over the data axis — each device holds 1/n."""
+    from jax.sharding import PartitionSpec as P
+    x, y = _toy_data()
+    model = _Net()
+    z = pp.Zero1DataParallel(_loss_fn(model), Adam(1e-2),
+                             mesh=pp.make_mesh(data=8))
+    zs = z.init(model.init(jax.random.PRNGKey(0)))
+    zs, _ = z.step(zs, *z.shard_batch((x, y)))
+
+    def assert_sharded(arr):
+        assert arr.sharding.spec == P("data"), arr.sharding
+        local = arr.addressable_shards[0].data
+        assert local.shape[0] * 8 == arr.shape[0]
+
+    assert_sharded(zs.flat)
+    for leaf in jax.tree_util.tree_leaves(zs.opt_state["slots"]):
+        assert_sharded(leaf)
 
 
 def test_tensor_parallel_linear_matches_dense():
