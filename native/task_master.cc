@@ -7,6 +7,9 @@
 //
 // C ABI for ctypes (paddle_tpu/runtime/master.py).
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -170,66 +173,166 @@ void ptm_stats(void* h, int* todo, int* pending, int* done, int* discarded,
 
 // Snapshot/restore (service.go:166-227: etcd snapshot -> here a local file;
 // the multi-host deployment points it at shared storage).
-// Format v2: header line, then per task a "tag id failures len\n" line
-// followed by exactly len raw payload bytes + '\n' — length-prefixed so empty
-// payloads and payloads containing whitespace/newlines survive the roundtrip.
+// Format v3: header "ptm_snapshot_v3 next_id epoch bodylen crc32\n" followed
+// by the body — per task a "tag id failures len\n" line plus exactly len raw
+// payload bytes + '\n' (length-prefixed so arbitrary payload bytes survive).
+// The CRC32 over the body (same integrity discipline as the Go pserver's
+// checkpoints, go/pserver/service.go:119-126) is verified on restore, and the
+// file is written to a temp path then renamed so readers never see a torn
+// snapshot.
+
+static uint32_t crc32_of(const std::string& data) {
+  // magic-static init: thread-safe under C++11 (snapshots may run
+  // concurrently from several servers' housekeeping threads)
+  static const std::vector<uint32_t> table = [] {
+    std::vector<uint32_t> t(256);
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+    return t;
+  }();
+  uint32_t c = 0xFFFFFFFFu;
+  for (unsigned char ch : data) c = table[(c ^ ch) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 int ptm_snapshot(void* h, const char* path) {
   auto* m = static_cast<Master*>(h);
   std::lock_guard<std::mutex> g(m->mu);
-  FILE* f = fopen(path, "w");
-  if (!f) return -1;
-  fprintf(f, "ptm_snapshot_v2 %d %d\n", m->next_id, m->epoch);
+  std::string body;
+  char line[128];
   auto dump = [&](const char* tag, const Task& t) {
-    fprintf(f, "%s %d %d %zu\n", tag, t.id, t.failures, t.payload.size());
-    fwrite(t.payload.data(), 1, t.payload.size(), f);
-    fputc('\n', f);
+    snprintf(line, sizeof(line), "%s %d %d %zu\n", tag, t.id, t.failures,
+             t.payload.size());
+    body += line;
+    body += t.payload;
+    body += '\n';
   };
   for (auto& t : m->todo) dump("todo", t);
   // pending tasks snapshot as todo: after recovery they must be re-dispatched
   for (auto& kv : m->pending) dump("todo", kv.second);
   for (auto& t : m->done) dump("done", t);
   for (auto& t : m->discarded) dump("disc", t);
-  fclose(f);
+
+  std::string tmp = std::string(path) + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  fprintf(f, "ptm_snapshot_v3 %d %d %zu %u\n", m->next_id, m->epoch,
+          body.size(), crc32_of(body));
+  bool ok = fwrite(body.data(), 1, body.size(), f) == body.size();
+  // fsync before the rename: otherwise a crash can journal the rename while
+  // the data blocks never hit disk, atomically replacing a good snapshot
+  // with garbage
+  ok = (fflush(f) == 0) && ok;
+  ok = (fsync(fileno(f)) == 0) && ok;
+  ok = (fclose(f) == 0) && ok;
+  if (!ok || rename(tmp.c_str(), path) != 0) {
+    remove(tmp.c_str());
+    return -1;
+  }
+  // persist the rename itself
+  std::string dir(path);
+  size_t slash = dir.find_last_of('/');
+  dir = (slash == std::string::npos) ? "." : dir.substr(0, slash);
+  int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    close(dfd);
+  }
   return 0;
 }
 
 int ptm_restore(void* h, const char* path) {
   auto* m = static_cast<Master*>(h);
   std::lock_guard<std::mutex> g(m->mu);
-  FILE* f = fopen(path, "r");
+  FILE* f = fopen(path, "rb");
   if (!f) return -1;
   char header[64];
   int next_id = 0, epoch = 0;
-  if (fscanf(f, "%63s %d %d", header, &next_id, &epoch) != 3 ||
-      strcmp(header, "ptm_snapshot_v2") != 0 || fgetc(f) != '\n') {
+  size_t body_len = 0;
+  unsigned int crc_want = 0;
+  if (fscanf(f, "%63s", header) != 1) {
     fclose(f);
     return -2;
   }
+  if (strcmp(header, "ptm_snapshot_v2") == 0) {
+    // migration path for pre-CRC snapshots (same per-task body format,
+    // no length/CRC in the header)
+    if (fscanf(f, "%d %d", &next_id, &epoch) != 2 || fgetc(f) != '\n') {
+      fclose(f);
+      return -2;
+    }
+    m->todo.clear();
+    m->pending.clear();
+    m->done.clear();
+    m->discarded.clear();
+    m->next_id = next_id;
+    m->epoch = epoch;
+    char tag[8];
+    int id, failures;
+    size_t len;
+    while (fscanf(f, "%7s %d %d %zu", tag, &id, &failures, &len) == 4) {
+      if (fgetc(f) != '\n') { fclose(f); return -3; }
+      Task t;
+      t.id = id;
+      t.failures = failures;
+      t.payload.resize(len);
+      if (len > 0 && fread(&t.payload[0], 1, len, f) != len) {
+        fclose(f);
+        return -3;
+      }
+      if (fgetc(f) != '\n') { fclose(f); return -3; }
+      if (strcmp(tag, "todo") == 0) m->todo.push_back(t);
+      else if (strcmp(tag, "done") == 0) m->done.push_back(t);
+      else m->discarded.push_back(t);
+    }
+    fclose(f);
+    return 0;
+  }
+  if (fscanf(f, "%d %d %zu %u", &next_id, &epoch, &body_len,
+             &crc_want) != 4 ||
+      strcmp(header, "ptm_snapshot_v3") != 0 || fgetc(f) != '\n') {
+    fclose(f);
+    return -2;  // bad header
+  }
+  std::string body(body_len, '\0');
+  if (body_len > 0 && fread(&body[0], 1, body_len, f) != body_len) {
+    fclose(f);
+    return -4;  // truncated
+  }
+  fclose(f);
+  if (crc32_of(body) != crc_want) return -5;  // corruption detected
+
   m->todo.clear();
   m->pending.clear();
   m->done.clear();
   m->discarded.clear();
   m->next_id = next_id;
   m->epoch = epoch;
-  char tag[8];
-  int id, failures;
-  size_t len;
-  while (fscanf(f, "%7s %d %d %zu", tag, &id, &failures, &len) == 4) {
-    if (fgetc(f) != '\n') { fclose(f); return -3; }
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t eol = body.find('\n', pos);
+    if (eol == std::string::npos) return -3;
+    char tag[8];
+    int id, failures;
+    size_t len;
+    if (sscanf(body.substr(pos, eol - pos).c_str(), "%7s %d %d %zu", tag, &id,
+               &failures, &len) != 4)
+      return -3;
+    pos = eol + 1;
+    if (pos + len >= body.size() || body[pos + len] != '\n') return -3;
     Task t;
     t.id = id;
     t.failures = failures;
-    t.payload.resize(len);
-    if (len > 0 && fread(&t.payload[0], 1, len, f) != len) {
-      fclose(f);
-      return -3;
-    }
-    if (fgetc(f) != '\n') { fclose(f); return -3; }
+    t.payload = body.substr(pos, len);
+    pos += len + 1;
     if (strcmp(tag, "todo") == 0) m->todo.push_back(t);
     else if (strcmp(tag, "done") == 0) m->done.push_back(t);
     else m->discarded.push_back(t);
   }
-  fclose(f);
   return 0;
 }
 

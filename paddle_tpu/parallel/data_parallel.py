@@ -36,16 +36,22 @@ class DataParallel:
 
     def __init__(self, loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
                  axis: str = "data", param_rules: Optional[ShardingRules] = None,
-                 donate: bool = True):
+                 donate: bool = True, aux_fn: Optional[Callable] = None):
         self.loss_fn = loss_fn
         self.opt = optimizer
         self.mesh = mesh if mesh is not None else make_mesh(data=-1)
         self.axis = axis
         self.rules = param_rules
+        self.aux_fn = aux_fn
 
         def _step(params, opt_state, *batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            # aux (eval outputs) computed INSIDE the same jitted step so XLA
+            # shares the forward pass — no second per-batch dispatch
+            aux = aux_fn(params, *batch) if aux_fn is not None else None
             new_params, new_state = self.opt.update(grads, opt_state, params)
+            if aux_fn is not None:
+                return new_params, new_state, loss, aux
             return new_params, new_state, loss
 
         donate_args = (0, 1) if donate else ()
@@ -186,14 +192,14 @@ class Zero1DataParallel:
             for b in batch)
 
         def local_step(flat_shard, opt_state, stats, *batch):
-            full = jax.lax.all_gather(flat_shard, axis, tiled=True)
+            from . import collectives as cc
+            full = cc.all_gather(flat_shard, axis)
             params = self._unflatten(full, stats)
             loss, grads = jax.value_and_grad(self.loss_fn)(params, *batch)
             gflat = self._flatten(self._train_leaves(grads))
             # mean over the data axis, scattered so each device only keeps
             # (and updates) its own 1/n shard
-            g_shard = jax.lax.psum_scatter(gflat, axis, scatter_dimension=0,
-                                           tiled=True) / n
+            g_shard = cc.reduce_scatter(gflat, axis) / n
             new_p, new_state = self.opt.update({"flat": g_shard}, opt_state,
                                                {"flat": flat_shard})
             return new_p["flat"], new_state, jax.lax.pmean(loss, axis)

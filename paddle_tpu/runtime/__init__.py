@@ -16,6 +16,8 @@ from .master import TaskMaster
 from .recordio import RecordReader, RecordWriter
 from .arena import HostArena
 from .optimizer import HostOptimizer
+from .lease import FileLease, LeaseKeeper
 
 __all__ = ["load_library", "native_available", "TaskMaster",
+           "FileLease", "LeaseKeeper",
            "RecordReader", "RecordWriter", "HostArena", "HostOptimizer"]

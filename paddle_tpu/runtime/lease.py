@@ -1,0 +1,170 @@
+"""Lease/lock primitives for master election — the etcd analog.
+
+The reference elects and registers its masters/pservers through etcd leases
+and locks (go/master/etcd_client.go: concurrency.NewSession + lock under
+a TTL lease; go/pserver/etcd_client.go slot registration). A TPU pod has no
+etcd, but every host mounts shared storage; :class:`FileLease` provides the
+same primitive there: a lock file holding ``owner expires_at``, acquirable
+when absent/expired, renewed by its holder, atomically replaced via
+write-temp-then-rename. A standby master blocks on the lease and takes over
+(restoring the CRC-checked snapshot) when the active master dies — removing
+the single-point-of-failure the round-1 review flagged.
+
+Contention protocol: writers re-read after renaming and only believe they
+hold the lease if the file names them (last-writer-wins + confirm), which is
+safe on POSIX rename atomicity for the single-shared-filesystem deployment.
+For cross-datacenter placement, point the path at a fencing-capable store.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Optional, Tuple
+
+
+class FileLease:
+    """A TTL lease on shared storage (etcd lease/lock stand-in)."""
+
+    def __init__(self, path: str, owner: Optional[str] = None,
+                 ttl: float = 10.0):
+        self.path = path
+        self.owner = owner or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.ttl = ttl
+
+    # -- inspection ---------------------------------------------------------
+    def holder(self) -> Optional[Tuple[str, float]]:
+        """(owner, expires_at) of the current lease file, None if absent/bad."""
+        return self._read(self.path)
+
+    def held_by_me(self, now: Optional[float] = None) -> bool:
+        h = self.holder()
+        now = time.time() if now is None else now
+        return h is not None and h[0] == self.owner and h[1] > now
+
+    # -- acquisition --------------------------------------------------------
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        """Take the lease if it is free, expired, or already ours.
+
+        Mutual exclusion among contenders: a FREE lease is taken by O_EXCL
+        creation (exactly one creator wins); an EXPIRED lease is first
+        *claimed* by renaming it to a contender-unique path (exactly one
+        rename succeeds — the loser gets ENOENT), verified expired, then
+        replaced via O_EXCL. Residual race vs a live holder's renewal is
+        bounded by the renewal cadence (ttl/3 ≪ ttl); true fencing needs a
+        coordination service (see module docstring).
+        """
+        now = time.time() if now is None else now
+        h = self.holder()
+        if h is not None:
+            if h[0] == self.owner:
+                self._write(now)             # refresh our own lease
+                return self.held_by_me(now)
+            if h[1] > now:
+                return False                 # live foreign lease
+            # expired foreign lease: claim it by rename — only ONE contender
+            # can win this rename; everyone else fails with ENOENT
+            claim = f"{self.path}.claim.{self.owner}"
+            try:
+                os.rename(self.path, claim)
+            except OSError:
+                return False
+            claimed = self._read(claim)
+            if claimed is not None and claimed[1] > now and \
+                    claimed[0] != self.owner:
+                # it was renewed between our read and our claim: give it back
+                try:
+                    os.rename(claim, self.path)
+                except OSError:
+                    os.remove(claim)
+                return False
+            os.remove(claim)
+        return self._create_excl(now)
+
+    def renew(self, now: Optional[float] = None) -> bool:
+        """Extend our lease; False (lease LOST) if someone else took it."""
+        now = time.time() if now is None else now
+        h = self.holder()
+        if h is None or h[0] != self.owner:
+            return False
+        self._write(now)
+        return self.held_by_me(now)
+
+    def release(self):
+        if self.held_by_me():
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+    def wait_acquire(self, poll: float = 0.5,
+                     timeout: Optional[float] = None) -> bool:
+        """Block until the lease is ours (standby-master loop)."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            if self.try_acquire():
+                return True
+            if deadline is not None and time.time() > deadline:
+                return False
+            time.sleep(poll)
+
+    def _read(self, path: str) -> Optional[Tuple[str, float]]:
+        try:
+            with open(path) as f:
+                owner, expires = f.read().split()
+                return owner, float(expires)
+        except (OSError, ValueError):
+            return None
+
+    def _create_excl(self, now: float) -> bool:
+        try:
+            fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+        except FileExistsError:
+            return self.held_by_me(now)      # maybe we lost to a peer
+        with os.fdopen(fd, "w") as f:
+            f.write(f"{self.owner} {now + self.ttl}")
+        return True
+
+    def _write(self, now: float):
+        tmp = f"{self.path}.{self.owner}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{self.owner} {now + self.ttl}")
+        os.replace(tmp, self.path)
+
+
+class LeaseKeeper:
+    """Background renewal thread; fires ``on_lost`` if the lease slips away
+    (the etcd session-expired event)."""
+
+    def __init__(self, lease: FileLease, interval: Optional[float] = None,
+                 on_lost=None):
+        self.lease = lease
+        self.interval = interval if interval is not None else lease.ttl / 3
+        self.on_lost = on_lost
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            if not self.lease.renew():
+                if self.on_lost is not None:
+                    self.on_lost()
+                return
+
+    def stop(self, release: bool = True):
+        self._stop.set()
+        # on_lost callbacks run ON the keeper thread and may call stop();
+        # joining ourselves would raise RuntimeError
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)
+        if release:
+            self.lease.release()

@@ -57,12 +57,18 @@ def _recv_exact(sock, n) -> Optional[bytes]:
 
 
 class MasterServer:
-    """Serve a TaskMaster over TCP with timeout housekeeping + snapshots."""
+    """Serve a TaskMaster over TCP with timeout housekeeping + snapshots.
+
+    Pass a :class:`~paddle_tpu.runtime.lease.FileLease` to run under master
+    election: the server renews the lease while alive and shuts itself down
+    if the lease is lost (split-brain guard) — the etcd-session semantics of
+    go/master/etcd_client.go.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  timeout_s: float = 60.0, failure_max: int = 3,
                  snapshot_path: Optional[str] = None,
-                 tick_interval: float = 1.0):
+                 tick_interval: float = 1.0, lease=None):
         self.master = TaskMaster(timeout_s=timeout_s, failure_max=failure_max)
         if snapshot_path:
             try:
@@ -71,11 +77,22 @@ class MasterServer:
                 pass  # no snapshot yet
         self.snapshot_path = snapshot_path
         self._tick_interval = tick_interval
+        self.lease = lease
+        self._keeper = None
+        self.lease_lost = threading.Event()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with outer._conn_lock:
+                    outer._conns.add(self.request)
+
+            def finish(self):
+                with outer._conn_lock:
+                    outer._conns.discard(self.request)
+
             def handle(self):
-                while True:
+                while not outer._stop.is_set():
                     req = _recv_msg(self.request)
                     if req is None:
                         return
@@ -85,6 +102,8 @@ class MasterServer:
             allow_reuse_address = True
             daemon_threads = True
 
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
         self._server = Server((host, port), Handler)
         self.address: Tuple[str, int] = self._server.server_address
         self._threads: List[threading.Thread] = []
@@ -92,6 +111,13 @@ class MasterServer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
+        if self.lease is not None:
+            from .lease import LeaseKeeper
+            if not self.lease.held_by_me() and not self.lease.try_acquire():
+                raise RuntimeError(
+                    f"lease {self.lease.path} held by {self.lease.holder()}")
+            self._keeper = LeaseKeeper(self.lease, on_lost=self._on_lease_lost)
+            self._keeper.start()
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
         t.start()
         h = threading.Thread(target=self._housekeeping, daemon=True)
@@ -99,10 +125,33 @@ class MasterServer:
         self._threads = [t, h]
         return self
 
-    def stop(self):
+    def _on_lease_lost(self):
+        # another master was elected: stop serving immediately (split-brain
+        # guard); task state survives in the CRC-checked snapshot
+        self.lease_lost.set()
+        self.stop(release_lease=False)
+
+    def stop(self, release_lease: bool = True):
         self._stop.set()
+        if self._keeper is not None:
+            self._keeper.stop(release=release_lease)
+            self._keeper = None
         self._server.shutdown()
         self._server.server_close()
+        # shutdown() only stops the accept loop; live handler threads would
+        # keep answering connected clients from this (now deposed) master's
+        # state — the split-brain the lease exists to prevent. Sever them.
+        with self._conn_lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def _housekeeping(self):
         while not self._stop.wait(self._tick_interval):
@@ -141,20 +190,44 @@ class MasterServer:
 
 
 class MasterClient:
-    """Auto-reconnecting client (go/connection/conn.go semantics)."""
+    """Auto-reconnecting client (go/connection/conn.go semantics).
 
-    def __init__(self, host: str, port: int, *, retries: int = 5,
-                 retry_delay: float = 0.2):
-        self.addr = (host, port)
+    Accepts either one address or a failover list of candidate master
+    endpoints (active + standbys); reconnection rotates through them, so a
+    master failover is transparent to the trainer — the role etcd master
+    discovery plays for go/master/client.go.
+    """
+
+    def __init__(self, host=None, port: Optional[int] = None, *,
+                 endpoints: Optional[List[Tuple[str, int]]] = None,
+                 retries: int = 5, retry_delay: float = 0.2):
+        if endpoints is None:
+            if host is None or port is None:
+                raise ValueError("pass (host, port) or endpoints=[...]")
+            endpoints = [(host, port)]
+        self.endpoints = list(endpoints)
+        self._ep_idx = 0
         self.retries = retries
         self.retry_delay = retry_delay
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return self.endpoints[self._ep_idx]
+
     def _connect(self):
-        s = socket.create_connection(self.addr, timeout=10.0)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)  # LightNetwork
-        self._sock = s
+        last = None
+        for _ in range(len(self.endpoints)):
+            try:
+                s = socket.create_connection(self.addr, timeout=10.0)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)  # LightNetwork
+                self._sock = s
+                return
+            except OSError as e:
+                last = e
+                self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
+        raise ConnectionError(f"no master endpoint reachable: {last}")
 
     def _call(self, req):
         with self._lock:

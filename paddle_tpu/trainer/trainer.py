@@ -43,7 +43,11 @@ class Trainer:
       optimizer: paddle_tpu optimizer.
       mesh: optional jax Mesh -> SPMD data-parallel step over its 'data' axis.
       outputs_fn: optional (params, *batch) -> dict of device metrics handed to
-        evaluators (e.g. {'logits':..., 'labels':...}).
+        evaluators (e.g. {'logits':..., 'labels':...}). Evaluated INSIDE the
+        fused train step on the PRE-update parameters — the reference's
+        semantics (TrainerInternal.cpp:144-148 evaluates the training
+        forward's outputs, which precede the update) and one forward cheaper
+        than a separate post-update pass.
       evaluators: EvaluatorGroup or list of Evaluators.
       output_dir: if set, save pass-%05d checkpoints (ParamUtil semantics).
     """
@@ -51,7 +55,8 @@ class Trainer:
     def __init__(self, loss_fn: Callable, optimizer, *, mesh=None,
                  outputs_fn: Optional[Callable] = None,
                  evaluators=None, output_dir: Optional[str] = None,
-                 prefetch: int = 2, log_period: int = 0):
+                 prefetch: int = 2, log_period: int = 0,
+                 nan_guard: bool = True):
         self.loss_fn = loss_fn
         self.opt = optimizer
         self.outputs_fn = jax.jit(outputs_fn) if outputs_fn is not None else None
@@ -64,17 +69,24 @@ class Trainer:
         self.output_dir = output_dir
         self.prefetch = prefetch
         self.log_period = log_period
+        self.nan_guard = nan_guard
         self.stats = StatSet()
         self.mesh = mesh
         if mesh is not None:
-            self._dp = DataParallel(loss_fn, optimizer, mesh=mesh)
+            self._dp = DataParallel(loss_fn, optimizer, mesh=mesh,
+                                    aux_fn=outputs_fn)
             self._step = None
         else:
             self._dp = None
 
             def _step(params, opt_state, *batch):
                 loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+                # eval outputs computed inside the SAME jitted step (XLA
+                # shares the forward) — no second per-batch forward dispatch
+                outs = outputs_fn(params, *batch) if outputs_fn else None
                 params, opt_state = optimizer.update(grads, opt_state, params)
+                if outputs_fn is not None:
+                    return params, opt_state, loss, outs
                 return params, opt_state, loss
 
             self._step = jax.jit(_step, donate_argnums=(0, 1))
@@ -115,18 +127,28 @@ class Trainer:
                 with self.stats.timer("TrainBatch"):
                     if self._dp is not None:
                         batch = self._dp.shard_batch(batch)
-                        params, opt_state, cost = self._dp.step(params, opt_state,
-                                                                *batch)
+                        res = self._dp.step(params, opt_state, *batch)
                     else:
-                        params, opt_state, cost = self._step(params, opt_state,
-                                                             *batch)
-                ev_result = None
+                        res = self._step(params, opt_state, *batch)
                 if self.outputs_fn is not None:
-                    with self.stats.timer("Eval"):
-                        outs = self.outputs_fn(params, *batch)
-                        self.evaluators.update(cost=float(cost), **outs)
-                        ev_result = self.evaluators.result()
+                    params, opt_state, cost, outs = res
+                else:
+                    params, opt_state, cost = res
+                    outs = None
                 cost_f = float(cost)
+                if self.nan_guard and not np.isfinite(cost_f):
+                    # the feenableexcept(FE_INVALID|DIVBYZERO|OVERFLOW) analog
+                    # (TrainerMain.cpp:49): fail fast, don't train on garbage
+                    raise FloatingPointError(
+                        f"non-finite loss {cost_f} at pass {pass_id} batch "
+                        f"{batch_id}; re-run with "
+                        f"jax.config.update('jax_debug_nans', True) to locate "
+                        f"the producing op")
+                ev_result = None
+                if outs is not None:
+                    with self.stats.timer("Eval"):
+                        self.evaluators.update(cost=cost_f, **outs)
+                        ev_result = self.evaluators.result()
                 if self.log_period and (batch_id + 1) % self.log_period == 0:
                     log.info("pass %d batch %d cost %.6f", pass_id, batch_id, cost_f)
                 event_handler(EV.EndIteration(pass_id, batch_id, cost_f,
@@ -235,14 +257,14 @@ class Trainer:
                 else lambda p, s, *b: self._dp.step(p, s, *b))
         i = 0
         for _ in range(warmup):
-            params, opt_state, loss = step(params, opt_state,
-                                           *batches[i % len(batches)])
+            res = step(params, opt_state, *batches[i % len(batches)])
+            params, opt_state, loss = res[0], res[1], res[2]
             i += 1
         jax.block_until_ready(loss)
         t0 = time.perf_counter()
         for _ in range(iters):
-            params, opt_state, loss = step(params, opt_state,
-                                           *batches[i % len(batches)])
+            res = step(params, opt_state, *batches[i % len(batches)])
+            params, opt_state, loss = res[0], res[1], res[2]
             i += 1
         jax.block_until_ready(loss)
         ms = (time.perf_counter() - t0) / iters * 1e3

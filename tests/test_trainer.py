@@ -193,3 +193,39 @@ def test_tar_preserves_empty_containers_and_tuples():
     buf.seek(0)
     back = from_tar(buf)
     assert isinstance(back["pair"], tuple) and back["empty"] == []
+
+
+def test_nan_guard_raises():
+    """Non-finite loss must abort the pass loop — the feenableexcept
+    (TrainerMain.cpp:49) analog."""
+    model = _MLP()
+
+    def bad_loss(params, x, y):
+        return _loss(model)(params, x, y) / 0.0   # inf/nan every batch
+
+    trainer = Trainer(bad_loss, SGD(0.1))
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        trainer.train(_reader(), params, num_passes=1,
+                      feeder=lambda rows: _feeder.feed(rows))
+
+
+def test_eval_outputs_fused_into_step():
+    """Evaluator outputs must come from the SAME jitted step (no second
+    forward dispatch) — the round-1 double-forward fix."""
+    model = _MLP()
+    calls = {"n": 0}
+    base_outputs = _outputs(model)
+
+    def counting_outputs(params, x, y):
+        calls["n"] += 1          # traced once per jit compile, not per batch
+        return base_outputs(params, x, y)
+
+    trainer = Trainer(_loss(model), SGD(0.1), outputs_fn=counting_outputs,
+                      evaluators=[ClassificationErrorEvaluator()])
+    params = model.init(jax.random.PRNGKey(0))
+    trainer.train(_reader(), params, num_passes=1,
+                  feeder=lambda rows: _feeder.feed(rows))
+    # traced by the fused train step -> at most a couple of traces (train step
+    # compile + optional standalone uses), NOT once per batch
+    assert calls["n"] <= 2, f"outputs_fn traced {calls['n']} times"
