@@ -259,18 +259,15 @@ int ptm_restore(void* h, const char* path) {
     return -2;
   }
   if (strcmp(header, "ptm_snapshot_v2") == 0) {
-    // migration path for pre-CRC snapshots (same per-task body format,
-    // no length/CRC in the header)
+    // migration path for pre-CRC snapshots (same per-task body format, no
+    // length/CRC in the header). Parsed into temporaries and committed only
+    // on FULL success — a truncated file must not leave half-restored state.
     if (fscanf(f, "%d %d", &next_id, &epoch) != 2 || fgetc(f) != '\n') {
       fclose(f);
       return -2;
     }
-    m->todo.clear();
-    m->pending.clear();
-    m->done.clear();
-    m->discarded.clear();
-    m->next_id = next_id;
-    m->epoch = epoch;
+    std::deque<Task> todo;
+    std::vector<Task> done, discarded;
     char tag[8];
     int id, failures;
     size_t len;
@@ -285,11 +282,17 @@ int ptm_restore(void* h, const char* path) {
         return -3;
       }
       if (fgetc(f) != '\n') { fclose(f); return -3; }
-      if (strcmp(tag, "todo") == 0) m->todo.push_back(t);
-      else if (strcmp(tag, "done") == 0) m->done.push_back(t);
-      else m->discarded.push_back(t);
+      if (strcmp(tag, "todo") == 0) todo.push_back(t);
+      else if (strcmp(tag, "done") == 0) done.push_back(t);
+      else discarded.push_back(t);
     }
     fclose(f);
+    m->todo = std::move(todo);
+    m->pending.clear();
+    m->done = std::move(done);
+    m->discarded = std::move(discarded);
+    m->next_id = next_id;
+    m->epoch = epoch;
     return 0;
   }
   if (fscanf(f, "%d %d %zu %u", &next_id, &epoch, &body_len,
@@ -297,6 +300,17 @@ int ptm_restore(void* h, const char* path) {
       strcmp(header, "ptm_snapshot_v3") != 0 || fgetc(f) != '\n') {
     fclose(f);
     return -2;  // bad header
+  }
+  // the header is outside the CRC: sanity-bound body_len by the file size so
+  // a corrupted length digit can't drive a huge allocation
+  long data_start = ftell(f);
+  fseek(f, 0, SEEK_END);
+  long file_end = ftell(f);
+  fseek(f, data_start, SEEK_SET);
+  if (data_start < 0 || file_end < data_start ||
+      body_len > (size_t)(file_end - data_start)) {
+    fclose(f);
+    return -4;  // truncated / corrupt length
   }
   std::string body(body_len, '\0');
   if (body_len > 0 && fread(&body[0], 1, body_len, f) != body_len) {

@@ -17,7 +17,7 @@ from .framework import (Block, Operator, Program, Variable,
                         program_guard, reset_default_programs)
 from .layers import Cond, StaticRNN, While
 from .optimizer import (AdadeltaOptimizer, AdagradOptimizer, AdamaxOptimizer,
-                        AdamOptimizer, DecayedAdagradOptimizer,
+                        AdamOptimizer, DecayedAdagradOptimizer, FtrlOptimizer,
                         MomentumOptimizer, RMSPropOptimizer, SGDOptimizer)
 from .registry import OpRegistry
 from .regularizer import L1Decay, L2Decay, append_regularization_ops
@@ -30,6 +30,6 @@ __all__ = ["layers", "backward", "io", "optimizer", "registry", "executor",
            "reset_default_programs", "While", "Cond", "StaticRNN",
            "SGDOptimizer", "MomentumOptimizer", "AdamOptimizer",
            "AdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
-           "AdamaxOptimizer", "DecayedAdagradOptimizer",
+           "AdamaxOptimizer", "DecayedAdagradOptimizer", "FtrlOptimizer",
            "L1Decay", "L2Decay", "append_regularization_ops",
            "AccuracyEvaluator", "ChunkEvaluator", "OpRegistry"]

@@ -214,3 +214,23 @@ class DecayedAdagradOptimizer(Optimizer):
              "LearningRate": [lr.name]},
             {"ParamOut": [param.name], "MomentOut": [m.name]},
             {"decay": self.decay, "epsilon": self.epsilon})
+
+
+class FtrlOptimizer(Optimizer):
+    """FTRL-proximal (ref: operators/ftrl_op.cc) — the CTR-model staple."""
+
+    def __init__(self, learning_rate=0.01, l1: float = 0.0, l2: float = 0.0):
+        super().__init__(learning_rate)
+        self.l1, self.l2 = l1, l2
+
+    def _append_update(self, program, param, grad, lr):
+        sq = self._accumulator(program, param, "squared_accum")
+        lin = self._accumulator(program, param, "linear_accum")
+        program.global_block().append_op(
+            "ftrl",
+            {"Param": [param.name], "Grad": [grad.name],
+             "SquaredAccumulator": [sq.name], "LinearAccumulator": [lin.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [param.name], "SquaredAccumOut": [sq.name],
+             "LinearAccumOut": [lin.name]},
+            {"l1": self.l1, "l2": self.l2})

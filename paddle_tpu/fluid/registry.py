@@ -556,7 +556,13 @@ def _pad(ins, attrs):
 @OpRegistry.register("crop")
 def _crop(ins, attrs):
     from ..ops.math import crop
-    return {"Out": [crop(_x(ins), attrs["offsets"], attrs["shape"])]}
+    x = _x(ins)
+    # non-positive shape entries mean "to the end" (resolved at trace time —
+    # lets builders crop feature dims without knowing the batch size)
+    shape = [x.shape[i] - o if s <= 0 else s
+             for i, (o, s) in enumerate(zip(attrs["offsets"],
+                                            attrs["shape"]))]
+    return {"Out": [crop(x, attrs["offsets"], shape)]}
 
 
 @OpRegistry.register("gather")
@@ -808,8 +814,13 @@ def _margin_rank(ins, attrs):
 @OpRegistry.register("sequence_expand")
 def _seq_expand(ins, attrs):
     from ..ops.sequence import sequence_expand
-    return {"Out": [sequence_expand(_x(ins), ins["RefLengths"][0],
-                                    attrs["max_len"])]}
+    # max_len statically from the reference sequence when provided (the
+    # v2 expand_layer path), else from the attr
+    if "Ref" in ins:
+        max_len = ins["Ref"][0].shape[1]
+    else:
+        max_len = attrs["max_len"]
+    return {"Out": [sequence_expand(_x(ins), ins["RefLengths"][0], max_len)]}
 
 
 @OpRegistry.register("sequence_softmax")
@@ -914,11 +925,16 @@ def _nce(ins, attrs):
 
 @OpRegistry.register("hierarchical_sigmoid")
 def _hsig(ins, attrs):
-    from ..ops.nce import hsigmoid_loss
+    from ..ops.nce import build_huffman_codes, hsigmoid_loss
+    if "Paths" in ins:
+        paths, codes = ins["Paths"][0], ins["Codes"][0]
+    else:
+        # static tree from the num_classes attr (constant-folded at trace)
+        paths, codes = build_huffman_codes(attrs["num_classes"])
     return {"Cost": [hsigmoid_loss(
         ins["Input"][0], ins["Label"][0], ins["InnerW"][0],
         ins["InnerB"][0] if "InnerB" in ins else None,
-        ins["Paths"][0], ins["Codes"][0])]}
+        paths, codes)]}
 
 
 # ----------------------------------------------------------------- metrics ---
@@ -1119,6 +1135,26 @@ def _prox_adagrad(ins, attrs):
     return {"ParamOut": [p_new], "MomentOut": [m_new]}
 
 
+@OpRegistry.register("ftrl")
+def _ftrl(ins, attrs):
+    """FTRL-proximal (ref: operators/ftrl_op.cc; lr_power fixed at -0.5)."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = ins["LearningRate"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    sq_new = sq + g * g
+    sigma = (jnp.sqrt(sq_new) - jnp.sqrt(sq)) / lr
+    lin_new = lin + g - sigma * p
+    quad = jnp.sqrt(sq_new) / lr + 2.0 * l2
+    p_new = jnp.where(
+        jnp.abs(lin_new) > l1,
+        (jnp.sign(lin_new) * l1 - lin_new) / quad,
+        jnp.zeros_like(p))
+    return {"ParamOut": [p_new], "SquaredAccumOut": [sq_new],
+            "LinearAccumOut": [lin_new]}
+
+
 @OpRegistry.register("squeeze")
 def _squeeze(ins, attrs):
     return {"Out": [jnp.squeeze(_x(ins), axis=attrs.get("axis"))]}
@@ -1157,3 +1193,145 @@ def _nested_lstm(ins, attrs):
                            ins["B"][0] if "B" in ins else None,
                            reverse=attrs.get("reverse", False))
     return {"Out": [out], "LastH": [last.data]}
+
+
+# ---------------------------------------------------------------------------
+# gen-1 layer-zoo completions (small ops backing the v2 *_layer DSL surface;
+# each cites the gserver layer it re-provides)
+# ---------------------------------------------------------------------------
+
+@OpRegistry.register("argmax")
+def _argmax(ins, attrs):
+    """MaxIdLayer (gserver/layers/MaxIdLayer.cpp)."""
+    return {"Out": [jnp.argmax(_x(ins), axis=attrs.get("axis", -1))
+                    .astype(jnp.int32)]}
+
+
+@OpRegistry.register("power")
+def _power(ins, attrs):
+    """PowerLayer (gserver/layers/PowerLayer.cpp): y = x^w, w a learned
+    scalar; sign-preserving for negative activations."""
+    x, w = _x(ins), ins["W"][0]
+    return {"Out": [jnp.sign(x) * jnp.power(jnp.abs(x) + 1e-12,
+                                            jnp.reshape(w, ()))]}
+
+
+@OpRegistry.register("slope_intercept")
+def _slope_intercept(ins, attrs):
+    """SlopeInterceptLayer: y = slope * x + intercept (static attrs)."""
+    return {"Out": [attrs.get("slope", 1.0) * _x(ins)
+                    + attrs.get("intercept", 0.0)]}
+
+
+@OpRegistry.register("sum_to_one_norm")
+def _sum_to_one_norm(ins, attrs):
+    """SumToOneNormLayer: rows normalised to sum 1."""
+    x = _x(ins)
+    s = jnp.sum(x, axis=-1, keepdims=True)
+    return {"Out": [x / jnp.where(jnp.abs(s) < 1e-12, 1.0, s)]}
+
+
+@OpRegistry.register("linear_comb")
+def _linear_comb(ins, attrs):
+    """LinearCombinationLayer (convex_comb): weights [B, M] over M vectors
+    [B, M*D] -> [B, D]."""
+    w, x = ins["W"][0], _x(ins)
+    B = x.shape[0]
+    M = w.shape[-1]
+    D = x.shape[-1] // M
+    return {"Out": [jnp.einsum("bm,bmd->bd", w, x.reshape(B, M, D))]}
+
+
+@OpRegistry.register("repeat")
+def _repeat(ins, attrs):
+    """FeatureMapExpandLayer / repeat_layer: tile features n times."""
+    return {"Out": [jnp.repeat(_x(ins), attrs["times"], axis=attrs.get(
+        "axis", -1))]}
+
+
+@OpRegistry.register("rotate")
+def _rotate(ins, attrs):
+    """RotateLayer: 90-degree CCW rotation of [B, H, W, C] maps."""
+    return {"Out": [jnp.rot90(_x(ins), k=1, axes=(1, 2))]}
+
+
+@OpRegistry.register("seq_reshape")
+def _seq_reshape(ins, attrs):
+    """SequenceReshapeLayer: [B, T, D] -> [B, T*D//new_dim, new_dim]."""
+    x = _x(ins)
+    d = attrs["new_dim"]
+    B = x.shape[0]
+    return {"Out": [x.reshape(B, -1, d)]}
+
+
+@OpRegistry.register("sampling_id")
+def _sampling_id(ins, attrs):
+    """SamplingIdLayer: sample class ids from row distributions via the
+    Gumbel trick (on-device, reproducible by seed attr)."""
+    x = _x(ins)
+    key = jax.random.PRNGKey(attrs.get("seed", 0))
+    g = jax.random.gumbel(key, x.shape, x.dtype)
+    logp = jnp.log(jnp.clip(x, 1e-20, None)) if attrs.get(
+        "input_is_prob", True) else x
+    return {"Out": [jnp.argmax(logp + g, axis=-1).astype(jnp.int32)]}
+
+
+@OpRegistry.register("cross_entropy_over_selfnorm")
+def _ce_selfnorm(ins, attrs):
+    """CostLayer.cpp CrossEntropyOverSelfNorm: CE on unnormalised logits plus
+    alpha * log(Z)^2 pulling the partition toward 1 (self-normalised
+    softmax for fast inference)."""
+    logits, label = _x(ins), ins["Label"][0]
+    alpha = attrs.get("softmax_selfnorm_alpha", 0.1)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    logp = logits - logz[..., None]
+    nll = -jnp.take_along_axis(logp, label[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    return {"Out": [nll + alpha * logz * logz]}
+
+
+@OpRegistry.register("huber_classification")
+def _huber_cls(ins, attrs):
+    """CostLayer.cpp HuberTwoClassification: robust binary loss on {-1,+1}
+    labels."""
+    from ..ops import loss as L
+    return {"Out": [L.huber_classification(_x(ins), ins["Label"][0])]}
+
+
+@OpRegistry.register("lambda_cost")
+def _lambda_cost(ins, attrs):
+    """LambdaCost (gserver/layers/CostLayer.cpp LambdaCost): listwise
+    LambdaRank — pairwise logistic losses weighted by |delta NDCG|.
+
+    Score [B, T], Label (relevance) [B, T], Lengths [B].
+    """
+    s, rel = _x(ins).astype(jnp.float32), ins["Label"][0].astype(jnp.float32)
+    lens = ins["Lengths"][0]
+    B, T = s.shape
+    pos = jnp.arange(T)
+    valid = pos[None, :] < lens[:, None]                       # [B, T]
+    neg_inf = jnp.float32(-1e30)
+    s_m = jnp.where(valid, s, neg_inf)
+    # rank of each item under the CURRENT scores (0-based, stable)
+    order = jnp.argsort(-s_m, axis=-1)
+    ranks = jnp.zeros((B, T), jnp.float32)
+    ranks = jax.vmap(lambda r, o: r.at[o].set(jnp.arange(T, dtype=jnp.float32))
+                     )(ranks, order)
+    gain = (jnp.exp2(rel) - 1.0) * valid                       # [B, T]
+    disc = 1.0 / jnp.log2(ranks + 2.0)
+    # ideal DCG for normalisation
+    rel_sorted = -jnp.sort(-jnp.where(valid, rel, 0.0), axis=-1)
+    ideal = jnp.sum((jnp.exp2(rel_sorted) - 1.0)
+                    / jnp.log2(jnp.arange(T, dtype=jnp.float32) + 2.0),
+                    axis=-1, keepdims=True)
+    ideal = jnp.where(ideal <= 0, 1.0, ideal)
+    # |delta NDCG| of swapping i and j
+    dg = gain[:, :, None] - gain[:, None, :]                   # [B, T, T]
+    dd = disc[:, :, None] - disc[:, None, :]
+    dndcg = jnp.abs(dg * dd) / ideal[:, :, None]
+    higher = (rel[:, :, None] > rel[:, None, :]) \
+        & valid[:, :, None] & valid[:, None, :]
+    sdiff = s[:, :, None] - s[:, None, :]
+    pair_loss = jnp.log1p(jnp.exp(-jnp.clip(sdiff, -30, 30)))
+    per_row = jnp.sum(jnp.where(higher, dndcg * pair_loss, 0.0), axis=(1, 2))
+    return {"Out": [per_row]}

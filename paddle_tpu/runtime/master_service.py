@@ -71,10 +71,11 @@ class MasterServer:
                  tick_interval: float = 1.0, lease=None):
         self.master = TaskMaster(timeout_s=timeout_s, failure_max=failure_max)
         if snapshot_path:
-            try:
+            import os
+            if os.path.exists(snapshot_path):
+                # corruption (CRC/parse failure) must surface loudly — only a
+                # genuinely absent snapshot means "fresh start"
                 self.master.restore(snapshot_path)
-            except IOError:
-                pass  # no snapshot yet
         self.snapshot_path = snapshot_path
         self._tick_interval = tick_interval
         self.lease = lease
@@ -114,6 +115,7 @@ class MasterServer:
         if self.lease is not None:
             from .lease import LeaseKeeper
             if not self.lease.held_by_me() and not self.lease.try_acquire():
+                self._server.server_close()   # don't leak the bound socket
                 raise RuntimeError(
                     f"lease {self.lease.path} held by {self.lease.holder()}")
             self._keeper = LeaseKeeper(self.lease, on_lost=self._on_lease_lost)

@@ -475,3 +475,682 @@ def nested_lstmemory(input: LayerOutput, size: int,
                 {"Out": [out.name], "LastH": [last.name]},
                 {"reverse": reverse})
     return LayerOutput(last, input.lengths)
+
+
+# =============================================================================
+# Gen-1 layer-zoo breadth (trainer_config_helpers/layers.py — the 106
+# *_layer surface). Each function cites the gserver layer / CostLayer.cpp
+# entry it re-provides; all lower onto registered fluid ops.
+# =============================================================================
+
+def _emit(op_type, ins, attrs=None, out_shape=None, out_dtype="float32",
+          n_out=1, out_slot="Out"):
+    """Append one registered op; returns its output Variable(s)."""
+    b = default_main_program().current_block()
+    outs = [b.create_var(shape=out_shape or (-1,), dtype=out_dtype)
+            for _ in range(n_out)]
+    b.append_op(op_type, ins, {out_slot: [o.name for o in outs]}, attrs or {})
+    return outs[0] if n_out == 1 else outs
+
+
+def _shape(l: LayerOutput):
+    return tuple(l.var.shape)
+
+
+# ------------------------------------------------------------ mixed / proj ---
+# Projections return (emit_fn, out_size); mixed_layer sums their outputs
+# (gserver Mixed layer + Projection.h: FullMatrix/Table/Context/DotMul/
+# Scaling/Identity/Slice projections, DotMulOperator).
+
+class _Projection:
+    def __init__(self, emit, size):
+        self.emit = emit        # () -> Variable with last dim == size
+        self.size = size
+
+
+def full_matrix_projection(input: LayerOutput, size: int) -> _Projection:
+    """FullMatrixProjection: x W."""
+    in_dim = _shape(input)[-1]
+    def emit():
+        w = FL._create_parameter("proj_w", (in_dim, size), "float32",
+                                 I.xavier())
+        return _emit("mul", {"X": [input.var.name], "Y": [w.name]},
+                     {"x_num_col_dims": len(_shape(input)) - 1},
+                     out_shape=_shape(input)[:-1] + (size,))
+    return _Projection(emit, size)
+
+
+def trans_full_matrix_projection(input: LayerOutput, size: int) -> _Projection:
+    """TransposedFullMatrixProjection: x Wᵀ (weight stored [size, in])."""
+    in_dim = _shape(input)[-1]
+    def emit():
+        w = FL._create_parameter("tproj_w", (size, in_dim), "float32",
+                                 I.xavier())
+        return _emit("matmul", {"X": [input.var.name], "Y": [w.name]},
+                     {"transpose_Y": True},
+                     out_shape=_shape(input)[:-1] + (size,))
+    return _Projection(emit, size)
+
+
+def table_projection(input: LayerOutput, size: int) -> _Projection:
+    """TableProjection: embedding lookup of integer input."""
+    t = input.input_type
+    if t is None or not t.vocab:
+        raise ValueError("table_projection needs integer_value input")
+    def emit():
+        w = FL._create_parameter("table_w", (t.vocab, size), "float32",
+                                 I.normal(0.0, 0.01))
+        return _emit("lookup_table", {"W": [w.name], "Ids": [input.var.name]},
+                     out_shape=_shape(input) + (size,))
+    return _Projection(emit, size)
+
+
+def identity_projection(input: LayerOutput, offset: Optional[int] = None,
+                        size: Optional[int] = None) -> _Projection:
+    """IdentityProjection / IdentityOffsetProjection (feature slice)."""
+    in_dim = _shape(input)[-1]
+    if offset is None:
+        return _Projection(lambda: input.var, in_dim)
+    end = offset + (size or (in_dim - offset))
+    def emit():
+        ndim = len(_shape(input))
+        starts = [0] * (ndim - 1) + [offset]
+        shape = [-1] * (ndim - 1) + [end - offset]   # -1: full batch extent
+        return _emit("crop", {"X": [input.var.name]},
+                     {"offsets": starts, "shape": shape},
+                     out_shape=_shape(input)[:-1] + (end - offset,))
+    return _Projection(emit, end - offset)
+
+
+def dotmul_projection(input: LayerOutput) -> _Projection:
+    """DotMulProjection: per-dimension learned weight, y = w ⊙ x."""
+    in_dim = _shape(input)[-1]
+    def emit():
+        w = FL._create_parameter("dotmul_w", (in_dim,), "float32", I.ones)
+        return _emit("elementwise_mul",
+                     {"X": [input.var.name], "Y": [w.name]},
+                     out_shape=_shape(input))
+    return _Projection(emit, in_dim)
+
+
+def scaling_projection(input: LayerOutput) -> _Projection:
+    """ScalingProjection: one learned scalar, y = w * x."""
+    in_dim = _shape(input)[-1]
+    def emit():
+        w = FL._create_parameter("scaling_w", (), "float32", I.ones)
+        return _emit("elementwise_mul",
+                     {"X": [input.var.name], "Y": [w.name]},
+                     out_shape=_shape(input))
+    return _Projection(emit, in_dim)
+
+
+def context_projection_layer(input: LayerOutput, context_len: int,
+                             context_start: Optional[int] = None) -> _Projection:
+    """ContextProjection: concat of shifted frames (sequence input)."""
+    in_dim = _shape(input)[-1]
+    start = context_start if context_start is not None else -(context_len // 2)
+    size = in_dim * context_len
+    def emit():
+        return _emit("context_projection",
+                     {"X": [input.var.name],
+                      "Lengths": [input.lengths.name]},
+                     {"context_length": context_len, "context_start": start},
+                     out_shape=_shape(input)[:-1] + (size,))
+    return _Projection(emit, size)
+
+
+def dotmul_operator(a: LayerOutput, b: LayerOutput,
+                    scale: float = 1.0) -> _Projection:
+    """DotMulOperator: scale * (a ⊙ b) — a Mixed-layer binary operator."""
+    in_dim = _shape(a)[-1]
+    def emit():
+        prod = _emit("elementwise_mul", {"X": [a.var.name], "Y": [b.var.name]},
+                     out_shape=_shape(a))
+        if scale == 1.0:
+            return prod
+        return _emit("scale", {"X": [prod.name]}, {"scale": scale},
+                     out_shape=_shape(a))
+    return _Projection(emit, in_dim)
+
+
+def mixed_layer(size: Optional[int] = None, input=None,
+                act: Optional[str] = None, bias_attr: bool = False,
+                name: Optional[str] = None) -> LayerOutput:
+    """MixedLayer: sum of projections/operators, + bias, + activation."""
+    projs: List[_Projection] = list(input or [])
+    if not projs:
+        raise ValueError("mixed_layer needs at least one projection")
+    size = size or projs[0].size
+    for p in projs:
+        if p.size != size:
+            raise ValueError(f"projection size {p.size} != mixed size {size}")
+    outs = [p.emit() for p in projs]
+    b = default_main_program().current_block()
+    acc = outs[0]
+    if len(outs) > 1:
+        acc = _emit("sum", {"X": [o.name for o in outs]},
+                    out_shape=tuple(outs[0].shape))
+    if bias_attr:
+        bias = FL._create_parameter("mixed_b", (size,), "float32", I.zeros)
+        acc = _emit("elementwise_add", {"X": [acc.name], "Y": [bias.name]},
+                    out_shape=tuple(acc.shape))
+    if act:
+        acc = _emit(act, {"X": [acc.name]}, out_shape=tuple(acc.shape))
+    _register_named(name, acc)
+    return LayerOutput(acc)
+
+
+# ----------------------------------------------------------------- misc ------
+
+def addto_layer(input: List[LayerOutput], act: Optional[str] = None,
+                bias_attr: bool = False) -> LayerOutput:
+    """AddtoLayer: elementwise sum of N inputs (+act)."""
+    out = _emit("sum", {"X": [i.var.name for i in input]},
+                out_shape=_shape(input[0]))
+    if act:
+        out = _emit(act, {"X": [out.name]}, out_shape=tuple(out.shape))
+    return LayerOutput(out, input[0].lengths, input[0].input_type)
+
+
+def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0) -> LayerOutput:
+    """CosSimLayer."""
+    bkl = default_main_program().current_block()
+    out = bkl.create_var(shape=(_shape(a)[0],), dtype="float32")
+    bkl.append_op("cos_sim", {"X": [a.var.name], "Y": [b.var.name]},
+                  {"Out": [out.name]}, {"scale": scale})
+    return LayerOutput(out)
+
+
+def power_layer(input: LayerOutput) -> LayerOutput:
+    """PowerLayer: y = x^w with a learned scalar exponent."""
+    w = FL._create_parameter("power_w", (), "float32", I.ones)
+    out = _emit("power", {"X": [input.var.name], "W": [w.name]},
+                out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def scaling_layer(input: LayerOutput, weight: LayerOutput) -> LayerOutput:
+    """ScalingLayer: rows of ``input`` scaled by per-row ``weight`` [B, 1]."""
+    out = _emit("elementwise_mul",
+                {"X": [input.var.name], "Y": [weight.var.name]},
+                out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def slope_intercept_layer(input: LayerOutput, slope: float = 1.0,
+                          intercept: float = 0.0) -> LayerOutput:
+    out = _emit("slope_intercept", {"X": [input.var.name]},
+                {"slope": slope, "intercept": intercept},
+                out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def sum_to_one_norm_layer(input: LayerOutput) -> LayerOutput:
+    out = _emit("sum_to_one_norm", {"X": [input.var.name]},
+                out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def interpolation_layer(input: List[LayerOutput],
+                        weight: LayerOutput) -> LayerOutput:
+    """InterpolationLayer: w*a + (1-w)*b with per-row w."""
+    a, b = input
+    out = _emit("interpolation",
+                {"X": [a.var.name], "Y": [b.var.name],
+                 "W": [weight.var.name]},
+                out_shape=_shape(a))
+    return LayerOutput(out)
+
+
+def linear_comb_layer(weights: LayerOutput, vectors: LayerOutput,
+                      size: int) -> LayerOutput:
+    """LinearCombinationLayer (convex_comb_layer)."""
+    out = _emit("linear_comb",
+                {"X": [vectors.var.name], "W": [weights.var.name]},
+                out_shape=(_shape(vectors)[0], size))
+    return LayerOutput(out)
+
+
+def bilinear_interp_layer(input: LayerOutput, out_h: int,
+                          out_w: int) -> LayerOutput:
+    """BilinearInterpLayer ([B, H, W, C] maps)."""
+    shp = _shape(input)
+    out = _emit("bilinear_interp", {"X": [input.var.name]},
+                {"out_h": out_h, "out_w": out_w},
+                out_shape=(shp[0], out_h, out_w, shp[-1]))
+    return LayerOutput(out)
+
+
+def repeat_layer(input: LayerOutput, num_repeats: int) -> LayerOutput:
+    """FeatureMapExpandLayer."""
+    shp = _shape(input)
+    out = _emit("repeat", {"X": [input.var.name]}, {"times": num_repeats},
+                out_shape=shp[:-1] + (shp[-1] * num_repeats,))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def rotate_layer(input: LayerOutput) -> LayerOutput:
+    shp = _shape(input)
+    out = _emit("rotate", {"X": [input.var.name]},
+                out_shape=(shp[0], shp[2], shp[1], shp[3]))
+    return LayerOutput(out)
+
+
+def trans_layer(input: LayerOutput) -> LayerOutput:
+    """TransLayer: matrix transpose of [B, D] -> handled as [D, B]."""
+    shp = _shape(input)
+    out = _emit("transpose", {"X": [input.var.name]}, {"axis": (1, 0)},
+                out_shape=(shp[1], shp[0]))
+    return LayerOutput(out)
+
+
+def seq_reshape_layer(input: LayerOutput, reshape_size: int) -> LayerOutput:
+    shp = _shape(input)
+    out = _emit("seq_reshape", {"X": [input.var.name]},
+                {"new_dim": reshape_size},
+                out_shape=(shp[0], -1, reshape_size))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def expand_layer(input: LayerOutput, expand_as: LayerOutput) -> LayerOutput:
+    """ExpandLayer: broadcast per-sequence rows to every step of expand_as."""
+    out = _emit("sequence_expand",
+                {"X": [input.var.name],
+                 "RefLengths": [expand_as.lengths.name],
+                 "Ref": [expand_as.var.name]},
+                out_shape=(_shape(input)[0], _shape(expand_as)[1],
+                           _shape(input)[-1]))
+    return LayerOutput(out, expand_as.lengths, expand_as.input_type)
+
+
+def max_id_layer(input: LayerOutput) -> LayerOutput:
+    """MaxIdLayer."""
+    out = _emit("argmax", {"X": [input.var.name]},
+                out_shape=_shape(input)[:-1], out_dtype="int32")
+    return LayerOutput(out, input.lengths)
+
+
+def sampling_id_layer(input: LayerOutput, seed: int = 0) -> LayerOutput:
+    """SamplingIdLayer."""
+    out = _emit("sampling_id", {"X": [input.var.name]}, {"seed": seed},
+                out_shape=_shape(input)[:-1], out_dtype="int32")
+    return LayerOutput(out)
+
+
+def clip_layer(input: LayerOutput, min: float, max: float) -> LayerOutput:
+    out = _emit("clip", {"X": [input.var.name]}, {"min": min, "max": max},
+                out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def pad_layer(input: LayerOutput, pad) -> LayerOutput:
+    shp = _shape(input)
+    out_shape = tuple(s + lo + hi if s > 0 else s
+                      for s, (lo, hi) in zip(shp, pad))
+    out = _emit("pad", {"X": [input.var.name]}, {"paddings": pad},
+                out_shape=out_shape)
+    return LayerOutput(out)
+
+
+def crop_layer(input: LayerOutput, offsets, shape) -> LayerOutput:
+    out = _emit("crop", {"X": [input.var.name]},
+                {"offsets": offsets, "shape": shape},
+                out_shape=tuple(shape))
+    return LayerOutput(out)
+
+
+def multiplex_layer(index: LayerOutput,
+                    inputs: List[LayerOutput]) -> LayerOutput:
+    """MultiplexLayer: per-row selection among candidate inputs."""
+    out = _emit("multiplex",
+                {"Ids": [index.var.name],
+                 "X": [i.var.name for i in inputs]},
+                out_shape=_shape(inputs[0]))
+    return LayerOutput(out)
+
+
+def tensor_layer(a: LayerOutput, b: LayerOutput, size: int,
+                 act: Optional[str] = None) -> LayerOutput:
+    """TensorLayer: bilinear form aᵀ W_k b for k in 1..size."""
+    da, db = _shape(a)[-1], _shape(b)[-1]
+    w = FL._create_parameter("tensor_w", (size, da, db), "float32",
+                             I.xavier())
+    out = _emit("bilinear_tensor_product",
+                {"X": [a.var.name], "Y": [b.var.name], "Weight": [w.name]},
+                out_shape=(_shape(a)[0], size))
+    if act:
+        out = _emit(act, {"X": [out.name]}, out_shape=tuple(out.shape))
+    return LayerOutput(out)
+
+
+def conv_shift_layer(a: LayerOutput, b: LayerOutput) -> LayerOutput:
+    """ConvShiftLayer (circular convolution, NTM-style addressing)."""
+    out = _emit("conv_shift", {"X": [a.var.name], "Y": [b.var.name]},
+                out_shape=_shape(a))
+    return LayerOutput(out)
+
+
+def block_expand_layer(input: LayerOutput, block_x: int, block_y: int,
+                       stride_x: int = 1, stride_y: int = 1) -> LayerOutput:
+    """BlockExpandLayer (im2col as a layer)."""
+    shp = _shape(input)
+    out = _emit("block_expand", {"X": [input.var.name]},
+                {"block": (block_y, block_x),
+                 "strides": (stride_y, stride_x), "paddings": 0},
+                out_shape=(shp[0], -1, block_x * block_y * shp[-1]))
+    return LayerOutput(out)
+
+
+def maxout_layer(input: LayerOutput, groups: int) -> LayerOutput:
+    """MaxOutLayer."""
+    shp = _shape(input)
+    out = _emit("maxout", {"X": [input.var.name]}, {"groups": groups},
+                out_shape=shp[:-1] + (shp[-1] // groups,))
+    return LayerOutput(out)
+
+
+def row_conv_layer(input: LayerOutput, future_context: int) -> LayerOutput:
+    """RowConvLayer (lookahead conv, DeepSpeech2)."""
+    d = _shape(input)[-1]
+    w = FL._create_parameter("rowconv_w", (future_context + 1, d), "float32",
+                             I.xavier())
+    out = _emit("row_conv",
+                {"X": [input.var.name], "Filter": [w.name]},
+                out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def roi_pool_layer(input: LayerOutput, rois: LayerOutput, pooled_height: int,
+                   pooled_width: int, spatial_scale: float = 1.0) -> LayerOutput:
+    """ROIPoolLayer (detection)."""
+    shp = _shape(input)
+    out = _emit("roi_pool",
+                {"X": [input.var.name], "ROIs": [rois.var.name]},
+                {"pooled_height": pooled_height, "pooled_width": pooled_width,
+                 "spatial_scale": spatial_scale},
+                out_shape=(-1, pooled_height, pooled_width, shp[-1]))
+    return LayerOutput(out)
+
+
+def batch_norm_layer(input: LayerOutput, act: Optional[str] = None,
+                     momentum: float = 0.9, epsilon: float = 1e-5,
+                     is_test: bool = False) -> LayerOutput:
+    """BatchNormLayer (3 gserver impls + operators/batch_norm_op.cc) — uses
+    the TRAINING-mode fluid batch_norm (running stats updated in-graph)."""
+    out = FL.batch_norm(input.var, act=act, momentum=momentum,
+                        epsilon=epsilon, is_test=is_test)
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def img_cmrnorm_layer(input: LayerOutput, size: int = 5, scale: float = 1e-4,
+                      power: float = 0.75) -> LayerOutput:
+    """CMRProjectionNormLayer (local response norm across channels)."""
+    out = _emit("lrn", {"X": [input.var.name]},
+                {"n": size, "alpha": scale, "beta": power},
+                out_shape=_shape(input))
+    return LayerOutput(out)
+
+
+def img_conv3d(input: LayerOutput, num_filters: int, filter_size: int,
+               stride: int = 1, padding: int = 0,
+               act: Optional[str] = "relu") -> LayerOutput:
+    """3-D convolution layer (operators/conv3d)."""
+    shp = _shape(input)
+    k = (filter_size,) * 3 if isinstance(filter_size, int) else filter_size
+    w = FL._create_parameter("conv3d_w", tuple(k) + (shp[-1], num_filters),
+                             "float32", I.xavier())
+    out = _emit("conv3d", {"Input": [input.var.name], "Filter": [w.name]},
+                {"strides": stride, "paddings": padding},
+                out_shape=(shp[0], -1, -1, -1, num_filters))
+    if act:
+        out = _emit(act, {"X": [out.name]}, out_shape=tuple(out.shape))
+    return LayerOutput(out)
+
+
+def img_pool3d(input: LayerOutput, pool_size: int = 2, pool_type: str = "max",
+               stride: Optional[int] = None) -> LayerOutput:
+    shp = _shape(input)
+    out = _emit("pool3d", {"X": [input.var.name]},
+                {"ksize": pool_size, "pooling_type": pool_type,
+                 "strides": stride or pool_size},
+                out_shape=(shp[0], -1, -1, -1, shp[-1]))
+    return LayerOutput(out)
+
+
+def img_conv_transpose(input: LayerOutput, num_filters: int, filter_size: int,
+                       stride: int = 1, padding: int = 0,
+                       act: Optional[str] = None) -> LayerOutput:
+    """Transposed convolution (operators/conv2d_transpose; GAN generators)."""
+    shp = _shape(input)
+    k = (filter_size,) * 2 if isinstance(filter_size, int) else filter_size
+    w = FL._create_parameter("convT_w", tuple(k) + (shp[-1], num_filters),
+                             "float32", I.xavier())
+    out = _emit("conv2d_transpose",
+                {"Input": [input.var.name], "Filter": [w.name]},
+                {"strides": stride, "paddings": padding},
+                out_shape=(shp[0], -1, -1, num_filters))
+    if act:
+        out = _emit(act, {"X": [out.name]}, out_shape=tuple(out.shape))
+    return LayerOutput(out)
+
+
+def spp_layer(input: LayerOutput, pyramid_height: int = 3,
+              pool_type: str = "max") -> LayerOutput:
+    """SpatialPyramidPoolLayer."""
+    shp = _shape(input)
+    bins = sum(4 ** i for i in range(pyramid_height))
+    out = _emit("spp", {"X": [input.var.name]},
+                {"pyramid_height": pyramid_height, "pooling_type": pool_type},
+                out_shape=(shp[0], bins * shp[-1]))
+    return LayerOutput(out)
+
+
+def prelu_layer(input: LayerOutput) -> LayerOutput:
+    d = _shape(input)[-1]
+    alpha = FL._create_parameter("prelu_alpha", (d,), "float32",
+                                 I.constant(0.25))
+    out = _emit("prelu", {"X": [input.var.name], "Alpha": [alpha.name]},
+                out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+# ------------------------------------------------------------- cost zoo ------
+# CostLayer.cpp: 20+ losses; each cost returns a SCALAR mean cost layer.
+
+def _mean_of(var) -> LayerOutput:
+    return LayerOutput(_emit("mean", {"X": [var.name]}, out_shape=()))
+
+
+def mse_cost(input: LayerOutput, label: LayerOutput) -> LayerOutput:
+    return square_error_cost(input, label)
+
+
+regression_cost = mse_cost
+
+
+def multi_binary_label_cross_entropy_cost(input: LayerOutput,
+                                          label: LayerOutput) -> LayerOutput:
+    """CostLayer.cpp MultiBinaryLabelCrossEntropy."""
+    v = _emit("multi_binary_label_cross_entropy",
+              {"X": [input.var.name], "Label": [label.var.name]},
+              out_shape=(_shape(input)[0],))
+    return _mean_of(v)
+
+
+def soft_binary_class_cross_entropy_cost(input: LayerOutput,
+                                         label: LayerOutput) -> LayerOutput:
+    v = _emit("soft_binary_class_cross_entropy",
+              {"X": [input.var.name], "Label": [label.var.name]},
+              out_shape=(_shape(input)[0],))
+    return _mean_of(v)
+
+
+def huber_regression_cost(input: LayerOutput, label: LayerOutput,
+                          delta: float = 1.0) -> LayerOutput:
+    v = _emit("huber_loss",
+              {"X": [input.var.name], "Label": [label.var.name]},
+              {"delta": delta}, out_shape=(_shape(input)[0],))
+    return _mean_of(v)
+
+
+def huber_classification_cost(input: LayerOutput,
+                              label: LayerOutput) -> LayerOutput:
+    """HuberTwoClassification ({-1,+1} labels)."""
+    v = _emit("huber_classification",
+              {"X": [input.var.name], "Label": [label.var.name]},
+              out_shape=(_shape(input)[0],))
+    return _mean_of(v)
+
+
+def rank_cost(left: LayerOutput, right: LayerOutput,
+              label: LayerOutput) -> LayerOutput:
+    """RankingCost (pairwise logistic)."""
+    v = _emit("rank_loss",
+              {"Left": [left.var.name], "Right": [right.var.name],
+               "Label": [label.var.name]},
+              out_shape=(_shape(left)[0],))
+    return _mean_of(v)
+
+
+def lambda_cost(score: LayerOutput, label: LayerOutput) -> LayerOutput:
+    """LambdaCost (LambdaRank with |ΔNDCG| pair weights) over a sequence of
+    candidate scores per query."""
+    v = _emit("lambda_cost",
+              {"X": [score.var.name], "Label": [label.var.name],
+               "Lengths": [score.lengths.name]},
+              out_shape=(_shape(score)[0],))
+    return _mean_of(v)
+
+
+def cross_entropy_with_selfnorm_cost(input: LayerOutput, label: LayerOutput,
+                                     softmax_selfnorm_alpha: float = 0.1
+                                     ) -> LayerOutput:
+    v = _emit("cross_entropy_over_selfnorm",
+              {"X": [input.var.name], "Label": [label.var.name]},
+              {"softmax_selfnorm_alpha": softmax_selfnorm_alpha},
+              out_shape=(_shape(input)[0],))
+    return _mean_of(v)
+
+
+def smooth_l1_cost(input: LayerOutput, label: LayerOutput) -> LayerOutput:
+    v = _emit("smooth_l1_loss",
+              {"X": [input.var.name], "Label": [label.var.name]},
+              out_shape=(_shape(input)[0],))
+    return _mean_of(v)
+
+
+def hinge_cost(input: LayerOutput, label: LayerOutput) -> LayerOutput:
+    v = _emit("hinge_loss",
+              {"X": [input.var.name], "Label": [label.var.name]},
+              out_shape=(_shape(input)[0],))
+    return _mean_of(v)
+
+
+def log_loss_cost(input: LayerOutput, label: LayerOutput,
+                  epsilon: float = 1e-7) -> LayerOutput:
+    v = _emit("log_loss",
+              {"Predicted": [input.var.name], "Label": [label.var.name]},
+              {"eps": epsilon}, out_shape=(_shape(input)[0],))
+    return _mean_of(v)
+
+
+def sum_cost(input: LayerOutput) -> LayerOutput:
+    """SumCost: sum of the input as the cost."""
+    v = _emit("reduce_sum", {"X": [input.var.name]}, {"dim": None},
+              out_shape=())
+    return LayerOutput(v)
+
+
+def sigmoid_cross_entropy_cost(input: LayerOutput,
+                               label: LayerOutput) -> LayerOutput:
+    v = _emit("sigmoid_cross_entropy_with_logits",
+              {"X": [input.var.name], "Label": [label.var.name]},
+              out_shape=_shape(input))
+    return _mean_of(v)
+
+
+def crf_layer(input: LayerOutput, label: LayerOutput,
+              size: Optional[int] = None) -> LayerOutput:
+    """CRFLayer (linear-chain CRF negative log-likelihood).
+
+    The transition parameter is exposed as ``.transitions`` on the returned
+    cost layer — pass it to :func:`crf_decoding_layer` so Viterbi decoding
+    uses the TRAINED matrix (the reference shares it by parameter name)."""
+    n_tags = size or _shape(input)[-1]
+    trans = FL._create_parameter("crf_trans", (n_tags + 2, n_tags), "float32",
+                                 I.constant(0.0))
+    v = _emit("linear_chain_crf",
+              {"Emission": [input.var.name], "Label": [label.var.name],
+               "Transition": [trans.name],
+               "Lengths": [input.lengths.name]},
+              out_shape=(_shape(input)[0],), out_slot="LogLikelihood")
+    neg = _emit("scale", {"X": [v.name]}, {"scale": -1.0},
+                out_shape=(_shape(input)[0],))
+    cost = _mean_of(neg)
+    cost.transitions = LayerOutput(trans)
+    return cost
+
+
+def crf_decoding_layer(input: LayerOutput, size: Optional[int] = None,
+                       transitions: Optional[LayerOutput] = None
+                       ) -> LayerOutput:
+    """CRFDecodingLayer (Viterbi). Pass ``transitions`` from the training
+    crf_layer's ``.transitions`` to decode with the learned matrix; omitting
+    it creates a FRESH zero matrix (argmax-of-emissions decoding)."""
+    n_tags = size or _shape(input)[-1]
+    if transitions is not None:
+        trans = transitions.var
+    else:
+        trans = FL._create_parameter("crf_trans", (n_tags + 2, n_tags),
+                                     "float32", I.constant(0.0))
+    v = _emit("crf_decoding",
+              {"Emission": [input.var.name], "Transition": [trans.name],
+               "Lengths": [input.lengths.name]},
+              out_shape=_shape(input)[:-1], out_dtype="int32",
+              out_slot="ViterbiPath")
+    return LayerOutput(v, input.lengths)
+
+
+def ctc_layer(input: LayerOutput, label: LayerOutput, size: int,
+              blank: int = 0) -> LayerOutput:
+    """CTCLayer / WarpCTCLayer."""
+    v = _emit("warpctc",
+              {"Logits": [input.var.name], "Label": [label.var.name],
+               "LogitsLengths": [input.lengths.name],
+               "LabelLengths": [label.lengths.name]},
+              {"blank": blank}, out_shape=(_shape(input)[0],),
+              out_slot="Loss")
+    return _mean_of(v)
+
+
+def nce_layer(input: LayerOutput, label: LayerOutput, num_classes: int,
+              num_neg_samples: int = 10, seed: int = 0) -> LayerOutput:
+    """NCELayer (noise-contrastive estimation)."""
+    d = _shape(input)[-1]
+    w = FL._create_parameter("nce_w", (num_classes, d), "float32",
+                             I.normal(0.0, 0.01))
+    bias = FL._create_parameter("nce_b", (num_classes,), "float32", I.zeros)
+    v = _emit("nce",
+              {"Input": [input.var.name], "Label": [label.var.name],
+               "Weight": [w.name], "Bias": [bias.name]},
+              {"num_neg_samples": num_neg_samples, "seed": seed,
+               "num_classes": num_classes},
+              out_shape=(_shape(input)[0],), out_slot="Cost")
+    return _mean_of(v)
+
+
+def hsigmoid_layer(input: LayerOutput, label: LayerOutput,
+                   num_classes: int) -> LayerOutput:
+    """HierarchicalSigmoidLayer: O(log V) softmax over a Huffman-ish tree;
+    paths/codes are derived in-op from the static num_classes attr."""
+    d = _shape(input)[-1]
+    w = FL._create_parameter("hsig_w", (2 * num_classes, d), "float32",
+                             I.normal(0.0, 0.01))
+    bias = FL._create_parameter("hsig_b", (2 * num_classes,), "float32",
+                                I.zeros)
+    v = _emit("hierarchical_sigmoid",
+              {"Input": [input.var.name], "Label": [label.var.name],
+               "InnerW": [w.name], "InnerB": [bias.name]},
+              {"num_classes": num_classes},
+              out_shape=(), out_slot="Cost")
+    return LayerOutput(v)

@@ -98,3 +98,70 @@ def simple_attention(encoded_sequence: LayerOutput,
     weighted = FL.elementwise_mul(encoded_sequence.var, w3)
     context = FL.reduce_sum(weighted, dim=1)               # [B, H]
     return LayerOutput(context)
+
+
+def simple_gru(input: LayerOutput, size: int,
+               reverse: bool = False) -> LayerOutput:
+    """networks.py simple_gru / simple_gru2 — fused projection + GRU scan."""
+    return L.grumemory(input, size, reverse=reverse)
+
+
+def bidirectional_gru(input: LayerOutput, size: int) -> LayerOutput:
+    """networks.py bidirectional_gru: concat(last fwd state, first bwd)."""
+    fwd = L.grumemory(input, size)
+    bwd = L.grumemory(input, size, reverse=True)
+    return L.concat([L.last_seq(fwd), L.first_seq(bwd)], axis=-1)
+
+
+def sequence_conv_pool(input: LayerOutput, context_len: int,
+                       hidden_size: int,
+                       pool_type: str = "max") -> LayerOutput:
+    """networks.py sequence_conv_pool: context window FC + sequence pool."""
+    proj = L.mixed_layer(
+        size=hidden_size,
+        input=[L.full_matrix_projection(
+            L.mixed_layer(size=input.var.shape[-1] * context_len,
+                          input=[L.context_projection_layer(
+                              input, context_len)]),
+            hidden_size)],
+        act="relu")
+    ctx = LayerOutput(proj.var, input.lengths, input.input_type)
+    return L.pooling(ctx, pool_type)
+
+
+def img_conv_group(input: LayerOutput, conv_filters,
+                   pool_size: int = 2) -> LayerOutput:
+    """networks.py img_conv_group: N conv+BN blocks then one pool (channel
+    count inferred from the input)."""
+    h = input
+    for nf in conv_filters:
+        h = L.img_conv(h, nf, 3, padding=1, act=None)
+        h = L.batch_norm_layer(h, act="relu")
+    return L.img_pool(h, pool_size)
+
+
+def simple_attention_pool(encoded: LayerOutput,
+                          hidden: int = 64) -> LayerOutput:
+    """Self-attentive pooling: tanh hidden projection then a learned scalar
+    query over encoder states — the building block behind networks.py
+    simple_attention when used without a decoder state."""
+    # projections handle the [B, T, D] rank (plain fc would flatten the time
+    # dim into the feature dim)
+    h = L.mixed_layer(size=hidden,
+                      input=[L.full_matrix_projection(encoded, hidden)],
+                      act="tanh")
+    scores = L.mixed_layer(size=1, input=[L.full_matrix_projection(h, 1)])
+    b = default_main_program().current_block()
+    flat = b.create_var(shape=scores.var.shape[:-1], dtype="float32")
+    b.append_op("squeeze", {"X": [scores.var.name]}, {"Out": [flat.name]},
+                {"axis": -1})
+    sm = b.create_var(shape=flat.shape, dtype="float32")
+    b.append_op("sequence_softmax",
+                {"X": [flat.name], "Lengths": [encoded.lengths.name]},
+                {"Out": [sm.name]}, {})
+    w3 = b.create_var(shape=tuple(sm.shape) + (1,), dtype="float32")
+    b.append_op("unsqueeze", {"X": [sm.name]}, {"Out": [w3.name]},
+                {"axis": -1})
+    weighted = L.scaling_layer(encoded, LayerOutput(w3))
+    return L.pooling(LayerOutput(weighted.var, encoded.lengths,
+                                 encoded.input_type), "sum")

@@ -387,6 +387,36 @@ SPECS = {
                                   "Moment": [pos32(D)],
                                   "LearningRate": [np.float32(0.1)]},
                              attrs={"l1": 0.01, "l2": 0.01}),
+    "ftrl": dict(ins={"Param": [f32(D)], "Grad": [f32(D)],
+                      "SquaredAccumulator": [pos32(D)],
+                      "LinearAccumulator": [f32(D)],
+                      "LearningRate": [np.float32(0.1)]},
+                 attrs={"l1": 0.01, "l2": 0.01}),
+    # -- gen-1 layer-zoo completions ----------------------------------------
+    "argmax": dict(ins={"X": [f32(B, V)]}),
+    "power": dict(ins={"X": [pos32(B, D)], "W": [np.float32(1.5)]},
+                  grad=[("X", 0), ("W", 0)]),
+    "slope_intercept": dict(ins={"X": [f32(B, D)]},
+                            attrs={"slope": 2.0, "intercept": 0.5},
+                            grad=[("X", 0)]),
+    "sum_to_one_norm": dict(ins={"X": [pos32(B, D)]}, grad=[("X", 0)]),
+    "linear_comb": dict(ins={"X": [f32(B, N * D)], "W": [f32(B, N)]},
+                        grad=[("X", 0), ("W", 0)]),
+    "repeat": dict(ins={"X": [f32(B, D)]}, attrs={"times": 3}),
+    "rotate": dict(ins={"X": [f32(B, T, T, D)]}),
+    "seq_reshape": dict(ins={"X": [f32(B, T, 2 * D)]}, attrs={"new_dim": D}),
+    "sampling_id": dict(ins={"X": [pos32(B, V)]}, attrs={"seed": 3}),
+    "cross_entropy_over_selfnorm": dict(
+        ins={"X": [f32(B, V)], "Label": [R.randint(0, V, B).astype(np.int32)]},
+        grad=[("X", 0)]),
+    "huber_classification": dict(
+        ins={"X": [f32(B)],
+             "Label": [(R.randint(0, 2, B) * 2 - 1).astype(np.float32)]}),
+    "lambda_cost": dict(
+        ins={"X": [f32(B, T)],
+             "Label": [R.randint(0, 3, (B, T)).astype(np.float32)],
+             "Lengths": [LENGTHS]},
+        grad=[("X", 0)]),
 }
 
 # ops that cannot be run standalone (structural / host-side)

@@ -1,0 +1,430 @@
+"""Gen-1 layer-zoo breadth: every new *_layer / *_cost in the v2 DSL builds
+a program and executes through the fluid Executor — the parametrized analog
+of trainer_config_helpers' per-layer configs + test_LayerGrad coverage
+(SURVEY.md §2.4: layers.py 106 *_layer functions; CostLayer.cpp zoo)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.fluid.executor import Executor
+
+L = paddle.layer
+DT = paddle.data_type
+
+B, T, D, V = 4, 6, 8, 12
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    yield
+
+
+def _run(out_layer, feeds):
+    exe = Executor()
+    exe.run(fluid.default_startup_program())
+    res = exe.run(fluid.default_main_program(), feed=feeds,
+                  fetch_list=[out_layer.var.name])
+    return np.asarray(res[0])
+
+
+def _dense(name, dim=D):
+    return L.data(name, DT.dense_vector(dim))
+
+
+def _seq(name, dim=D):
+    return L.data(name, DT.dense_vector_sequence(dim))
+
+
+RS = np.random.RandomState(0)
+X = RS.randn(B, D).astype(np.float32)
+X2 = RS.randn(B, D).astype(np.float32)
+SEQ = RS.randn(B, T, D).astype(np.float32)
+LENS = np.array([6, 4, 3, 2], np.int32)
+
+
+# ----------------------------------------------------------- mixed/proj ------
+
+def test_mixed_layer_full_matrix_plus_identity():
+    x = _dense("x")
+    out = L.mixed_layer(size=D, input=[
+        L.full_matrix_projection(x, D),
+        L.identity_projection(x),
+        L.dotmul_projection(x),
+    ], act="tanh", bias_attr=True)
+    v = _run(out, {"x": X})
+    assert v.shape == (B, D) and np.isfinite(v).all()
+
+
+def test_mixed_layer_table_and_trans():
+    ids = L.data("ids", DT.integer_value(V))
+    x = _dense("x")
+    out = L.mixed_layer(size=5, input=[
+        L.table_projection(ids, 5),
+        L.trans_full_matrix_projection(x, 5),
+        L.scaling_projection(x) if D == 5 else L.full_matrix_projection(x, 5),
+    ])
+    v = _run(out, {"ids": RS.randint(0, V, B).astype(np.int32), "x": X})
+    assert v.shape == (B, 5)
+
+
+def test_context_projection_in_mixed():
+    s = _seq("s")
+    out = L.mixed_layer(size=3 * D, input=[
+        L.context_projection_layer(s, context_len=3)])
+    v = _run(out, {"s": SEQ, "s__len__": LENS})
+    assert v.shape == (B, T, 3 * D)
+
+
+def test_dotmul_operator():
+    a, b = _dense("a"), _dense("b")
+    out = L.mixed_layer(size=D, input=[L.dotmul_operator(a, b, scale=2.0)])
+    v = _run(out, {"a": X, "b": X2})
+    np.testing.assert_allclose(v, 2.0 * X * X2, rtol=1e-5)
+
+
+def test_identity_projection_offset_slice():
+    x = _dense("x")
+    out = L.mixed_layer(size=3, input=[
+        L.identity_projection(x, offset=2, size=3)])
+    v = _run(out, {"x": X})
+    np.testing.assert_allclose(v, X[:, 2:5], rtol=1e-6)
+
+
+# ------------------------------------------------------------- misc ----------
+
+def test_addto_cos_power_scaling_slope():
+    a, b = _dense("a"), _dense("b")
+    added = L.addto_layer([a, b], act="relu")
+    w = L.data("w", DT.dense_vector(1))
+    scaled = L.scaling_layer(added, w)
+    sloped = L.slope_intercept_layer(scaled, slope=2.0, intercept=1.0)
+    v = _run(sloped, {"a": X, "b": X2, "w": np.ones((B, 1), np.float32)})
+    np.testing.assert_allclose(v, 2.0 * np.maximum(X + X2, 0) + 1.0,
+                               rtol=1e-5)
+    fluid.reset_default_programs()
+    a, b = _dense("a"), _dense("b")
+    cs = L.cos_sim(a, b)
+    v = _run(cs, {"a": X, "b": X2})
+    assert v.shape == (B,) and np.all(np.abs(v) <= 1.0 + 1e-5)
+    fluid.reset_default_programs()
+    x = _dense("x")
+    p = L.power_layer(x)
+    v = _run(p, {"x": np.abs(X) + 0.1})
+    assert np.isfinite(v).all()
+
+
+def test_norm_interp_comb_layers():
+    x = _dense("x")
+    n = L.sum_to_one_norm_layer(x)
+    v = _run(n, {"x": np.abs(X) + 0.1})
+    np.testing.assert_allclose(v.sum(-1), 1.0, rtol=1e-5)
+    fluid.reset_default_programs()
+    a, b, w = _dense("a"), _dense("b"), L.data("w", DT.dense_vector(1))
+    out = L.interpolation_layer([a, b], w)
+    v = _run(out, {"a": X, "b": X2, "w": np.full((B, 1), 0.3, np.float32)})
+    np.testing.assert_allclose(v, 0.3 * X + 0.7 * X2, rtol=1e-5)
+    fluid.reset_default_programs()
+    vecs = L.data("vecs", DT.dense_vector(3 * D))
+    ws = L.data("ws", DT.dense_vector(3))
+    out = L.linear_comb_layer(ws, vecs, D)
+    v = _run(out, {"vecs": RS.randn(B, 3 * D).astype(np.float32),
+                   "ws": RS.randn(B, 3).astype(np.float32)})
+    assert v.shape == (B, D)
+
+
+def test_shape_layers():
+    x = _dense("x")
+    r = L.repeat_layer(x, 3)
+    v = _run(r, {"x": X})
+    assert v.shape == (B, 3 * D)
+    fluid.reset_default_programs()
+    s = _seq("s")
+    rs = L.seq_reshape_layer(s, D // 2)
+    v = _run(rs, {"s": SEQ, "s__len__": LENS})
+    assert v.shape == (B, 2 * T, D // 2)
+    fluid.reset_default_programs()
+    x = _dense("x")
+    c = L.clip_layer(x, -0.5, 0.5)
+    v = _run(c, {"x": X})
+    assert v.min() >= -0.5 and v.max() <= 0.5
+    fluid.reset_default_programs()
+    x = _dense("x")
+    pd = L.pad_layer(x, [(0, 0), (1, 2)])
+    v = _run(pd, {"x": X})
+    assert v.shape == (B, D + 3)
+
+
+def test_expand_and_maxid_sampling():
+    per_seq = _dense("p")
+    s = _seq("s")
+    ex = L.expand_layer(per_seq, s)
+    v = _run(ex, {"p": X, "s": SEQ, "s__len__": LENS})
+    assert v.shape == (B, T, D)
+    np.testing.assert_allclose(v[:, 0], X, rtol=1e-6)
+    fluid.reset_default_programs()
+    x = _dense("x")
+    mid = L.max_id_layer(x)
+    v = _run(mid, {"x": X})
+    np.testing.assert_array_equal(v, X.argmax(-1))
+    fluid.reset_default_programs()
+    probs = _dense("pr")
+    sid = L.sampling_id_layer(probs, seed=1)
+    v = _run(sid, {"pr": np.abs(X) + 0.01})
+    assert v.shape == (B,) and (0 <= v).all() and (v < D).all()
+
+
+def test_multiplex_tensor_convshift():
+    idx = L.data("i", DT.integer_value(2))
+    a, b = _dense("a"), _dense("b")
+    out = L.multiplex_layer(idx, [a, b])
+    ids = np.array([0, 1, 1, 0], np.int32)
+    v = _run(out, {"i": ids, "a": X, "b": X2})
+    want = np.where(ids[:, None] == 0, X, X2)
+    np.testing.assert_allclose(v, want, rtol=1e-6)
+    fluid.reset_default_programs()
+    a, b = _dense("a"), _dense("b")
+    t = L.tensor_layer(a, b, size=4, act="tanh")
+    v = _run(t, {"a": X, "b": X2})
+    assert v.shape == (B, 4)
+    fluid.reset_default_programs()
+    a = _dense("a")
+    k = L.data("k", DT.dense_vector(3))
+    cs = L.conv_shift_layer(a, k)
+    v = _run(cs, {"a": X, "k": RS.randn(B, 3).astype(np.float32)})
+    assert v.shape == (B, D)
+
+
+def test_image_layers():
+    img = L.data("img", DT.dense_vector(8 * 8 * 3))
+    # v2 images feed flat; reshape through the fluid var
+    from paddle_tpu.fluid import layers as FL
+    reshaped = L.LayerOutput(FL.reshape(img.var, (-1, 8, 8, 3)))
+    mo = L.maxout_layer(_as4(reshaped, (B, 8, 8, 3)), groups=3)
+    v = _run(mo, {"img": RS.randn(B, 8 * 8 * 3).astype(np.float32)})
+    assert v.shape == (B, 8, 8, 1)
+
+
+def _as4(lo, shape):
+    lo.var.shape = shape  # annotate for the DSL's static-shape math
+    return lo
+
+
+def test_image_pipeline_layers():
+    from paddle_tpu.fluid import layers as FL
+    img = L.data("img", DT.dense_vector(8 * 8 * 3))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 8, 8, 3))), (B, 8, 8, 3))
+    feeds = {"img": RS.randn(B, 8 * 8 * 3).astype(np.float32)}
+
+    v = _run(L.img_cmrnorm_layer(x, size=3), feeds)
+    assert v.shape == (B, 8, 8, 3)
+    fluid.reset_default_programs()
+    img = L.data("img", DT.dense_vector(8 * 8 * 3))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 8, 8, 3))), (B, 8, 8, 3))
+    v = _run(L.bilinear_interp_layer(x, 16, 16), feeds)
+    assert v.shape == (B, 16, 16, 3)
+    fluid.reset_default_programs()
+    img = L.data("img", DT.dense_vector(8 * 8 * 3))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 8, 8, 3))), (B, 8, 8, 3))
+    v = _run(L.rotate_layer(x), feeds)
+    assert v.shape == (B, 8, 8, 3)
+    fluid.reset_default_programs()
+    img = L.data("img", DT.dense_vector(8 * 8 * 3))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 8, 8, 3))), (B, 8, 8, 3))
+    v = _run(L.spp_layer(x, pyramid_height=2), feeds)
+    assert v.shape == (B, 5 * 3)
+    fluid.reset_default_programs()
+    img = L.data("img", DT.dense_vector(8 * 8 * 3))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 8, 8, 3))), (B, 8, 8, 3))
+    v = _run(L.img_conv_transpose(x, 4, 3, stride=2), feeds)
+    assert v.shape[0] == B and v.shape[-1] == 4
+    fluid.reset_default_programs()
+    img = L.data("img", DT.dense_vector(4 * 8 * 8 * 3))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 4, 8, 8, 3))),
+             (B, 4, 8, 8, 3))
+    feeds5 = {"img": RS.randn(B, 4 * 8 * 8 * 3).astype(np.float32)}
+    v = _run(L.img_pool3d(L.img_conv3d(x, 4, 3, padding=1), 2), feeds5)
+    assert v.shape[0] == B and v.shape[-1] == 4
+
+
+def test_seq_aux_layers():
+    s = _seq("s")
+    rc = L.row_conv_layer(s, future_context=2)
+    v = _run(rc, {"s": SEQ, "s__len__": LENS})
+    assert v.shape == (B, T, D)
+    fluid.reset_default_programs()
+    s = _seq("s")
+    pl = L.prelu_layer(s)
+    v = _run(pl, {"s": SEQ, "s__len__": LENS})
+    assert v.shape == (B, T, D)
+
+
+# ------------------------------------------------------------ cost zoo -------
+
+def _scalar(cost, feeds):
+    v = _run(cost, feeds)
+    assert v.shape == () and np.isfinite(v)
+    return float(v)
+
+
+def test_cost_zoo_regression_family():
+    x, y = _dense("x"), _dense("y")
+    _scalar(L.mse_cost(x, y), {"x": X, "y": X2})
+    fluid.reset_default_programs()
+    x, y = _dense("x"), _dense("y")
+    _scalar(L.huber_regression_cost(x, y), {"x": X, "y": X2})
+    fluid.reset_default_programs()
+    x, y = _dense("x"), _dense("y")
+    _scalar(L.smooth_l1_cost(x, y), {"x": X, "y": X2})
+
+
+def test_cost_zoo_classification_family():
+    logits = _dense("l", V)
+    lab = L.data("y", DT.integer_value(V))
+    feeds = {"l": RS.randn(B, V).astype(np.float32),
+             "y": RS.randint(0, V, B).astype(np.int32)}
+    _scalar(L.cross_entropy_with_selfnorm_cost(logits, lab), feeds)
+    fluid.reset_default_programs()
+    logits = _dense("l", V)
+    multi = L.data("m", DT.dense_vector(V))
+    _scalar(L.multi_binary_label_cross_entropy_cost(logits, multi),
+            {"l": RS.randn(B, V).astype(np.float32),
+             "m": RS.randint(0, 2, (B, V)).astype(np.float32)})
+    fluid.reset_default_programs()
+    p = _dense("p", V)
+    soft = L.data("t", DT.dense_vector(V))
+    probs = np.abs(RS.randn(B, V)).astype(np.float32) * 0.1 + 0.2
+    _scalar(L.soft_binary_class_cross_entropy_cost(p, soft),
+            {"p": np.clip(probs, 0.05, 0.95),
+             "t": np.clip(probs, 0.05, 0.95)})
+    fluid.reset_default_programs()
+    score = L.data("s", DT.dense_vector(1))
+    binlab = L.data("y", DT.dense_vector(1))
+    _scalar(L.sigmoid_cross_entropy_cost(score, binlab),
+            {"s": RS.randn(B, 1).astype(np.float32),
+             "y": RS.randint(0, 2, (B, 1)).astype(np.float32)})
+    fluid.reset_default_programs()
+    score = L.data("s", DT.dense_vector(1))
+    pm = L.data("y", DT.dense_vector(1))
+    _scalar(L.hinge_cost(score, pm),
+            {"s": RS.randn(B, 1).astype(np.float32),
+             "y": (RS.randint(0, 2, (B, 1)) * 2 - 1).astype(np.float32)})
+
+
+def test_cost_zoo_rank_and_lambda():
+    left = L.data("a", DT.dense_vector(1))
+    right = L.data("b", DT.dense_vector(1))
+    lab = L.data("y", DT.dense_vector(1))
+    _scalar(L.rank_cost(left, right, lab),
+            {"a": RS.randn(B, 1).astype(np.float32),
+             "b": RS.randn(B, 1).astype(np.float32),
+             "y": RS.randint(0, 2, (B, 1)).astype(np.float32)})
+    fluid.reset_default_programs()
+    score = L.data("s", DT.integer_value_sequence(1))  # [B, T] float scores
+    score.var.dtype = "float32"
+    rel = L.data("r", DT.integer_value_sequence(1))
+    rel.var.dtype = "float32"
+    _scalar(L.lambda_cost(score, rel),
+            {"s": RS.randn(B, T).astype(np.float32),
+             "r": RS.randint(0, 3, (B, T)).astype(np.float32),
+             "s__len__": LENS, "r__len__": LENS})
+
+
+def test_cost_zoo_structured():
+    emis = _seq("e", 5)
+    tags = L.data("t", DT.integer_value_sequence(5))
+    _scalar(L.crf_layer(emis, tags),
+            {"e": RS.randn(B, T, 5).astype(np.float32),
+             "t": RS.randint(0, 5, (B, T)).astype(np.int32),
+             "e__len__": LENS, "t__len__": LENS})
+    fluid.reset_default_programs()
+    emis = _seq("e", 5)
+    tags = L.data("t", DT.integer_value_sequence(5))
+    cost = L.crf_layer(emis, tags)
+    # decoding SHARES the training transitions (reference: same param name)
+    dec = L.crf_decoding_layer(emis, transitions=cost.transitions)
+    trans_names = [v for v in
+                   fluid.default_main_program().global_block().vars
+                   if "crf_trans" in v]
+    assert len(trans_names) == 1, trans_names
+    v = _run(dec, {"e": RS.randn(B, T, 5).astype(np.float32),
+                   "t": RS.randint(0, 5, (B, T)).astype(np.int32),
+                   "e__len__": LENS, "t__len__": LENS})
+    assert v.shape == (B, T) and (v >= 0).all() and (v < 5).all()
+    fluid.reset_default_programs()
+    logits = _seq("lg", 6)
+    labels = L.data("lb", DT.integer_value_sequence(6))
+    _scalar(L.ctc_layer(logits, labels, size=6),
+            {"lg": RS.randn(B, T, 6).astype(np.float32),
+             "lb": RS.randint(1, 6, (B, 3)).astype(np.int32),
+             "lg__len__": LENS,
+             "lb__len__": np.array([3, 2, 2, 1], np.int32)})
+
+
+def test_cost_zoo_sampled():
+    h = _dense("h")
+    lab = L.data("y", DT.integer_value(V))
+    feeds = {"h": X, "y": RS.randint(0, V, B).astype(np.int32)}
+    _scalar(L.nce_layer(h, lab, num_classes=V, num_neg_samples=3), feeds)
+    fluid.reset_default_programs()
+    h = _dense("h")
+    lab = L.data("y", DT.integer_value(V))
+    _scalar(L.hsigmoid_layer(h, lab, num_classes=V), feeds)
+
+
+def test_cost_trains_end_to_end():
+    """A mixed_layer + cost-zoo model actually learns via the v2 trainer."""
+    from paddle_tpu.trainer import event
+    x = L.data("x", DT.dense_vector(D))
+    y = L.data("y", DT.dense_vector(1))
+    h = L.mixed_layer(size=16, input=[L.full_matrix_projection(x, 16)],
+                      act="tanh", bias_attr=True)
+    pred = L.fc(h, 1)
+    cost = L.huber_regression_cost(pred, y)
+
+    w_true = RS.randn(D, 1).astype(np.float32)
+    Xtr = RS.randn(256, D).astype(np.float32)
+    Ytr = Xtr @ w_true
+
+    def reader():
+        for i in range(0, 256, 32):
+            yield [(Xtr[j], Ytr[j]) for j in range(i, i + 32)]
+
+    trainer = paddle.SGD(cost, paddle.optimizer.Adam(1e-2))
+    costs = []
+    trainer.train(reader, num_passes=10,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, event.EndIteration) else None,
+                  feeding=[x, y])
+    assert costs[-1] < costs[0] * 0.3
+
+
+def test_prebuilt_networks():
+    net = paddle.networks
+    words = L.data("w", DT.integer_value_sequence(V))
+    emb = L.embedding(words, D)
+    feeds = {"w": RS.randint(0, V, (B, T)).astype(np.int32),
+             "w__len__": LENS}
+    g = net.simple_gru(emb, 8)
+    v = _run(g, feeds)
+    assert v.shape == (B, T, 8)
+    fluid.reset_default_programs()
+    words = L.data("w", DT.integer_value_sequence(V))
+    emb = L.embedding(words, D)
+    bi = net.bidirectional_gru(emb, 8)
+    v = _run(bi, feeds)
+    assert v.shape == (B, 16)
+    fluid.reset_default_programs()
+    words = L.data("w", DT.integer_value_sequence(V))
+    emb = L.embedding(words, D)
+    scp = net.sequence_conv_pool(emb, context_len=3, hidden_size=10)
+    v = _run(scp, feeds)
+    assert v.shape == (B, 10)
+    fluid.reset_default_programs()
+    words = L.data("w", DT.integer_value_sequence(V))
+    emb = L.embedding(words, D)
+    ap = net.simple_attention_pool(emb)
+    v = _run(ap, feeds)
+    assert v.shape == (B, D)
