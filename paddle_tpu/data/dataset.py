@@ -364,3 +364,89 @@ class criteo:
     @staticmethod
     def test(n: int = 256) -> Reader:
         return lambda: criteo._make(n, 92)
+
+
+# --------------------------------------------------------------- flowers -----
+class flowers:
+    """Oxford-102 flowers schema (dataset/flowers.py): readers yield
+    (HWC uint8 image, label in [0, 102)) through the standard train/test
+    mapper pipeline (resize-short 256 -> crop 224 -> normalize handled by the
+    caller's mapper, as in flowers.default_mapper)."""
+
+    CLASSES = 102
+    HW = 64          # synthetic images are small; schema (HWC uint8) matches
+
+    @staticmethod
+    def _make(n, seed):
+        rs = _state(seed)
+        protos = _state(77).randint(0, 255, (flowers.CLASSES, 8, 8, 3))
+        labels = rs.randint(0, flowers.CLASSES, n)
+        imgs = []
+        for i in range(n):
+            base = protos[labels[i]].astype(np.float32)
+            up = np.kron(base, np.ones((flowers.HW // 8, flowers.HW // 8, 1)))
+            noise = rs.randn(flowers.HW, flowers.HW, 3) * 12
+            imgs.append(np.clip(up + noise, 0, 255).astype(np.uint8))
+        return imgs, labels.astype(np.int32)
+
+    @staticmethod
+    def train(n: int = 512, mapper=None) -> Reader:
+        def reader():
+            imgs, labels = flowers._make(n, 70)
+            for im, lb in zip(imgs, labels):
+                sample = (im, int(lb))
+                yield mapper(sample) if mapper else sample
+        return reader
+
+    @staticmethod
+    def test(n: int = 128, mapper=None) -> Reader:
+        def reader():
+            imgs, labels = flowers._make(n, 71)
+            for im, lb in zip(imgs, labels):
+                sample = (im, int(lb))
+                yield mapper(sample) if mapper else sample
+        return reader
+
+    valid = test
+
+
+# --------------------------------------------------------------- voc2012 -----
+class voc2012:
+    """VOC2012 segmentation schema (dataset/voc2012.py): readers yield
+    (HWC uint8 image, HW int32 mask with classes in [0, 21))."""
+
+    CLASSES = 21
+    HW = 64
+
+    @staticmethod
+    def _make(n, seed):
+        rs = _state(seed)
+        samples = []
+        for _ in range(n):
+            img = rs.randint(0, 255, (voc2012.HW, voc2012.HW, 3)).astype(np.uint8)
+            mask = np.zeros((voc2012.HW, voc2012.HW), np.int32)
+            # a few rectangular object regions with class-correlated pixels
+            for _ in range(rs.randint(1, 4)):
+                c = rs.randint(1, voc2012.CLASSES)
+                y, x = rs.randint(0, voc2012.HW - 16, 2)
+                h, w = rs.randint(8, 16, 2)
+                mask[y:y + h, x:x + w] = c
+                img[y:y + h, x:x + w] = (c * 11) % 255
+            samples.append((img, mask))
+        return samples
+
+    @staticmethod
+    def train(n: int = 256) -> Reader:
+        def reader():
+            for img, mask in voc2012._make(n, 80):
+                yield img, mask
+        return reader
+
+    @staticmethod
+    def test(n: int = 64) -> Reader:
+        def reader():
+            for img, mask in voc2012._make(n, 81):
+                yield img, mask
+        return reader
+
+    val = test

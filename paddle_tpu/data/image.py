@@ -81,3 +81,78 @@ def simple_transform(im: np.ndarray, resize: int, crop: int, is_train: bool,
     if mean is not None:
         im = normalize(im, mean)
     return im
+
+
+def to_chw(im: np.ndarray, order: Tuple[int, int, int] = (2, 0, 1)) -> np.ndarray:
+    """HWC -> CHW (image.py to_chw) — the layout the reference's conv layers
+    ate; paddle_tpu convs are NHWC-native, so use this only for exported
+    compatibility paths."""
+    return im.transpose(order)
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    """Decode an encoded (PNG/JPEG/...) image from bytes -> HWC uint8
+    (image.py load_image_bytes; PIL replaces the reference's cv2)."""
+    import io
+
+    from PIL import Image
+    img = Image.open(io.BytesIO(data))
+    img = img.convert("RGB" if is_color else "L")
+    arr = np.asarray(img)
+    if not is_color:
+        arr = arr[..., None]
+    return arr
+
+
+def load_image(path: str, is_color: bool = True) -> np.ndarray:
+    """image.py load_image."""
+    with open(path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def load_and_transform(path: str, resize: int, crop: int, is_train: bool,
+                       is_color: bool = True,
+                       mean: Optional[Sequence[float]] = None) -> np.ndarray:
+    """image.py load_and_transform: decode + simple_transform."""
+    return simple_transform(load_image(path, is_color), resize, crop,
+                            is_train, mean=mean)
+
+
+def batch_images_from_tar(tar_path: str, dataset_name: str,
+                          img2label: dict, num_per_batch: int = 1024,
+                          out_path: Optional[str] = None) -> str:
+    """Pre-batch a tar of encoded images into pickled numpy batches
+    (image.py batch_images_from_tar): each output batch file holds
+    {'data': [raw bytes...], 'label': [...]}; returns the batch-list file."""
+    import pickle
+    import tarfile
+
+    out_path = out_path or (tar_path + "_batch")
+    import os
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, names = [], [], []
+    with tarfile.open(tar_path) as tf:
+        for m in tf.getmembers():
+            if m.name not in img2label:
+                continue
+            data.append(tf.extractfile(m).read())
+            labels.append(img2label[m.name])
+            if len(data) == num_per_batch:
+                names.append(_dump_batch(out_path, dataset_name, len(names),
+                                         data, labels))
+                data, labels = [], []
+    if data:
+        names.append(_dump_batch(out_path, dataset_name, len(names), data,
+                                 labels))
+    listfile = f"{out_path}/{dataset_name}.batch_list"
+    with open(listfile, "w") as f:
+        f.write("\n".join(names))
+    return listfile
+
+
+def _dump_batch(out_path, name, idx, data, labels):
+    import pickle
+    fname = f"{out_path}/{name}_batch_{idx:04d}"
+    with open(fname, "wb") as f:
+        pickle.dump({"data": list(data), "label": list(labels)}, f)
+    return fname

@@ -137,3 +137,80 @@ def both_start_propagate(both_start, label_starts, B, T):
     sT = jnp.swapaxes(label_starts, 0, 1)
     _, runs = jax.lax.scan(step, jnp.zeros((B,), jnp.bool_), (bT, sT))
     return jnp.swapaxes(runs, 0, 1)
+
+
+def edit_distance(pred: jax.Array, pred_len, label: jax.Array, label_len):
+    """Levenshtein distance per row (CTCErrorEvaluator.cpp's core).
+
+    pred [B, Tp] int ids (padded), label [B, Tl]; returns [B] distances.
+    DP over fixed padded shapes with masking — XLA-friendly (no dynamic
+    shapes), one fori_loop over the pred axis.
+    """
+    import jax as _jax
+    B, Tp = pred.shape
+    Tl = label.shape[1]
+    pred_len = pred_len.astype(jnp.int32)
+    label_len = label_len.astype(jnp.int32)
+
+    # dp[j] = distance between pred[:i] and label[:j], updated row by row
+    init = jnp.broadcast_to(jnp.arange(Tl + 1, dtype=jnp.float32),
+                            (B, Tl + 1))
+
+    def row(i, dp):
+        ins = dp[:, 0] + 1.0
+        first = jnp.where(i < pred_len, ins, dp[:, 0])
+
+        def col(j, carry):
+            dp_new, diag = carry       # diag = old dp[:, j-1]
+            old = dp[:, j]
+            sub = diag + jnp.where(pred[:, i] == label[:, j - 1], 0.0, 1.0)
+            val = jnp.minimum(jnp.minimum(old + 1.0, dp_new[:, j - 1] + 1.0),
+                              sub)
+            # freeze beyond the true lengths
+            val = jnp.where((i < pred_len) & (j <= label_len), val, old)
+            return dp_new.at[:, j].set(val), old
+
+        dp_new = dp.at[:, 0].set(first)
+        dp_new, _ = _jax.lax.fori_loop(1, Tl + 1, col, (dp_new, dp[:, 0]))
+        return dp_new
+
+    dp = _jax.lax.fori_loop(0, Tp, row, init)
+    return jnp.take_along_axis(dp, label_len[:, None], axis=1)[:, 0]
+
+
+def pnpair_counts(scores: jax.Array, labels: jax.Array, query_ids: jax.Array):
+    """PnpairEvaluator.cpp: among same-query pairs with different labels,
+    count (correctly ordered, wrongly ordered, ties) by score.
+
+    scores/labels/query_ids: [N]. Returns (pos, neg, spe) scalars.
+    """
+    s_i, s_j = scores[:, None], scores[None, :]
+    l_i, l_j = labels[:, None], labels[None, :]
+    q_i, q_j = query_ids[:, None], query_ids[None, :]
+    cand = (q_i == q_j) & (l_i > l_j)         # ordered pairs: i should rank higher
+    pos = jnp.sum(cand & (s_i > s_j))
+    neg = jnp.sum(cand & (s_i < s_j))
+    spe = jnp.sum(cand & (s_i == s_j))
+    return pos, neg, spe
+
+
+def average_precision(scores, matched, n_gt):
+    """11-point / area AP for one class given decision scores and 0/1 match
+    flags (DetectionMAPEvaluator.cpp integral mode). Host-side numpy."""
+    import numpy as _np
+    scores = _np.asarray(scores, _np.float64)
+    matched = _np.asarray(matched, _np.float64)
+    if n_gt <= 0 or scores.size == 0:
+        return 0.0
+    order = _np.argsort(-scores)
+    tp = _np.cumsum(matched[order])
+    fp = _np.cumsum(1.0 - matched[order])
+    rec = tp / n_gt
+    prec = tp / _np.maximum(tp + fp, 1e-12)
+    # integral AP: sum precision deltas over recall steps
+    ap = 0.0
+    prev_r = 0.0
+    for r, p in zip(rec, prec):
+        ap += p * (r - prev_r)
+        prev_r = r
+    return float(ap)

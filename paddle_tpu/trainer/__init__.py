@@ -8,13 +8,17 @@ from . import event
 from .checkpoint import (from_tar, latest_pass, load_checkpoint, pass_dir,
                          save_checkpoint, to_tar)
 from .evaluator import (AucEvaluator, ChunkEvaluator,
-                        ClassificationErrorEvaluator, Evaluator,
-                        EvaluatorGroup, PrecisionRecallEvaluator, SumEvaluator)
+                        ClassificationErrorEvaluator, CTCErrorEvaluator,
+                        DetectionMAPEvaluator, Evaluator, EvaluatorGroup,
+                        MaxIdPrinterEvaluator, PnpairEvaluator,
+                        PrecisionRecallEvaluator, SumEvaluator,
+                        ValuePrinterEvaluator)
 from .trainer import Trainer
 
 __all__ = ["Trainer", "event",
            "Evaluator", "EvaluatorGroup", "ClassificationErrorEvaluator",
            "SumEvaluator", "AucEvaluator", "PrecisionRecallEvaluator",
-           "ChunkEvaluator",
+           "ChunkEvaluator", "CTCErrorEvaluator", "DetectionMAPEvaluator",
+           "PnpairEvaluator", "ValuePrinterEvaluator", "MaxIdPrinterEvaluator",
            "to_tar", "from_tar", "save_checkpoint", "load_checkpoint",
            "latest_pass", "pass_dir"]

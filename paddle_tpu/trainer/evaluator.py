@@ -184,3 +184,183 @@ class EvaluatorGroup:
         for e in self.evaluators:
             out.update(e.result())
         return out
+
+
+class CTCErrorEvaluator(Evaluator):
+    """Sequence error rate: edit distance between CTC greedy decodes and
+    label sequences over total label length (CTCErrorEvaluator.cpp)."""
+
+    name = "ctc_error"
+
+    def __init__(self, blank: int = 0):
+        self.blank = blank
+        self.start()
+
+    def start(self):
+        self.dist = 0.0
+        self.label_len = 0.0
+        self.seq_errs = 0.0
+        self.n_seq = 0.0
+
+    def update(self, log_probs=None, logit_lengths=None, labels=None,
+               label_lengths=None, decoded=None, decoded_lengths=None, **_):
+        from ..ops.ctc import ctc_greedy_decode
+        if decoded is None:
+            decoded, decoded_lengths = ctc_greedy_decode(
+                log_probs, logit_lengths, blank=self.blank)
+        d = np.asarray(M.edit_distance(decoded, decoded_lengths, labels,
+                                       label_lengths), np.float64)
+        self.dist += float(d.sum())
+        self.label_len += float(np.asarray(label_lengths).sum())
+        self.seq_errs += float((d > 0).sum())
+        self.n_seq += d.shape[0]
+
+    def result(self):
+        return {"ctc_error_rate": self.dist / max(self.label_len, 1.0),
+                "ctc_seq_error": self.seq_errs / max(self.n_seq, 1.0)}
+
+
+class PnpairEvaluator(Evaluator):
+    """Positive-negative pair ordering (PnpairEvaluator.cpp): ratio of
+    correctly-ordered same-query pairs; ties count half."""
+
+    name = "pnpair"
+
+    def __init__(self):
+        self.start()
+
+    def start(self):
+        self.pos = 0.0
+        self.neg = 0.0
+        self.spe = 0.0
+
+    def update(self, scores=None, labels=None, query_ids=None, **_):
+        p, n, s = M.pnpair_counts(jnp.ravel(scores), jnp.ravel(labels),
+                                  jnp.ravel(query_ids))
+        self.pos += float(p)
+        self.neg += float(n)
+        self.spe += float(s)
+
+    def result(self):
+        denom = max(self.neg + self.spe / 2.0, 1e-12)
+        return {"pnpair_ratio": (self.pos + self.spe / 2.0) / denom,
+                "pnpair_pos": self.pos, "pnpair_neg": self.neg}
+
+
+class DetectionMAPEvaluator(Evaluator):
+    """Mean average precision over detection outputs
+    (DetectionMAPEvaluator.cpp, integral mode).
+
+    update() takes per-image detections [N, 6] rows (class, score, x1, y1,
+    x2, y2) — the detection_output op's format — and ground truth [M, 5]
+    rows (class, x1, y1, x2, y2)."""
+
+    name = "detection_map"
+
+    def __init__(self, num_classes: int, iou_threshold: float = 0.5,
+                 background: int = 0):
+        self.num_classes = num_classes
+        self.iou = iou_threshold
+        self.background = background
+        self.start()
+
+    def start(self):
+        self.scores = {c: [] for c in range(self.num_classes)}
+        self.matched = {c: [] for c in range(self.num_classes)}
+        self.n_gt = {c: 0 for c in range(self.num_classes)}
+
+    def update(self, detections=None, gt=None, **_):
+        from ..ops.detection import iou_matrix
+        det = np.asarray(detections, np.float64)
+        gts = np.asarray(gt, np.float64)
+        for c in range(self.num_classes):
+            if c == self.background:
+                continue
+            d = det[det[:, 0] == c]
+            g = gts[gts[:, 0] == c]
+            self.n_gt[c] += len(g)
+            if len(d) == 0:
+                continue
+            d = d[np.argsort(-d[:, 1])]
+            taken = np.zeros(len(g), bool)
+            # ONE batched [D, M] IoU call per class (not per detection row)
+            all_ious = (np.asarray(iou_matrix(jnp.asarray(d[:, 2:6]),
+                                              jnp.asarray(g[:, 1:5])))
+                        if len(g) else np.zeros((len(d), 0)))
+            for row, ious in zip(d, all_ious):
+                self.scores[c].append(row[1])
+                if ious.size == 0:
+                    self.matched[c].append(0.0)
+                    continue
+                best = int(ious.argmax())
+                if ious[best] >= self.iou and not taken[best]:
+                    taken[best] = True
+                    self.matched[c].append(1.0)
+                else:
+                    self.matched[c].append(0.0)
+
+    def result(self):
+        aps = []
+        for c in range(self.num_classes):
+            if c == self.background or self.n_gt[c] == 0:
+                continue
+            aps.append(M.average_precision(self.scores[c], self.matched[c],
+                                           self.n_gt[c]))
+        return {"detection_map": float(np.mean(aps)) if aps else 0.0}
+
+
+class ValuePrinterEvaluator(Evaluator):
+    """Printer evaluator (Evaluator.cpp ValuePrinter): logs a named batch
+    output every ``period`` updates — debugging aid, contributes no metric."""
+
+    name = "value_printer"
+
+    def __init__(self, key: str, period: int = 1, max_items: int = 8,
+                 log_fn=None):
+        from ..utils.logging import get_logger
+        self.key = key
+        self.period = period
+        self.max_items = max_items
+        self.log = log_fn or get_logger(__name__).info
+        self.start()
+
+    def start(self):
+        self.n = 0
+
+    def update(self, **kw):
+        self.n += 1
+        if self.key in kw and self.n % self.period == 0:
+            v = np.asarray(kw[self.key])
+            self.log("value_printer[%s] shape=%s head=%s", self.key, v.shape,
+                     np.ravel(v)[: self.max_items])
+
+    def result(self):
+        return {}
+
+
+class MaxIdPrinterEvaluator(Evaluator):
+    """Printer (Evaluator.cpp MaxIdPrinter): logs argmax ids of an output."""
+
+    name = "max_id_printer"
+
+    def __init__(self, key: str = "logits", period: int = 1, max_items: int = 8,
+                 log_fn=None):
+        from ..utils.logging import get_logger
+        self.key = key
+        self.period = period
+        self.max_items = max_items
+        self.log = log_fn or get_logger(__name__).info
+        self.start()
+
+    def start(self):
+        self.n = 0
+
+    def update(self, **kw):
+        self.n += 1
+        if self.key in kw and self.n % self.period == 0:
+            ids = np.asarray(kw[self.key]).argmax(-1)
+            self.log("max_id[%s]: %s", self.key,
+                     np.ravel(ids)[: self.max_items])
+
+    def result(self):
+        return {}

@@ -24,6 +24,7 @@ class LayerOutput:
     lengths: Optional[Variable] = None      # set for sequence outputs
     input_type: Optional[InputType] = None
     sub_lengths: Optional[Variable] = None  # set for nested (2-level LoD) data
+    values: Optional[Variable] = None       # set for sparse (ids, vals) data
 
     @property
     def name(self):
@@ -54,31 +55,84 @@ def data(name: str, type: InputType) -> LayerOutput:
         v = FL.data(name, shape=(type.slot.dim,))
     elif isinstance(type.slot, IndexSlot):
         v = FL.data(name, shape=(), dtype="int32")
-    else:  # sparse: padded (ids, vals) pair
+    else:  # sparse: padded COO pair (ids [B,K], vals [B,K]); vals carry the
+        # padding mask (0 where padded) — consumed by embedding()/fc()
         v = FL.data(name, shape=(-1,), dtype="int32")
         vals = FL.data(name + "__vals__", shape=(-1,), dtype="float32")
+        return LayerOutput(v, None, type, values=vals)
     return LayerOutput(v, None, type)
+
+
+def _sparse_weighted_sum(ids_var, vals_var, table, size):
+    """sum_k vals[b,k] * table[ids[b,k]] -> [B, size]: the padded-COO
+    SelectedRows path (sparse_binary/float_vector inputs to fc/embedding;
+    math/SparseRowMatrix + getParameterSparse analog — only touched rows
+    enter the matmul)."""
+    b = default_main_program().current_block()
+    looked = b.create_var(shape=(-1, -1, size), dtype="float32")
+    b.append_op("lookup_table", {"W": [table.name], "Ids": [ids_var.name]},
+                {"Out": [looked.name]}, {})
+    vals3 = b.create_var(shape=(-1, -1, 1), dtype="float32")
+    b.append_op("unsqueeze", {"X": [vals_var.name]}, {"Out": [vals3.name]},
+                {"axis": -1})
+    weighted = b.create_var(shape=(-1, -1, size), dtype="float32")
+    b.append_op("elementwise_mul", {"X": [looked.name], "Y": [vals3.name]},
+                {"Out": [weighted.name]}, {})
+    out = b.create_var(shape=(-1, size), dtype="float32")
+    b.append_op("reduce_sum", {"X": [weighted.name]}, {"Out": [out.name]},
+                {"dim": 1})
+    return out
 
 
 def fc(input, size: int, act: Optional[str] = None,
        bias_attr: bool = True, name: Optional[str] = None) -> LayerOutput:
     """Accepts a single layer or a list (concatenated, like the reference's
-    multi-input fc). ``name`` registers the output for memory() binding
-    inside a recurrent_group/beam_search step."""
-    if isinstance(input, (list, tuple)):
-        var = FL.concat([i.var for i in input], axis=-1)
-    else:
-        var = input.var
-    out = FL.fc(var, size, act=act, bias_attr=bias_attr)
-    _register_named(name, out)
-    return LayerOutput(out)
+    multi-input fc). Sparse inputs (sparse_binary/float_vector data layers)
+    take the weighted-row-sum path — the reference's sparse fc
+    (quick_start LR config). ``name`` registers the output for memory()
+    binding inside a recurrent_group/beam_search step."""
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    sparse = [i for i in inputs if i.values is not None]
+    dense = [i for i in inputs if i.values is None]
+    parts = []
+    for s in sparse:
+        dim = s.input_type.slot.dim
+        table = FL._create_parameter("sparse_fc_w", (dim, size), "float32",
+                                     I.xavier())
+        parts.append(_sparse_weighted_sum(s.var, s.values, table, size))
+    if dense:
+        var = (FL.concat([i.var for i in dense], axis=-1)
+               if len(dense) > 1 else dense[0].var)
+        parts.append(FL.fc(var, size, act=None, bias_attr=False))
+    b = default_main_program().current_block()
+    acc = parts[0]
+    if len(parts) > 1:
+        summed = b.create_var(shape=(-1, size), dtype="float32")
+        b.append_op("sum", {"X": [p.name for p in parts]},
+                    {"Out": [summed.name]}, {})
+        acc = summed
+    if bias_attr:
+        bias = FL._create_parameter("fc_b", (size,), "float32", I.zeros)
+        acc = FL.elementwise_add(acc, bias)
+    if act:
+        acc = FL.activation(acc, act)
+    _register_named(name, acc)
+    return LayerOutput(acc)
 
 
 def embedding(input: LayerOutput, size: int) -> LayerOutput:
     t = input.input_type
+    if input.values is not None:
+        # sparse input -> weighted-sum embedding [B, size] (bag-of-features)
+        dim = t.slot.dim
+        table = FL._create_parameter("embedding_w", (dim, size), "float32",
+                                     I.normal(0.0, 0.01))
+        out = _sparse_weighted_sum(input.var, input.values, table, size)
+        return LayerOutput(out)
     if t is None or not t.vocab:
         raise ValueError("embedding needs a data layer typed "
-                         "integer_value[_sequence](vocab_size)")
+                         "integer_value[_sequence](vocab_size) or a sparse "
+                         "vector type")
     out = FL.embedding(input.var, (t.vocab, size))
     return LayerOutput(out, input.lengths, input.input_type,
                        sub_lengths=input.sub_lengths)

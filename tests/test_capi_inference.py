@@ -1,0 +1,139 @@
+"""C inference ABI (native/capi_inference.cc — capi/gradient_machine.h:36-88
+analog): create from the merged inference bundle, forward-only, callable from
+plain C (driven here via ctypes), multi-thread safe (the reference's
+multi_thread example)."""
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB_PATH = os.path.join(REPO, "native", "libpaddle_tpu_capi.so")
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    yield
+
+
+def _load():
+    if not os.path.exists(LIB_PATH):
+        pytest.skip("capi library not built (make -C native)")
+    lib = ctypes.CDLL(LIB_PATH)
+    lib.pti_create.restype = ctypes.c_void_p
+    lib.pti_create.argtypes = [ctypes.c_char_p]
+    lib.pti_forward.restype = ctypes.c_int
+    lib.pti_forward.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),      # inputs
+        ctypes.POINTER(ctypes.c_longlong),    # shapes (concatenated)
+        ctypes.POINTER(ctypes.c_int),         # ndims
+        ctypes.POINTER(ctypes.c_int),         # dtypes
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int)]
+    lib.pti_destroy.argtypes = [ctypes.c_void_p]
+    lib.pti_last_error.restype = ctypes.c_char_p
+    return lib
+
+
+def _export_model(tmp_path):
+    x = fluid.layers.data("x", shape=(4,))
+    h = fluid.layers.fc(x, 8, act="tanh")
+    out = fluid.layers.fc(h, 2)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "model")
+    fluid.io.export_inference_model(d, ["x"], [out], exe)
+    xs = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    ref = np.asarray(exe.run(fluid.default_main_program(), feed={"x": xs},
+                             fetch_list=[out])[0])
+    return d, xs, ref
+
+
+def _forward(lib, h, xs, out_elems=64):
+    buf = np.ascontiguousarray(xs)
+    inputs = (ctypes.c_void_p * 1)(buf.ctypes.data)
+    shapes = (ctypes.c_longlong * 2)(*buf.shape)
+    ndims = (ctypes.c_int * 1)(2)
+    dtypes = (ctypes.c_int * 1)(0)
+    out = np.zeros(out_elems, np.float32)
+    out_shape = (ctypes.c_longlong * 8)()
+    out_ndim = ctypes.c_int(0)
+    rc = lib.pti_forward(
+        h, inputs, shapes, ndims, dtypes, 1, 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_elems, out_shape, ctypes.byref(out_ndim))
+    assert rc >= 0, lib.pti_last_error().decode()
+    shape = tuple(out_shape[i] for i in range(out_ndim.value))
+    return out[:rc].reshape(shape)
+
+
+def test_capi_create_forward_destroy(tmp_path):
+    lib = _load()
+    d, xs, ref = _export_model(tmp_path)
+    h = lib.pti_create(d.encode())
+    assert h, lib.pti_last_error().decode()
+    got = _forward(lib, h, xs)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    lib.pti_destroy(h)
+
+
+def test_capi_create_bad_dir_reports_error():
+    lib = _load()
+    h = lib.pti_create(b"/nonexistent/model/dir")
+    assert not h
+    assert lib.pti_last_error()
+
+
+def test_capi_multi_thread(tmp_path):
+    """capi/examples/model_inference/multi_thread analog: concurrent
+    forwards on one handle must all produce correct results."""
+    lib = _load()
+    d, xs, ref = _export_model(tmp_path)
+    h = lib.pti_create(d.encode())
+    assert h, lib.pti_last_error().decode()
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(5):
+                got = _forward(lib, h, xs)
+                np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+        except Exception as e:   # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    lib.pti_destroy(h)
+
+
+def test_capi_small_buffer_reports_size(tmp_path):
+    lib = _load()
+    d, xs, _ = _export_model(tmp_path)
+    h = lib.pti_create(d.encode())
+    buf = np.ascontiguousarray(xs)
+    inputs = (ctypes.c_void_p * 1)(buf.ctypes.data)
+    shapes = (ctypes.c_longlong * 2)(*buf.shape)
+    ndims = (ctypes.c_int * 1)(2)
+    dtypes = (ctypes.c_int * 1)(0)
+    out = np.zeros(1, np.float32)
+    out_shape = (ctypes.c_longlong * 8)()
+    out_ndim = ctypes.c_int(0)
+    rc = lib.pti_forward(
+        h, inputs, shapes, ndims, dtypes, 1, 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 1,
+        out_shape, ctypes.byref(out_ndim))
+    assert rc == -2          # too small; shape still reported for retry
+    assert tuple(out_shape[i] for i in range(out_ndim.value)) == (3, 2)
+    lib.pti_destroy(h)

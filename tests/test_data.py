@@ -116,3 +116,61 @@ def test_seq_datasets_schema():
         assert len(dense) == 13 and len(ids) == 26 and y in (0, 1)
     for img, label in firstn(cifar.train10(8), 2)():
         assert len(img) == 3072
+
+
+def test_image_pipeline_extras(tmp_path):
+    """image.py parity additions: to_chw, PIL decode, load_and_transform,
+    batch_images_from_tar (python/paddle/v2/image.py)."""
+    import tarfile
+
+    from PIL import Image
+
+    from paddle_tpu.data import image as I
+
+    im = np.random.RandomState(0).randint(0, 255, (40, 50, 3)).astype(np.uint8)
+    chw = I.to_chw(im)
+    assert chw.shape == (3, 40, 50)
+
+    p = str(tmp_path / "im.png")
+    Image.fromarray(im).save(p)
+    back = I.load_image(p)
+    np.testing.assert_array_equal(back, im)
+    gray = I.load_image(p, is_color=False)
+    assert gray.shape == (40, 50, 1)
+
+    out = I.load_and_transform(p, resize=32, crop=24, is_train=False,
+                               mean=[127.5, 127.5, 127.5])
+    assert out.shape == (24, 24, 3)
+
+    # tar batching
+    tar_p = str(tmp_path / "imgs.tar")
+    with tarfile.open(tar_p, "w") as tf:
+        for i in range(5):
+            q = str(tmp_path / f"i{i}.png")
+            Image.fromarray(im).save(q)
+            tf.add(q, arcname=f"i{i}.png")
+    listfile = I.batch_images_from_tar(
+        tar_p, "toy", {f"i{i}.png": i for i in range(5)}, num_per_batch=2)
+    import pickle
+    batches = open(listfile).read().splitlines()
+    assert len(batches) == 3
+    b0 = pickle.load(open(batches[0], "rb"))
+    assert len(b0["data"]) == 2 and b0["label"] == [0, 1]
+    assert I.load_image_bytes(b0["data"][0]).shape == (40, 50, 3)
+
+
+def test_flowers_voc_datasets():
+    from paddle_tpu.data.dataset import flowers, voc2012
+
+    im, lb = next(iter(flowers.train(4)()))
+    assert im.shape == (64, 64, 3) and im.dtype == np.uint8
+    assert 0 <= lb < flowers.CLASSES
+    # mapper pipeline like flowers.default_mapper
+    from paddle_tpu.data import image as I
+    mapped = next(iter(flowers.train(
+        4, mapper=lambda s: (I.simple_transform(s[0], 48, 32, True), s[1]))()))
+    assert mapped[0].shape == (32, 32, 3)
+
+    img, mask = next(iter(voc2012.train(2)()))
+    assert img.shape == (64, 64, 3) and mask.shape == (64, 64)
+    assert mask.max() < voc2012.CLASSES

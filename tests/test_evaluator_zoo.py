@@ -1,0 +1,64 @@
+"""Evaluator-zoo completions: CTC error, detection mAP, pnpair, printers
+(gserver/evaluators registry Evaluator.cpp:172-1357, CTCErrorEvaluator.cpp,
+DetectionMAPEvaluator.cpp, PnpairEvaluator.cpp)."""
+
+import numpy as np
+
+from paddle_tpu.trainer import (CTCErrorEvaluator, DetectionMAPEvaluator,
+                                MaxIdPrinterEvaluator, PnpairEvaluator,
+                                ValuePrinterEvaluator)
+
+
+def test_ctc_error_evaluator():
+    import jax.numpy as jnp
+    ev = CTCErrorEvaluator()
+    # perfect decode: logits peaked on [blank, 1, blank, 2] -> decode [1, 2]
+    T, C = 4, 4
+    lp = np.full((2, T, C), -10.0, np.float32)
+    for b in range(2):
+        for t, c in enumerate([0, 1, 0, 2]):
+            lp[b, t, c] = 0.0
+    labels = np.array([[1, 2], [1, 3]], np.int32)   # row1 has one sub error
+    ev.update(log_probs=jnp.asarray(lp),
+              logit_lengths=jnp.asarray([4, 4]),
+              labels=jnp.asarray(labels),
+              label_lengths=jnp.asarray([2, 2]))
+    r = ev.result()
+    assert abs(r["ctc_error_rate"] - 1 / 4) < 1e-6   # 1 edit / 4 label tokens
+    assert abs(r["ctc_seq_error"] - 0.5) < 1e-6
+
+
+def test_pnpair_evaluator():
+    ev = PnpairEvaluator()
+    ev.update(scores=np.array([0.9, 0.1, 0.2, 0.8], np.float32),
+              labels=np.array([1, 0, 1, 0], np.int32),
+              query_ids=np.array([0, 0, 1, 1], np.int32))
+    r = ev.result()
+    # query0 ordered correctly, query1 wrongly -> ratio 1.0
+    assert r["pnpair_pos"] == 1.0 and r["pnpair_neg"] == 1.0
+    assert abs(r["pnpair_ratio"] - 1.0) < 1e-9
+
+
+def test_detection_map_evaluator():
+    ev = DetectionMAPEvaluator(num_classes=3)
+    gt = np.array([[1, 0, 0, 10, 10],
+                   [2, 20, 20, 30, 30]], np.float32)
+    det = np.array([
+        [1, 0.9, 0, 0, 10, 10],       # perfect match class 1
+        [2, 0.8, 21, 21, 30, 30],     # good match class 2
+        [2, 0.7, 50, 50, 60, 60],     # false positive class 2
+    ], np.float32)
+    ev.update(detections=det, gt=gt)
+    r = ev.result()
+    assert 0.5 < r["detection_map"] <= 1.0
+
+
+def test_printer_evaluators():
+    lines = []
+    vp = ValuePrinterEvaluator("logits", log_fn=lambda *a: lines.append(a))
+    mp = MaxIdPrinterEvaluator("logits", log_fn=lambda *a: lines.append(a))
+    logits = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+    vp.update(logits=logits)
+    mp.update(logits=logits)
+    assert len(lines) == 2
+    assert vp.result() == {} and mp.result() == {}

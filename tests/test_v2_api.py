@@ -90,3 +90,48 @@ def test_bidirectional_lstm_and_text_conv():
                   if isinstance(e, event.EndIteration) else None,
                   feeding=[words, label])
     assert costs[-1] < costs[0]
+
+
+def test_sparse_input_trains_end_to_end():
+    """quick_start LR config analog: sparse_binary_vector input -> fc ->
+    classification — the round-1 dead __vals__ path now carries real data
+    (sparse fc = weighted-row-sum, the SelectedRows/sparse-remote analog)."""
+    DIM = 100
+    rs = np.random.RandomState(0)
+
+    def make(n, seed):
+        r = np.random.RandomState(seed)
+        rows = []
+        for _ in range(n):
+            label = int(r.randint(0, 2))
+            # class-indicative feature ids: even ids -> class 0, odd -> 1
+            base = r.choice(np.arange(label, DIM, 2), size=6, replace=False)
+            noise = r.choice(DIM, size=2, replace=False)
+            rows.append((list(np.concatenate([base, noise])), label))
+        return rows
+
+    x = paddle.layer.data("x", paddle.data_type.sparse_binary_vector(DIM))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    logits = paddle.layer.fc(x, 2)
+    cost = paddle.layer.classification_cost(logits, y)
+
+    trainer = paddle.SGD(cost, paddle.optimizer.Adam(5e-2))
+    costs = []
+
+    def reader():
+        rows = make(256, 1)
+        for i in range(0, 256, 32):
+            yield rows[i:i + 32]
+
+    trainer.train(reader, num_passes=6,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, event.EndIteration) else None,
+                  feeding=[x, y])
+    assert costs[-1] < costs[0] * 0.5
+
+    # sparse_float_vector through embedding(): weighted bag-of-features
+    import paddle_tpu.fluid as F
+    F.reset_default_programs()
+    xf = paddle.layer.data("xf", paddle.data_type.sparse_float_vector(DIM))
+    emb = paddle.layer.embedding(xf, 8)
+    assert emb.var.shape[-1] == 8
