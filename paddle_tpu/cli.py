@@ -135,6 +135,65 @@ def cmd_merge_model(args):
     return 0
 
 
+def cmd_cluster_train(args):
+    """Local cluster launcher — the scripts/cluster_train/paddle.py (ssh) and
+    cluster_train_v2 fabric/openmpi analog, process-model edition.
+
+    Spawns ``--num_workers`` worker processes that join one jax.distributed
+    job (coordinator on localhost; PADDLE_TPU_* env carries the membership
+    that etcd/MPI carried for the reference) and each execute the training
+    SCRIPT. The script calls ``paddle_tpu.parallel.multihost.initialize()``
+    to join, then trains over the global mesh. A failing worker tears the
+    job down (failure detection; rc propagated)."""
+    import os
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    procs = []
+    for i in range(args.num_workers):
+        env = dict(os.environ)
+        env["PADDLE_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["PADDLE_TPU_NUM_PROCESSES"] = str(args.num_workers)
+        env["PADDLE_TPU_PROCESS_ID"] = str(i)
+        if args.devices_per_worker:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count="
+                                f"{args.devices_per_worker}").strip()
+            env["JAX_PLATFORMS"] = "cpu"
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script] + (args.script_args or []),
+            env=env))
+    import time as _time
+    rc = 0
+    deadline = _time.time() + args.timeout
+    try:
+        # poll-all: the moment ANY worker fails, tear the job down (the
+        # docstring's failure-detection contract); one shared deadline
+        pending = list(procs)
+        while pending:
+            for p in list(pending):
+                code = p.poll()
+                if code is not None:
+                    pending.remove(p)
+                    rc = rc or code
+            if rc:                   # a peer failed -> kill the rest now
+                break
+            if _time.time() > deadline:
+                rc = 124
+                break
+            _time.sleep(0.2)
+    finally:
+        for p in procs:           # a dead/hung peer must not strand the rest
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
 def cmd_version(args):
     from . import __version__
     import jax
@@ -177,6 +236,17 @@ def main(argv=None) -> int:
     mm.add_argument("--model_path", required=True)
     mm.add_argument("--output_dir", required=True)
     mm.set_defaults(fn=cmd_merge_model)
+
+    ct = sub.add_parser("cluster_train")
+    ct.add_argument("script", help="training script run by every worker")
+    ct.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="args passed through verbatim (flags included)")
+    ct.add_argument("--num_workers", type=int, default=2)
+    ct.add_argument("--devices_per_worker", type=int, default=0,
+                    help="force N virtual CPU devices per worker (testing; "
+                         "0 = use the worker's real accelerators)")
+    ct.add_argument("--timeout", type=float, default=600.0)
+    ct.set_defaults(fn=cmd_cluster_train)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=cmd_version)

@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from typing import Tuple
 
 import jax
+from jax.experimental import sparse as jsparse
 import jax.numpy as jnp
 
 
@@ -84,3 +85,64 @@ def lookup_table(table: jax.Array, ids: jax.Array,
     if padding_idx is not None:
         out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
     return out
+
+
+# ---------------------------------------------------------------------------
+# General sparse matrices (CSR/CSC/COO) — the paddle/math sparse layer beyond
+# row-sparse gradients: CpuSparseMatrix/SparseMatrix (math/CpuSparseMatrix.h,
+# SparseMatrix.h) carried CSR/CSC value + non-value formats for sparse
+# input features and sparse matmuls. TPU-native: jax.experimental.sparse
+# BCOO (batched COO, the XLA-friendly format) with CSR-style constructors;
+# matmuls lower to gather+segment ops the compiler fuses.
+# ---------------------------------------------------------------------------
+
+def csr_matrix(values, col_ids, row_ptr, shape) -> "jsparse.BCOO":
+    """Build a sparse matrix from CSR arrays (CpuSparseMatrix CSR format;
+    non-value format = pass values of all ones)."""
+    import numpy as np
+    values = jnp.asarray(values)
+    col_ids = np.asarray(col_ids)
+    row_ptr = np.asarray(row_ptr)
+    rows = np.repeat(np.arange(len(row_ptr) - 1), np.diff(row_ptr))
+    idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                     jnp.asarray(col_ids, jnp.int32)], axis=1)
+    return jsparse.BCOO((values, idx), shape=tuple(shape))
+
+
+def csc_matrix(values, row_ids, col_ptr, shape) -> "jsparse.BCOO":
+    """CSC constructor (CpuSparseMatrix CSC format)."""
+    import numpy as np
+    values = jnp.asarray(values)
+    row_ids = np.asarray(row_ids)
+    col_ptr = np.asarray(col_ptr)
+    cols = np.repeat(np.arange(len(col_ptr) - 1), np.diff(col_ptr))
+    idx = jnp.stack([jnp.asarray(row_ids, jnp.int32),
+                     jnp.asarray(cols, jnp.int32)], axis=1)
+    return jsparse.BCOO((values, idx), shape=tuple(shape))
+
+
+def coo_matrix(values, rows, cols, shape) -> "jsparse.BCOO":
+    values = jnp.asarray(values)
+    idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                     jnp.asarray(cols, jnp.int32)], axis=1)
+    return jsparse.BCOO((values, idx), shape=tuple(shape))
+
+
+def sparse_dense_matmul(sp: "jsparse.BCOO", dense: jax.Array) -> jax.Array:
+    """sp @ dense (Matrix::mul with a sparse lhs — the sparse-input fc path
+    of CpuSparseMatrix). Differentiable w.r.t. both operands."""
+    return sp @ dense
+
+
+def dense_sparse_matmul(dense: jax.Array, sp: "jsparse.BCOO") -> jax.Array:
+    """dense @ sp (sparse rhs)."""
+    return dense @ sp
+
+
+def sparse_to_dense(sp: "jsparse.BCOO") -> jax.Array:
+    return sp.todense()
+
+
+def dense_to_bcoo(x: jax.Array, nse: int = None) -> "jsparse.BCOO":
+    """Sparsify a dense matrix (test/construction helper)."""
+    return jsparse.BCOO.fromdense(x, nse=nse)

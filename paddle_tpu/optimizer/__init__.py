@@ -1,12 +1,14 @@
 from .lr_scheduler import (constant_lr, exponential_decay, inverse_time_decay,
                            linear_warmup, natural_exp_decay, piecewise_decay,
                            poly_decay, discexp_lr)
+from .hooks import HookSet, ParameterHook, PruningHook, StaticHook
 from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, DecayedAdagrad,
                          Ftrl, Momentum, Optimizer, ProximalGD, RMSProp,
                          ParameterAverager)
 from .clip import clip_by_global_norm, clip_by_norm, clip_by_value
 
 __all__ = [
+    "HookSet", "ParameterHook", "PruningHook", "StaticHook",
     "Optimizer", "SGD", "Momentum", "Adagrad", "DecayedAdagrad", "Adadelta",
     "RMSProp", "Adam", "Adamax", "ProximalGD", "Ftrl", "ParameterAverager",
     "constant_lr", "exponential_decay", "natural_exp_decay", "inverse_time_decay",

@@ -1,0 +1,93 @@
+"""Parameter-update hooks — the ParameterUpdaterHook.cpp re-provision.
+
+The reference attaches hooks per parameter via ParameterAttr(update_hooks=):
+* static parameters (is_static: excluded from updates — frozen embeddings,
+  pretrained feature towers);
+* StaticPruningHook: a magnitude mask fixed at init (keep the largest
+  (1 - sparsity_ratio) fraction) applied after every update, so pruned
+  entries stay zero through training.
+
+TPU-native: hooks are pure functions composed into the optimizer's jitted
+update (no host round trips); attachment is by parameter-path regex, matching
+how parallel.ShardingRules target parameters.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def path_str(path) -> str:
+    """KeyPath -> 'l1/w' style string (same form ShardingRules matches)."""
+    parts = []
+    for e in path:
+        k = getattr(e, "key", None)
+        if k is None:
+            k = getattr(e, "idx", e)
+        parts.append(str(k))
+    return "/".join(parts)
+
+
+class ParameterHook:
+    """Base hook: optional per-parameter state + post-update transform."""
+
+    def init_state(self, p: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    def apply(self, p_new: jax.Array, p_old: jax.Array,
+              hook_state: Dict[str, jax.Array]) -> jax.Array:
+        return p_new
+
+
+class StaticHook(ParameterHook):
+    """Frozen parameter (ParameterConfig.is_static): the update is discarded.
+
+    Slot state still advances benignly; the parameter value never moves."""
+
+    def apply(self, p_new, p_old, hook_state):
+        return p_old
+
+
+class PruningHook(ParameterHook):
+    """StaticPruningHook: magnitude mask computed ONCE from the initial
+    values; masked entries are forced to zero after every update."""
+
+    def __init__(self, sparsity_ratio: float = 0.75):
+        if not 0.0 <= sparsity_ratio < 1.0:
+            raise ValueError("sparsity_ratio in [0, 1)")
+        self.sparsity_ratio = sparsity_ratio
+
+    def init_state(self, p):
+        k = int(p.size * self.sparsity_ratio)
+        if k == 0:
+            mask = jnp.ones_like(p)
+        else:
+            # exact-k by index: magnitude ties at the threshold (e.g. a
+            # zero-heavy init) must not over-prune — a threshold compare
+            # would mask an all-zero parameter entirely and freeze it
+            order = jnp.argsort(jnp.abs(p).ravel())   # ascending
+            mask = jnp.ones((p.size,), p.dtype).at[order[:k]].set(0)
+            mask = mask.reshape(p.shape)
+        return {"mask": mask}
+
+    def apply(self, p_new, p_old, hook_state):
+        return p_new * hook_state["mask"]
+
+
+class HookSet:
+    """(pattern, hook) rules; first match wins — attach with
+    ``Optimizer(..., hooks=HookSet([(r"embed/w$", StaticHook())]))``."""
+
+    def __init__(self, rules: List[Tuple[str, ParameterHook]]):
+        self.rules = [(re.compile(pat), h) for pat, h in rules]
+
+    def match(self, path) -> Optional[ParameterHook]:
+        s = path_str(path)
+        for pat, h in self.rules:
+            if pat.search(s):
+                return h
+        return None

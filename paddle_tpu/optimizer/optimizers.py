@@ -47,11 +47,13 @@ class Optimizer:
     """Base: handles lr schedule, weight decay (L2), L1, and clipping."""
 
     def __init__(self, learning_rate=0.01, weight_decay: float = 0.0,
-                 l1_decay: float = 0.0, grad_clip: Optional[Tuple[str, float]] = None):
+                 l1_decay: float = 0.0, grad_clip: Optional[Tuple[str, float]] = None,
+                 hooks=None):
         self.lr = _sched(learning_rate)
         self.weight_decay = weight_decay
         self.l1_decay = l1_decay
         self.grad_clip = grad_clip
+        self.hooks = hooks          # optimizer.hooks.HookSet or None
 
     # -- subclass API ---------------------------------------------------
     def init_slot(self, p: jax.Array) -> Dict[str, jax.Array]:
@@ -63,7 +65,15 @@ class Optimizer:
     # -- public ---------------------------------------------------------
     def init(self, params: Params) -> State:
         slots = tmap(lambda p: self.init_slot(p), params)
-        return {"step": jnp.zeros((), jnp.int32), "slots": slots}
+        state = {"step": jnp.zeros((), jnp.int32), "slots": slots}
+        if self.hooks is not None:
+            flat_p, treedef = jax.tree_util.tree_flatten_with_path(params)
+            hook_states = []
+            for path, p in flat_p:
+                h = self.hooks.match(path)
+                hook_states.append(h.init_state(p) if h is not None else {})
+            state["hooks"] = jax.tree_util.tree_unflatten(treedef, hook_states)
+        return state
 
     def _preprocess(self, grads, params):
         if self.weight_decay:
@@ -92,17 +102,26 @@ class Optimizer:
         flat_p, treedef = jax.tree_util.tree_flatten_with_path(params)
         flat_g = jax.tree_util.tree_flatten(grads)[0]
         flat_s = treedef.flatten_up_to(state["slots"])
+        flat_h = (treedef.flatten_up_to(state["hooks"])
+                  if self.hooks is not None and "hooks" in state else None)
         new_p, new_s = [], []
-        for (path, p), g, s in zip(flat_p, flat_g, flat_s):
+        for i, ((path, p), g, s) in enumerate(zip(flat_p, flat_g, flat_s)):
             if _is_stat_path(path):
                 new_p.append(p)
                 new_s.append(s)
                 continue
             np_, ns_ = self.apply_one(p, g, s, lr, step)
+            if flat_h is not None:
+                h = self.hooks.match(path)
+                if h is not None:
+                    np_ = h.apply(np_, p, flat_h[i])
             new_p.append(np_)
             new_s.append(ns_)
-        return (jax.tree_util.tree_unflatten(treedef, new_p),
-                {"step": step, "slots": jax.tree_util.tree_unflatten(treedef, new_s)})
+        out_state = {"step": step,
+                     "slots": jax.tree_util.tree_unflatten(treedef, new_s)}
+        if "hooks" in state:
+            out_state["hooks"] = state["hooks"]
+        return jax.tree_util.tree_unflatten(treedef, new_p), out_state
 
 
 class SGD(Optimizer):

@@ -27,10 +27,19 @@ def initialize(coordinator_address: Optional[str] = None,
     """Join the multi-host job (jax.distributed.initialize wrapper).
 
     On real TPU pods all three args auto-detect from the environment; flags
-    mirror the reference's --trainer_id/--num_gradient_servers. Returns a
-    summary dict. Safe to call single-host (no-op when nothing configured).
+    mirror the reference's --trainer_id/--num_gradient_servers, and the
+    cluster launcher (cli.py cluster_train) exports them as
+    PADDLE_TPU_{COORDINATOR,NUM_PROCESSES,PROCESS_ID}. Returns a summary
+    dict. Safe to call single-host (no-op when nothing configured).
     """
-    if coordinator_address or num_processes or os.environ.get(
+    env = os.environ
+    coordinator_address = coordinator_address or env.get(
+        "PADDLE_TPU_COORDINATOR")
+    if num_processes is None and "PADDLE_TPU_NUM_PROCESSES" in env:
+        num_processes = int(env["PADDLE_TPU_NUM_PROCESSES"])
+    if process_id is None and "PADDLE_TPU_PROCESS_ID" in env:
+        process_id = int(env["PADDLE_TPU_PROCESS_ID"])
+    if coordinator_address or num_processes or env.get(
             "JAX_COORDINATOR_ADDRESS"):
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -245,8 +245,11 @@ class Trainer:
 
     # ------------------------------------------------------------------- time
     def benchmark(self, reader, params, *, feeder=None, warmup: int = 3,
-                  iters: int = 20) -> Dict[str, float]:
-        """--job=time analog (TrainerBenchmark.cpp): steady-state ms/batch."""
+                  iters: int = 20,
+                  profile_dir: Optional[str] = None) -> Dict[str, float]:
+        """--job=time analog (TrainerBenchmark.cpp): steady-state ms/batch.
+        ``profile_dir`` wraps the timed loop in an XLA trace
+        (utils/profiler — the hl_profiler_start/WITH_PROFILER analog)."""
         opt_state = self.opt.init(params) if self._dp is None else None
         if self._dp is not None:
             params, opt_state = self._dp.init(params)
@@ -261,11 +264,19 @@ class Trainer:
             params, opt_state, loss = res[0], res[1], res[2]
             i += 1
         jax.block_until_ready(loss)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            res = step(params, opt_state, *batches[i % len(batches)])
-            params, opt_state, loss = res[0], res[1], res[2]
-            i += 1
-        jax.block_until_ready(loss)
-        ms = (time.perf_counter() - t0) / iters * 1e3
+        from ..utils import profiler as _prof
+        import contextlib
+        prof_cm = (_prof.profile(profile_dir) if profile_dir
+                   else contextlib.nullcontext())
+        with prof_cm:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                with self.stats.timer("BenchBatch"):
+                    res = step(params, opt_state, *batches[i % len(batches)])
+                    params, opt_state, loss = res[0], res[1], res[2]
+                i += 1
+            jax.block_until_ready(loss)
+            # timed INSIDE the profiler context: stop_trace() serialization
+            # must not inflate the reported steady-state number
+            ms = (time.perf_counter() - t0) / iters * 1e3
         return {"ms_per_batch": ms}

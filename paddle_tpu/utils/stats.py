@@ -55,11 +55,17 @@ class StatSet:
 
     @contextmanager
     def timer(self, name: str):
+        from contextlib import nullcontext
+
+        from . import profiler
+        # named span on the device trace (REGISTER_TIMER_INFO analog)
+        span = profiler.annotate(name) if profiler.is_active() else nullcontext()
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.get(name).add(time.perf_counter() - t0)
+        with span:
+            try:
+                yield
+            finally:
+                self.get(name).add(time.perf_counter() - t0)
 
     def reset(self):
         with self._lock:

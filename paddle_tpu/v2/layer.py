@@ -140,7 +140,8 @@ def embedding(input: LayerOutput, size: int) -> LayerOutput:
 
 def _seq_op(op_type, input: LayerOutput, extra_attrs=None, out_shape=None,
             seq_out=False, params=None) -> LayerOutput:
-    b = default_main_program().global_block()
+    # current (not global) block: seq layers compose inside rg/nested steps
+    b = default_main_program().current_block()
     out = b.create_var(shape=out_shape or input.var.shape,
                        dtype="float32")
     inputs = {"X": [input.var.name], "Lengths": [input.lengths.name]}
@@ -154,7 +155,7 @@ def _seq_op(op_type, input: LayerOutput, extra_attrs=None, out_shape=None,
 def lstmemory(input: LayerOutput, size: int, reverse: bool = False,
               forget_bias: float = 1.0) -> LayerOutput:
     """Whole-sequence masked LSTM (simple_lstm/lstmemory analog)."""
-    b = default_main_program().global_block()
+    b = default_main_program().current_block()
     in_dim = input.var.shape[-1]
     w = FL._create_parameter("lstm_w", (in_dim, 4 * size), "float32",
                              I.uniform(-0.08, 0.08))
@@ -174,7 +175,7 @@ def lstmemory(input: LayerOutput, size: int, reverse: bool = False,
 
 
 def grumemory(input: LayerOutput, size: int, reverse: bool = False) -> LayerOutput:
-    b = default_main_program().global_block()
+    b = default_main_program().current_block()
     in_dim = input.var.shape[-1]
     w = FL._create_parameter("gru_w", (in_dim, 3 * size), "float32",
                              I.uniform(-0.08, 0.08))

@@ -1,0 +1,62 @@
+"""Worker script for the cluster_train launcher test: joins the job via
+multihost.initialize() (PADDLE_TPU_* env), trains a toy DP model over the
+global mesh, and asserts the job really is multi-process."""
+
+import os
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import nn, parallel as pp
+from paddle_tpu.optimizer import SGD
+from paddle_tpu.parallel import multihost
+
+
+def main():
+    info = multihost.initialize()
+    assert info["process_count"] == int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+    mesh = multihost.global_mesh(data=info["global_devices"])
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def __call__(self, params, x, **kw):
+            return self.fc(params["fc"], x)
+
+    model = Net()
+
+    def loss(params, x, y):
+        logp = jax.nn.log_softmax(model(params, x))
+        return -jnp.take_along_axis(logp, y[:, None], 1).mean()
+
+    rs = np.random.RandomState(0)
+    GB = 16
+    X = rs.randn(GB, 4).astype(np.float32)
+    Y = rs.randint(0, 2, GB).astype(np.int32)
+    sl = multihost.process_batch_slice(GB)
+
+    params = multihost.replicate_from_host(
+        mesh, jax.device_get(model.init(jax.random.PRNGKey(0))))
+    dp = pp.DataParallel(loss, SGD(0.1), mesh=mesh)
+    opt_state = multihost.replicate_from_host(
+        mesh, jax.device_get(dp.opt.init(jax.device_get(params))))
+    bx, by = multihost.make_global_batch(mesh, (X[sl], Y[sl]))
+    l0 = None
+    for i in range(5):
+        params, opt_state, l = dp.step(params, opt_state, bx, by)
+        if i == 0:
+            l0 = float(l)
+    assert float(l) < l0
+    print(f"worker {info['process_index']} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Representative model configs for the golden-program tests — the analog of
+trainer_config_helpers/tests/configs/* whose emitted protos are diffed
+against protostr/ goldens (SURVEY.md §4.4)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle
+
+L = paddle.layer
+DT = paddle.data_type
+
+
+def _reset():
+    fluid.reset_default_programs()
+    from paddle_tpu.fluid import layers as FL
+    FL._seed_counter[0] = 0        # deterministic init seeds for goldens
+
+
+def mlp_classifier():
+    """fit_a_line / recognize_digits style MLP."""
+    _reset()
+    x = L.data("x", DT.dense_vector(64))
+    y = L.data("y", DT.integer_value(10))
+    h = L.fc(x, 32, act="tanh")
+    logits = L.fc(h, 10)
+    L.classification_cost(logits, y)
+    return fluid.default_main_program()
+
+
+def lstm_text_model():
+    """quick_start LSTM text classification."""
+    _reset()
+    words = L.data("words", DT.integer_value_sequence(100))
+    label = L.data("label", DT.integer_value(2))
+    emb = L.embedding(words, 16)
+    lstm = L.lstmemory(emb, 16)
+    pooled = L.pooling(lstm, "max")
+    L.classification_cost(L.fc(pooled, 2), label)
+    return fluid.default_main_program()
+
+
+def mixed_projection_model():
+    """Mixed-layer projection algebra (the gen-1 signature surface)."""
+    _reset()
+    x = L.data("x", DT.dense_vector(8))
+    ids = L.data("ids", DT.integer_value(20))
+    out = L.mixed_layer(size=8, input=[
+        L.full_matrix_projection(x, 8),
+        L.identity_projection(x),
+        L.table_projection(ids, 8),
+    ], act="relu", bias_attr=True)
+    L.mse_cost(out, L.data("t", DT.dense_vector(8)))
+    return fluid.default_main_program()
+
+
+CONFIGS = {
+    "mlp_classifier": mlp_classifier,
+    "lstm_text_model": lstm_text_model,
+    "mixed_projection_model": mixed_projection_model,
+}

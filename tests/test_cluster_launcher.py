@@ -1,0 +1,25 @@
+"""cluster_train launcher (scripts/cluster_train/paddle.py / fabric/openmpi
+analogs): N workers join one jax.distributed job via PADDLE_TPU_* env and
+train data-parallel; worker failure tears the job down."""
+
+import os
+import sys
+
+from paddle_tpu.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tests", "cluster_train_script.py")
+
+
+def test_cluster_train_two_workers():
+    rc = cli_main(["cluster_train", SCRIPT, "--num_workers", "2",
+                   "--devices_per_worker", "2", "--timeout", "240"])
+    assert rc == 0
+
+
+def test_cluster_train_propagates_failure(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    rc = cli_main(["cluster_train", str(bad), "--num_workers", "2",
+                   "--devices_per_worker", "1", "--timeout", "60"])
+    assert rc != 0

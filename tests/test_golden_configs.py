@@ -1,0 +1,50 @@
+"""Golden-program tests: the serialized Program JSON for representative
+configs must match the checked-in goldens — the trainer_config_helpers
+golden-proto discipline (configs/ generate proto, diff vs protostr/,
+SURVEY.md §4.4). A legitimate IR change regenerates via:
+
+    python tests/test_golden_configs.py --regen
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from golden_configs import CONFIGS
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "goldens")
+
+
+def _dump(program) -> str:
+    return json.dumps(program.to_dict(), indent=1, sort_keys=True,
+                      default=lambda o: f"<callable:{getattr(o, '__name__', type(o).__name__)}>")
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_config_matches_golden(name):
+    got = _dump(CONFIGS[name]())
+    path = os.path.join(GOLDEN_DIR, f"{name}.json")
+    assert os.path.exists(path), f"golden missing; regen: python {__file__} --regen"
+    want = open(path).read()
+    assert got == want, (
+        f"program for {name!r} drifted from its golden; if intentional, "
+        f"regenerate with: python {__file__} --regen")
+
+
+def test_build_is_deterministic():
+    a = _dump(CONFIGS["mlp_classifier"]())
+    b = _dump(CONFIGS["mlp_classifier"]())
+    assert a == b
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        for name, fn in CONFIGS.items():
+            with open(os.path.join(GOLDEN_DIR, f"{name}.json"), "w") as f:
+                f.write(_dump(fn()))
+            print("wrote", name)

@@ -97,3 +97,58 @@ def test_sparse_matches_dense_sgd():
     sr = sparse.embedding_grad_rows(ids, out_grad, 8)
     sparse_new = sparse.sgd_sparse_update(table, sr, 0.1)
     np.testing.assert_allclose(np.asarray(dense_new), np.asarray(sparse_new), rtol=1e-5)
+
+
+def test_csr_csc_general_sparse_matmul():
+    """General sparse beyond row-sparse: CSR/CSC/COO constructors and
+    differentiable sparse-dense matmuls (math/CpuSparseMatrix.h,
+    SparseMatrix.h) on the BCOO backend."""
+    import jax
+
+    from paddle_tpu.ops import sparse as sp
+
+    rs = np.random.RandomState(0)
+    dense_m = rs.randn(4, 6).astype(np.float32)
+    dense_m[rs.rand(4, 6) < 0.6] = 0.0
+
+    # CSR arrays from scipy-free construction
+    rows, cols = np.nonzero(dense_m)
+    vals = dense_m[rows, cols]
+    row_ptr = np.zeros(5, np.int64)
+    for r in rows:
+        row_ptr[r + 1] += 1
+    row_ptr = np.cumsum(row_ptr)
+
+    m_csr = sp.csr_matrix(vals, cols, row_ptr, (4, 6))
+    np.testing.assert_allclose(np.asarray(sp.sparse_to_dense(m_csr)), dense_m)
+
+    # CSC of the same matrix
+    order = np.lexsort((rows, cols))
+    col_ptr = np.zeros(7, np.int64)
+    for c in cols:
+        col_ptr[c + 1] += 1
+    col_ptr = np.cumsum(col_ptr)
+    m_csc = sp.csc_matrix(vals[order], rows[order], col_ptr, (4, 6))
+    np.testing.assert_allclose(np.asarray(sp.sparse_to_dense(m_csc)), dense_m)
+
+    x = rs.randn(6, 3).astype(np.float32)
+    got = sp.sparse_dense_matmul(m_csr, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), dense_m @ x, rtol=1e-5,
+                               atol=1e-5)
+
+    y = rs.randn(2, 4).astype(np.float32)
+    got2 = sp.dense_sparse_matmul(jnp.asarray(y), m_csr)
+    np.testing.assert_allclose(np.asarray(got2), y @ dense_m, rtol=1e-5,
+                               atol=1e-5)
+
+    # differentiable w.r.t. the dense operand (sparse-input fc training path)
+    g = jax.grad(lambda w: (sp.sparse_dense_matmul(m_csr, w) ** 2).sum())(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g),
+                               2 * dense_m.T @ (dense_m @ x), rtol=1e-4,
+                               atol=1e-4)
+
+    # non-value (binary) format: all-ones values
+    m_bin = sp.csr_matrix(np.ones_like(vals), cols, row_ptr, (4, 6))
+    np.testing.assert_allclose(np.asarray(sp.sparse_to_dense(m_bin)),
+                               (dense_m != 0).astype(np.float32))

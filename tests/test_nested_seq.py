@@ -177,3 +177,74 @@ def test_v2_nested_pipeline_end_to_end():
     losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
               for _ in range(25)]
     assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_nested_recurrent_group_equals_flat_rnn():
+    """sequence_nest_rnn.conf equivalence at the user DSL: an outer
+    recurrent_group over sub-sequences whose inner recurrent_group's memory
+    boots from the outer memory (so state chains across sub-sequence
+    boundaries) must equal ONE flat recurrent_group over the flattened
+    tokens — the reference's hierarchical-RNN design contract
+    (gserver/tests/sequence_nest_rnn.conf vs sequence_rnn.conf)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import Executor
+    from paddle_tpu.v2 import layer as L
+    from paddle_tpu.v2.data_type import dense_vector_sequence
+
+    fluid.reset_default_programs()
+    B, S_, T, D, H = 2, 2, 3, 4, 5
+    r = np.random.RandomState(5)
+    nested_data = r.randn(B, S_, T, D).astype(np.float32)
+    flat_data = nested_data.reshape(B, S_ * T, D)
+
+    # ---- nested config: outer rg over sub-sequences, inner rg over tokens
+    x = L.data("x", dense_vector_sequence(D))        # fed [B, S*T... ] flat
+    # feed nested as [B, S, T, D] directly through a fresh data var
+    # (FL.data prepends the batch dim)
+    from paddle_tpu.fluid import layers as FL
+    xn = FL.data("xn", shape=(-1, -1, D))
+    xn_lo = L.LayerOutput(xn)
+    sublen = FL.data("sublen", shape=(-1,), dtype="int32")      # [B, S]
+
+    def outer_step(x_seq, sub_len):
+        outer_mem = L.memory("outer_state", H)
+        inner_in = L.LayerOutput(x_seq.var, sub_len.var)
+
+        def inner_step(y):
+            inner_mem = L.memory("inner_state", H, boot_layer=outer_mem)
+            return L.fc([y, inner_mem], H, act="tanh", bias_attr=True,
+                        name="inner_state")
+
+        inner_out = L.recurrent_group(inner_step, inner_in)
+        last = L.last_seq(inner_out)
+        L.identity(last, name="outer_state")
+        return inner_out
+
+    nested_out = L.recurrent_group(
+        outer_step, [xn_lo, L.LayerOutput(sublen)])
+
+    # ---- flat config: one rg over all tokens
+    def flat_step(y):
+        mem = L.memory("state", H)
+        return L.fc([y, mem], H, act="tanh", bias_attr=True, name="state")
+
+    flat_out = L.recurrent_group(flat_step, x)
+
+    exe = Executor()
+    exe.run(fluid.default_startup_program())
+    # share weights: copy the nested rg's fc params onto the flat rg's
+    params = [n for n, v in
+              fluid.default_main_program().global_block().vars.items()
+              if v.persistable and v.trainable]
+    assert len(params) == 4, params      # (w, b) x 2 configs
+    nested_p, flat_p = params[:2], params[2:]
+    for a, b in zip(nested_p, flat_p):
+        exe.scope.set(b, exe.scope.get(a))
+
+    feeds = {"xn": nested_data,
+             "sublen": np.full((B, S_), T, np.int32),
+             "x": flat_data, "x__len__": np.full((B,), S_ * T, np.int32)}
+    nv, fv = exe.run(fluid.default_main_program(), feed=feeds,
+                     fetch_list=[nested_out.var.name, flat_out.var.name])
+    nv = np.asarray(nv).reshape(B, S_ * T, H)
+    np.testing.assert_allclose(nv, np.asarray(fv), rtol=2e-5, atol=2e-6)

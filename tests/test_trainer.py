@@ -229,3 +229,20 @@ def test_eval_outputs_fused_into_step():
     # traced by the fused train step -> at most a couple of traces (train step
     # compile + optional standalone uses), NOT once per batch
     assert calls["n"] <= 2, f"outputs_fn traced {calls['n']} times"
+
+
+def test_benchmark_with_xla_profile(tmp_path):
+    """--job=time with an XLA trace (hl_profiler / test_GpuProfiler.cpp
+    analog): trace artifacts must land in the log dir."""
+    from paddle_tpu.utils import profiler
+
+    model = _MLP()
+    trainer = Trainer(_loss(model), SGD(0.1))
+    params = model.init(jax.random.PRNGKey(0))
+    d = str(tmp_path / "trace")
+    res = trainer.benchmark(_reader(), params,
+                            feeder=lambda rows: _feeder.feed(rows),
+                            warmup=1, iters=2, profile_dir=d)
+    assert res["ms_per_batch"] > 0
+    files = profiler.trace_files(d)
+    assert files, f"no .xplane.pb produced under {d}"
