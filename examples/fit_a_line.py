@@ -1,0 +1,24 @@
+"""fit_a_line demo config (fluid/tests/book/test_fit_a_line analog).
+
+Run: python -m paddle_tpu train --config examples/fit_a_line.py --num_passes 5
+"""
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.data.dataset import uci_housing
+
+x = paddle.layer.data("x", paddle.data_type.dense_vector(13))
+y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+pred = paddle.layer.fc(x, 1)
+cost = paddle.layer.square_error_cost(pred, y)
+
+optimizer = paddle.optimizer.SGD(0.01)
+feeding = [x, y]
+outputs = [pred]
+
+
+def train_reader():
+    return paddle.batch(uci_housing.train(256), 64)()
+
+
+def test_reader():
+    return paddle.batch(uci_housing.test(64), 64)()

@@ -1,0 +1,29 @@
+"""quick_start text-classification demo (v1_api_demo/quick_start LSTM config
+analog: embedding -> LSTM -> max pool -> softmax).
+
+Run: python -m paddle_tpu train --config examples/quick_start_sentiment.py
+"""
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.data.dataset import imdb
+
+words = paddle.layer.data(
+    "words", paddle.data_type.integer_value_sequence(imdb.VOCAB))
+label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+emb = paddle.layer.embedding(words, 32)
+lstm = paddle.networks.simple_lstm(emb, 32)
+pooled = paddle.layer.pooling(lstm, "max")
+logits = paddle.layer.fc(pooled, 2)
+cost = paddle.layer.classification_cost(logits, label)
+
+optimizer = paddle.optimizer.Adam(1e-2)
+feeding = [words, label]
+outputs = [logits]
+
+
+def train_reader():
+    return paddle.batch(imdb.train(256), 32)()
+
+
+def test_reader():
+    return paddle.batch(imdb.test(64), 32)()

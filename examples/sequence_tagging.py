@@ -1,0 +1,30 @@
+"""sequence_tagging demo (v1_api_demo/sequence_tagging linear_crf analog:
+embedding -> BiLSTM-ish projection -> linear-chain CRF cost).
+
+Run: python -m paddle_tpu train --config examples/sequence_tagging.py
+"""
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.data.dataset import conll05
+
+L = paddle.layer
+
+words = L.data("words",
+               paddle.data_type.integer_value_sequence(conll05.VOCAB))
+tags = L.data("tags",
+              paddle.data_type.integer_value_sequence(conll05.TAGS))
+emb = L.embedding(words, 24)
+hidden = L.lstmemory(emb, 24)
+emission = L.mixed_layer(
+    size=conll05.TAGS,
+    input=[L.full_matrix_projection(hidden, conll05.TAGS)])
+emission = L.LayerOutput(emission.var, hidden.lengths, hidden.input_type)
+cost = L.crf_layer(emission, tags)
+
+optimizer = paddle.optimizer.Adam(5e-3)
+feeding = [words, tags]
+outputs = [emission]
+
+
+def train_reader():
+    return paddle.batch(conll05.train(128), 16)()

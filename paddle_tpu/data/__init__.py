@@ -13,13 +13,13 @@ Re-provides the reference's data stack (SURVEY.md §2.4):
 """
 
 from .reader import (map_readers, shuffle, chain, compose, buffered, firstn,
-                     xmap_readers, cache, batch)
+                     xmap_readers, cache, batch, mix)
 from .feeder import (DataFeeder, DenseSlot, IndexSlot, SeqSlot, SparseSlot,
                      to_lod_batch)
 from .prefetch import DoubleBuffer
 from . import dataset
 
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
-           "xmap_readers", "cache", "batch",
+           "xmap_readers", "cache", "batch", "mix",
            "DataFeeder", "DenseSlot", "IndexSlot", "SeqSlot", "SparseSlot",
            "to_lod_batch", "DoubleBuffer", "dataset"]

@@ -200,3 +200,26 @@ def batch(reader_creator: Reader, batch_size: int, drop_last: bool = False) -> R
             yield b
 
     return reader
+
+
+def mix(readers_with_ratios, seed: int = 0) -> Reader:
+    """Ratio-mixed interleave of sub-readers — the MultiDataProvider.cpp
+    analog (gserver/dataproviders/MultiDataProvider: sub-providers sampled by
+    configured ratios). ``readers_with_ratios``: [(reader, weight), ...] with
+    strictly positive weights; exhausted sub-readers drop out and the rest
+    renormalise."""
+    if any(w <= 0 for _, w in readers_with_ratios):
+        raise ValueError("mix() weights must be strictly positive")
+
+    def reader():
+        rng = _random.Random(seed)
+        its = [iter(r()) for r, _ in readers_with_ratios]
+        weights = [float(w) for _, w in readers_with_ratios]
+        while its:
+            i = rng.choices(range(len(its)), weights=weights)[0]
+            try:
+                yield next(its[i])
+            except StopIteration:
+                del its[i], weights[i]
+
+    return reader

@@ -174,3 +174,21 @@ def test_flowers_voc_datasets():
     img, mask = next(iter(voc2012.train(2)()))
     assert img.shape == (64, 64, 3) and mask.shape == (64, 64)
     assert mask.max() < voc2012.CLASSES
+
+
+def test_mix_reader_ratio_and_drain():
+    """MultiDataProvider analog: ratio-weighted interleave, exhausted
+    sub-readers drop out, every sample eventually delivered."""
+    from paddle_tpu.data import mix
+
+    a = lambda: iter([("a", i) for i in range(30)])
+    b = lambda: iter([("b", i) for i in range(10)])
+    got = list(mix([(a, 3.0), (b, 1.0)], seed=0)())
+    assert len(got) == 40
+    assert sum(1 for s in got if s[0] == "a") == 30
+    first20 = [s[0] for s in got[:20]]
+    assert first20.count("a") > first20.count("b")   # ratio bias visible
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        mix([(a, 1.0), (b, 0.0)])

@@ -1,0 +1,34 @@
+"""The examples/ demo configs (v1_api_demo / book-test analogs) train through
+the real CLI — the reference's demo-as-acceptance-test discipline."""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu"] + list(args),
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return out.stdout
+
+
+@pytest.mark.parametrize("config,passes", [
+    ("examples/fit_a_line.py", "4"),
+    ("examples/quick_start_sentiment.py", "2"),
+    ("examples/sequence_tagging.py", "2"),
+])
+def test_example_trains_and_cost_falls(config, passes):
+    out = _run_cli("train", "--config", config, "--num_passes", passes,
+                   "--log_period", "1")
+    costs = [float(m) for m in re.findall(r"cost ([-\d.]+)", out)]
+    assert len(costs) >= 2, out
+    assert costs[-1] < costs[0], out
