@@ -18,7 +18,6 @@ hidden = L.lstmemory(emb, 24)
 emission = L.mixed_layer(
     size=conll05.TAGS,
     input=[L.full_matrix_projection(hidden, conll05.TAGS)])
-emission = L.LayerOutput(emission.var, hidden.lengths, hidden.input_type)
 cost = L.crf_layer(emission, tags)
 
 optimizer = paddle.optimizer.Adam(5e-3)

@@ -1,0 +1,63 @@
+/* Dense-input inference from plain C — the capi/examples/model_inference/
+ * dense analog. Links against libpaddle_tpu_capi.so; the library embeds
+ * CPython and runs the real XLA executor on the exported bundle.
+ *
+ * Build: gcc infer_dense.c -o infer_dense -L../.. -lpaddle_tpu_capi
+ * Run:   ./infer_dense <model_dir> <n_rows> <in_dim>
+ * Prints one line per output row; exit 0 on success.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* pti_create(const char* model_dir);
+extern int pti_forward(void* h, const void** inputs, const long long* shapes,
+                       const int* ndims, const int* dtypes, int n_inputs,
+                       int fetch_index, float* out_buf, long long out_capacity,
+                       long long* out_shape, int* out_ndim);
+extern void pti_destroy(void* h);
+extern const char* pti_last_error(void);
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <model_dir> <n_rows> <in_dim>\n", argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  int n = atoi(argv[2]);
+  int d = atoi(argv[3]);
+
+  void* h = pti_create(model_dir);
+  if (!h) {
+    fprintf(stderr, "create failed: %s\n", pti_last_error());
+    return 1;
+  }
+
+  float* in = malloc(sizeof(float) * n * d);
+  for (int i = 0; i < n * d; i++) in[i] = (float)(i % 7) * 0.1f - 0.3f;
+
+  const void* inputs[1] = {in};
+  long long shapes[2] = {n, d};
+  int ndims[1] = {2};
+  int dtypes[1] = {0}; /* f32 */
+  long long cap = 1 << 20;
+  float* out = malloc(sizeof(float) * cap);
+  long long out_shape[8];
+  int out_ndim = 0;
+
+  int rc = pti_forward(h, inputs, shapes, ndims, dtypes, 1, 0, out, cap,
+                       out_shape, &out_ndim);
+  if (rc < 0) {
+    fprintf(stderr, "forward failed (%d): %s\n", rc, pti_last_error());
+    return 1;
+  }
+  long long cols = out_ndim >= 2 ? out_shape[1] : 1;
+  for (long long r = 0; r < out_shape[0]; r++) {
+    for (long long c = 0; c < cols; c++)
+      printf("%s%.6f", c ? " " : "", out[r * cols + c]);
+    printf("\n");
+  }
+  free(in);
+  free(out);
+  pti_destroy(h);
+  return 0;
+}

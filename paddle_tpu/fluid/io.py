@@ -51,7 +51,16 @@ def export_inference_model(dirname: str, feed_names, fetch_vars,
     fetch_names = [v.name if hasattr(v, "name") else str(v) for v in fetch_vars]
     pruned = program.prune(fetch_names)
     os.makedirs(dirname, exist_ok=True)
-    meta = {"program": pruned.to_dict(),
+    prog_dict = pruned.to_dict()
+    # forward-only bundle: route recurrent ops through the fused Pallas
+    # sequence kernel (no autodiff replay cost on an inference program).
+    # Marked on the SERIALIZED dict — prune() shares live op objects with
+    # the source program, which must keep training un-fused.
+    for block in prog_dict["blocks"]:
+        for op in block["ops"]:
+            if op["type"] == "lstm":
+                op["attrs"] = dict(op["attrs"], fused=True)
+    meta = {"program": prog_dict,
             "feed_names": list(feed_names),
             "fetch_names": fetch_names}
     with open(os.path.join(dirname, "model.json"), "w") as f:

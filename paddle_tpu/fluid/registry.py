@@ -356,7 +356,10 @@ def _lstm(ins, attrs):
                       ins["W"][0], ins["U"][0],
                       ins["B"][0] if "B" in ins else None,
                       reverse=attrs.get("reverse", False),
-                      forget_bias=attrs.get("forget_bias", 1.0))
+                      forget_bias=attrs.get("forget_bias", 1.0),
+                      # inference bundles set this at export: forward-only
+                      # programs run the fused Pallas sequence kernel
+                      fused=attrs.get("fused", False))
     return {"Out": [out], "LastH": [state.h], "LastC": [state.c]}
 
 

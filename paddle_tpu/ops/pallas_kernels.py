@@ -387,3 +387,113 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused LSTM sequence kernel — the hl_cuda_lstm.cu analog: the entire T-step
+# recurrence runs inside ONE kernel with the recurrent weights and the h/c
+# state resident in VMEM, so the per-step state never round-trips HBM the way
+# a lax.scan's carry does. The input projection x@W stays outside (one big
+# MXU matmul); the kernel consumes the precomputed gates input [B, T, 4H].
+# ---------------------------------------------------------------------------
+
+def _lstm_seq_kernel(xw_ref, len_ref, u_ref, b_ref, h0_ref, c0_ref,
+                     out_ref, ht_ref, ct_ref, *, T: int, H: int,
+                     forget_bias: float):
+    """One batch-tile program: xw [T, Bb, 4H] (TIME-MAJOR — dynamic indexing
+    is only legal on the leading, untiled dim), lengths [Bb, 1] f32 (mask
+    computed in-kernel: no dynamic lane loads), u [H, 4H], b [1, 4H],
+    h0/c0 [Bb, H] -> out [T, Bb, H], hT/cT [Bb, H]."""
+    u = u_ref[...].astype(jnp.float32)
+    bias = b_ref[...].astype(jnp.float32)
+    lens = len_ref[...].astype(jnp.float32)          # [Bb, 1]
+    h0 = h0_ref[...].astype(jnp.float32)
+    c0 = c0_ref[...].astype(jnp.float32)
+
+    def step(t, carry):
+        h, c = carry
+        xw_t = xw_ref[t].astype(jnp.float32)
+        gates = xw_t + jax.lax.dot(h, u,
+                                   preferred_element_type=jnp.float32) + bias
+        i = jax.nn.sigmoid(gates[:, :H])
+        f = jax.nn.sigmoid(gates[:, H:2 * H] + forget_bias)
+        g = jnp.tanh(gates[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(gates[:, 3 * H:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        m = (t.astype(jnp.float32) < lens).astype(jnp.float32)   # [Bb, 1]
+        h = m * h_new + (1.0 - m) * h
+        c = m * c_new + (1.0 - m) * c
+        out_ref[t] = (m * h).astype(out_ref.dtype)
+        return h, c
+
+    h, c = jax.lax.fori_loop(0, T, step, (h0, c0))
+    ht_ref[...] = h.astype(ht_ref.dtype)
+    ct_ref[...] = c.astype(ct_ref.dtype)
+
+
+def lstm_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
+                        b: Optional[jax.Array] = None,
+                        h0: Optional[jax.Array] = None,
+                        c0: Optional[jax.Array] = None, *,
+                        forget_bias: float = 0.0, block_b: int = 8,
+                        interpret: Optional[bool] = None):
+    """Masked LSTM over a whole sequence in one Pallas kernel.
+
+    xw: precomputed x@W [B, T, 4H]; lengths: [B] int; u: [H, 4H];
+    returns (out [B, T, H], hT [B, H], cT [B, H]).
+
+    Forward-path kernel (inference / frozen encoders): gradients flow through
+    the lax.scan implementation in ops/rnn.py, which computes identical math
+    — use this where the reference used the fused hl_lstm forward kernels.
+    """
+    B, T, G = xw.shape
+    if G % 4:
+        raise ValueError(f"xw last dim {G} must be 4*H (i/f/g/o gates)")
+    H = G // 4
+    if interpret is None:
+        interpret = not _on_tpu()
+    if b is None:
+        b = jnp.zeros((G,), xw.dtype)
+    if h0 is None:
+        h0 = jnp.zeros((B, H), xw.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, H), xw.dtype)
+    blk = min(block_b, B)
+    Bp = -(-B // blk) * blk
+    lens = lengths.astype(jnp.float32).reshape(B, 1)
+    if Bp > B:
+        pad = Bp - B
+        xw = jnp.pad(xw, ((0, pad), (0, 0), (0, 0)))
+        lens = jnp.pad(lens, ((0, pad), (0, 0)))
+        h0 = jnp.pad(h0, ((0, pad), (0, 0)))
+        c0 = jnp.pad(c0, ((0, pad), (0, 0)))
+    xw_tm = jnp.swapaxes(xw, 0, 1)               # time-major [T, Bp, 4H]
+    b2 = b.reshape(1, G)
+
+    kernel = functools.partial(_lstm_seq_kernel, T=T, H=H,
+                               forget_bias=forget_bias)
+    out, ht, ct = pl.pallas_call(
+        kernel,
+        grid=(Bp // blk,),
+        in_specs=[
+            pl.BlockSpec((T, blk, G), lambda i: (0, i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((H, G), lambda i: (0, 0)),
+            pl.BlockSpec((1, G), lambda i: (0, 0)),
+            pl.BlockSpec((blk, H), lambda i: (i, 0)),
+            pl.BlockSpec((blk, H), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((T, blk, H), lambda i: (0, i, 0)),
+            pl.BlockSpec((blk, H), lambda i: (i, 0)),
+            pl.BlockSpec((blk, H), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((Bp, H), xw.dtype),
+        ],
+        interpret=bool(interpret),
+    )(xw_tm, lens, u, b2, h0, c0)
+    return jnp.swapaxes(out, 0, 1)[:B], ht[:B], ct[:B]

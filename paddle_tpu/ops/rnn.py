@@ -19,7 +19,10 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple, Optional, Tuple
 
+import functools
+
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
@@ -68,12 +71,56 @@ def gru_cell(xw: jax.Array, h: jax.Array, u: jax.Array,
 def lstm(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
          b: Optional[jax.Array] = None, h0: Optional[jax.Array] = None,
          c0: Optional[jax.Array] = None, reverse: bool = False,
-         forget_bias: float = 0.0) -> Tuple[jax.Array, LSTMState]:
+         forget_bias: float = 0.0,
+         fused: Optional[bool] = None) -> Tuple[jax.Array, LSTMState]:
     """Full-sequence LSTM. x: [B, T, D]; w: [D, 4H]; u: [H, 4H].
 
     Returns (outputs [B, T, H], final LSTMState). Masked: for t >= length the state
     carries through unchanged and the output is zero (LoD semantics — downstream
-    sequence pooling then ignores padding for free)."""
+    sequence pooling then ignores padding for free).
+
+    ``fused=True`` routes the forward pass through the Pallas whole-sequence
+    kernel (hl_cuda_lstm.cu analog: u and h/c resident in VMEM for all T
+    steps); both paths compute identical math. Use it on forward-only paths
+    (inference bundles set it automatically at export,
+    fluid/io.py export_inference_model) — under autodiff the backward
+    replays the scan, so training should keep the default.
+    """
+    if fused is None:
+        fused = False
+    if fused and not reverse:
+        from . import pallas_kernels as _pk
+        B, T, _ = x.shape
+        H = u.shape[0]
+        blk = _fused_block_b(T, H)
+        if not _pk._on_tpu() or blk is None:
+            # off-TPU, or the sequence is too long for the whole-sequence
+            # tile to fit VMEM even at block_b=1 — the scan handles any shape
+            return _lstm_scan(x, lengths, w, u, b, h0, c0, reverse,
+                              forget_bias)
+        lens = (lengths if lengths is not None
+                else jnp.full((B,), T, jnp.int32))
+        b_ = b if b is not None else jnp.zeros((4 * H,), x.dtype)
+        h0_ = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+        c0_ = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+        out, ht, ct = _lstm_fused(x, lens, w, u, b_, h0_, c0_, forget_bias,
+                                  blk)
+        return out, LSTMState(ht, ct)
+    return _lstm_scan(x, lengths, w, u, b, h0, c0, reverse, forget_bias)
+
+
+def _fused_block_b(T: int, H: int, budget_bytes: int = 10_000_000):
+    """Largest batch tile whose whole-sequence VMEM working set (xw + out
+    blocks, double-buffered, plus resident u) fits; None -> use the scan."""
+    u_bytes = H * 4 * H * 4
+    for blk in (8, 4, 2, 1):
+        tile = T * blk * (4 * H + H) * 4 * 2      # xw + out, double-buffered
+        if u_bytes + tile <= budget_bytes:
+            return blk
+    return None
+
+
+def _lstm_scan(x, lengths, w, u, b, h0, c0, reverse, forget_bias):
     B, T, D = x.shape
     H = u.shape[0]
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)  # one MXU pass
@@ -94,6 +141,39 @@ def lstm(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
     xs = (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(mask, 0, 1))  # [T, B, ...]
     (h, c), ys = lax.scan(step, (h, c), xs, reverse=reverse)
     return jnp.swapaxes(ys, 0, 1), LSTMState(h, c)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def _lstm_fused(x, lens, w, u, b, h0, c0, forget_bias, block_b):
+    """Forward through the Pallas fused kernel; backward recomputes through
+    the (bit-identical) scan implementation — the hand-kernel-forward /
+    autodiff-backward split of the reference's fused hl_lstm."""
+    from .pallas_kernels import lstm_sequence_fused
+    B, T, D = x.shape
+    xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
+    return lstm_sequence_fused(xw, lens, u, b, h0=h0, c0=c0,
+                               forget_bias=forget_bias, block_b=block_b)
+
+
+def _lstm_fused_fwd(x, lens, w, u, b, h0, c0, forget_bias, block_b):
+    out = _lstm_fused(x, lens, w, u, b, h0, c0, forget_bias, block_b)
+    return out, (x, lens, w, u, b, h0, c0)
+
+
+def _lstm_fused_bwd(forget_bias, block_b, res, g):
+    x, lens, w, u, b, h0, c0 = res
+
+    def replay(x, w, u, b, h0, c0):
+        out, state = _lstm_scan(x, lens, w, u, b, h0, c0, False, forget_bias)
+        return out, state.h, state.c
+
+    _, vjp = jax.vjp(replay, x, w, u, b, h0, c0)
+    dx, dw, du, db, dh0, dc0 = vjp(g)
+    zero_lens = np.zeros(lens.shape, jax.dtypes.float0)
+    return dx, zero_lens, dw, du, db, dh0, dc0
+
+
+_lstm_fused.defvjp(_lstm_fused_fwd, _lstm_fused_bwd)
 
 
 def gru(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,

@@ -65,3 +65,24 @@ def trace_files(logdir: str):
     """The .xplane.pb artifacts produced under ``logdir``."""
     return sorted(glob.glob(os.path.join(logdir, "plugins", "profile",
                                          "*", "*.xplane.pb")))
+
+
+def device_memory_stats(device=None) -> dict:
+    """Live HBM statistics for a device (the memory/ observability the
+    reference exposed through its allocator counters): bytes_in_use,
+    peak_bytes_in_use, bytes_limit where the backend reports them."""
+    if device is None:
+        device = jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+def save_device_memory_profile(path: str, backend: Optional[str] = None):
+    """Dump a pprof-format device memory profile (jax.profiler
+    .save_device_memory_profile) — who holds HBM right now.
+
+    Backend-dependent: some remote PJRT plugins (e.g. tunneled dev chips)
+    do not implement the heap-profile callbacks and abort the process —
+    call on direct-attached devices / the CPU backend."""
+    jax.profiler.save_device_memory_profile(path, backend=backend)
+    return path

@@ -558,9 +558,10 @@ def _shape(l: LayerOutput):
 # Scaling/Identity/Slice projections, DotMulOperator).
 
 class _Projection:
-    def __init__(self, emit, size):
+    def __init__(self, emit, size, src: Optional[LayerOutput] = None):
         self.emit = emit        # () -> Variable with last dim == size
         self.size = size
+        self.src = src          # source layer (sequence metadata propagation)
 
 
 def full_matrix_projection(input: LayerOutput, size: int) -> _Projection:
@@ -572,7 +573,7 @@ def full_matrix_projection(input: LayerOutput, size: int) -> _Projection:
         return _emit("mul", {"X": [input.var.name], "Y": [w.name]},
                      {"x_num_col_dims": len(_shape(input)) - 1},
                      out_shape=_shape(input)[:-1] + (size,))
-    return _Projection(emit, size)
+    return _Projection(emit, size, src=input)
 
 
 def trans_full_matrix_projection(input: LayerOutput, size: int) -> _Projection:
@@ -584,7 +585,7 @@ def trans_full_matrix_projection(input: LayerOutput, size: int) -> _Projection:
         return _emit("matmul", {"X": [input.var.name], "Y": [w.name]},
                      {"transpose_Y": True},
                      out_shape=_shape(input)[:-1] + (size,))
-    return _Projection(emit, size)
+    return _Projection(emit, size, src=input)
 
 
 def table_projection(input: LayerOutput, size: int) -> _Projection:
@@ -597,7 +598,7 @@ def table_projection(input: LayerOutput, size: int) -> _Projection:
                                  I.normal(0.0, 0.01))
         return _emit("lookup_table", {"W": [w.name], "Ids": [input.var.name]},
                      out_shape=_shape(input) + (size,))
-    return _Projection(emit, size)
+    return _Projection(emit, size, src=input)
 
 
 def identity_projection(input: LayerOutput, offset: Optional[int] = None,
@@ -605,7 +606,7 @@ def identity_projection(input: LayerOutput, offset: Optional[int] = None,
     """IdentityProjection / IdentityOffsetProjection (feature slice)."""
     in_dim = _shape(input)[-1]
     if offset is None:
-        return _Projection(lambda: input.var, in_dim)
+        return _Projection(lambda: input.var, in_dim, src=input)
     end = offset + (size or (in_dim - offset))
     def emit():
         ndim = len(_shape(input))
@@ -614,7 +615,7 @@ def identity_projection(input: LayerOutput, offset: Optional[int] = None,
         return _emit("crop", {"X": [input.var.name]},
                      {"offsets": starts, "shape": shape},
                      out_shape=_shape(input)[:-1] + (end - offset,))
-    return _Projection(emit, end - offset)
+    return _Projection(emit, end - offset, src=input)
 
 
 def dotmul_projection(input: LayerOutput) -> _Projection:
@@ -625,7 +626,7 @@ def dotmul_projection(input: LayerOutput) -> _Projection:
         return _emit("elementwise_mul",
                      {"X": [input.var.name], "Y": [w.name]},
                      out_shape=_shape(input))
-    return _Projection(emit, in_dim)
+    return _Projection(emit, in_dim, src=input)
 
 
 def scaling_projection(input: LayerOutput) -> _Projection:
@@ -636,7 +637,7 @@ def scaling_projection(input: LayerOutput) -> _Projection:
         return _emit("elementwise_mul",
                      {"X": [input.var.name], "Y": [w.name]},
                      out_shape=_shape(input))
-    return _Projection(emit, in_dim)
+    return _Projection(emit, in_dim, src=input)
 
 
 def context_projection_layer(input: LayerOutput, context_len: int,
@@ -651,7 +652,7 @@ def context_projection_layer(input: LayerOutput, context_len: int,
                       "Lengths": [input.lengths.name]},
                      {"context_length": context_len, "context_start": start},
                      out_shape=_shape(input)[:-1] + (size,))
-    return _Projection(emit, size)
+    return _Projection(emit, size, src=input)
 
 
 def dotmul_operator(a: LayerOutput, b: LayerOutput,
@@ -665,7 +666,7 @@ def dotmul_operator(a: LayerOutput, b: LayerOutput,
             return prod
         return _emit("scale", {"X": [prod.name]}, {"scale": scale},
                      out_shape=_shape(a))
-    return _Projection(emit, in_dim)
+    return _Projection(emit, in_dim, src=a)
 
 
 def mixed_layer(size: Optional[int] = None, input=None,
@@ -692,6 +693,12 @@ def mixed_layer(size: Optional[int] = None, input=None,
     if act:
         acc = _emit(act, {"X": [acc.name]}, out_shape=tuple(acc.shape))
     _register_named(name, acc)
+    # propagate sequence metadata from the first sequence-typed source so a
+    # mixed_layer output feeds seq layers (crf, pooling) without rewrapping
+    seq_src = next((p.src for p in projs
+                    if p.src is not None and p.src.lengths is not None), None)
+    if seq_src is not None:
+        return LayerOutput(acc, seq_src.lengths, seq_src.input_type)
     return LayerOutput(acc)
 
 

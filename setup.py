@@ -1,0 +1,26 @@
+"""Build shim: `pip install .` also builds the native host runtime when a
+toolchain is present (the CMake WITH_* option surface of the reference's
+build, reduced to one make invocation; paddle_tpu.runtime.lib falls back to
+pure-Python stand-ins when the .so is absent)."""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        native = os.path.join(here, "native")
+        if os.path.isdir(native):
+            try:
+                subprocess.run(["make", "-C", native], check=True)
+            except (OSError, subprocess.CalledProcessError) as e:
+                print(f"[paddle_tpu] native build skipped ({e}); "
+                      f"runtime falls back to gated pure-Python paths")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -137,3 +137,47 @@ def test_capi_small_buffer_reports_size(tmp_path):
     assert rc == -2          # too small; shape still reported for retry
     assert tuple(out_shape[i] for i in range(out_ndim.value)) == (3, 2)
     lib.pti_destroy(h)
+
+
+def test_c_example_program_standalone(tmp_path):
+    """capi/examples/model_inference/dense analog: a REAL C program compiled
+    with gcc, linked against the capi .so, run as its own process (its own
+    embedded-CPython init — ensure_python's cold path), output compared to
+    the in-process executor."""
+    import subprocess
+
+    import shutil
+
+    _load()   # skip if lib not built
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    d, _, _ = _export_model(tmp_path)
+    src = os.path.join(REPO, "native", "examples", "infer_dense.c")
+    exe = str(tmp_path / "infer_dense")
+    lib_dir = os.path.join(REPO, "native")
+    cc = subprocess.run(
+        ["gcc", src, "-o", exe, "-L" + lib_dir, "-lpaddle_tpu_capi"],
+        capture_output=True, text=True)
+    assert cc.returncode == 0, cc.stderr
+
+    n, dim = 3, 4
+    env = dict(os.environ)
+    env["LD_LIBRARY_PATH"] = lib_dir + ":" + env.get("LD_LIBRARY_PATH", "")
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([exe, d, str(n), str(dim)], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rows = [list(map(float, line.split()))
+            for line in out.stdout.strip().splitlines()]
+    assert len(rows) == n and len(rows[0]) == 2
+
+    # compare against the same inputs through the Python host. The C
+    # program's embedded interpreter runs on the DEFAULT platform (the real
+    # TPU under the driver — the image's sitecustomize ignores JAX_PLATFORMS
+    # env) while this test process is pinned to CPU, so tolerances are the
+    # cross-backend matmul kind (TensorCheck tiering, SURVEY §7).
+    from paddle_tpu.runtime.capi_host import InferenceHost
+    x = (np.arange(n * dim) % 7).astype(np.float32) * 0.1 - 0.3
+    ref = InferenceHost(d).run([x.reshape(n, dim)])
+    np.testing.assert_allclose(np.asarray(rows), ref, rtol=5e-2, atol=5e-3)

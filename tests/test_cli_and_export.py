@@ -94,3 +94,45 @@ def test_export_load_inference_model(tmp_path):
     assert "autodiff_grad" not in types and "sgd" not in types
     got = exe2.run(prog, feed={"x": xs}, fetch_list=fetches)[0]
     np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_export_marks_lstm_ops_fused(tmp_path):
+    """Inference bundles route recurrent ops through the fused Pallas
+    sequence kernel (forward-only: no autodiff replay cost)."""
+    import json
+
+    import numpy as np
+
+    from paddle_tpu.v2 import layer as L
+    from paddle_tpu.v2.data_type import dense_vector_sequence
+
+    fluid.reset_default_programs()
+    x = L.data("x", dense_vector_sequence(4))
+    h = L.lstmemory(x, 6)
+    out = L.last_seq(h)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "m")
+    fluid.io.export_inference_model(d, ["x", "x__len__"], [out.var], exe)
+
+    meta = json.load(open(d + "/model.json"))
+    lstm_ops = [op for blk in meta["program"]["blocks"]
+                for op in blk["ops"] if op["type"] == "lstm"]
+    assert lstm_ops and all(op["attrs"].get("fused") for op in lstm_ops)
+    # the training program is untouched (fused would add bwd replay cost)
+    train_ops = [op for blk in fluid.default_main_program().blocks
+                 for op in blk.ops if op.type == "lstm"]
+    assert train_ops and not any(op.attrs.get("fused") for op in train_ops)
+
+    # loaded bundle still computes the same numbers (kernel == scan math)
+    exe2 = fluid.Executor()
+    prog, feeds, fetches = fluid.io.load_inference_model(d, exe2)
+    xs = np.random.RandomState(0).randn(3, 5, 4).astype(np.float32)
+    lens = np.array([5, 3, 2], np.int32)
+    got = exe2.run(prog, feed={"x": xs, "x__len__": lens},
+                   fetch_list=fetches)[0]
+    ref = exe.run(fluid.default_main_program().prune([out.var.name]),
+                  feed={"x": xs, "x__len__": lens},
+                  fetch_list=[out.var.name])[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)

@@ -90,3 +90,31 @@ def test_flash_backward_no_dense_scores_in_jaxpr():
             shape = getattr(var.aval, "shape", ())
             assert not (len(shape) >= 2 and shape[-1] == T and
                         shape[-2] == T), f"dense [T,T] tensor in bwd: {eqn}"
+
+
+@pytest.mark.parametrize("block_b", [2, 5])
+def test_lstm_sequence_fused_matches_scan(block_b):
+    """The fused whole-sequence LSTM kernel (hl_cuda_lstm.cu analog: u and
+    h/c resident in VMEM across all T steps) must match the lax.scan LSTM
+    bit-for-bit, including variable-length masking and padded batch tails."""
+    from paddle_tpu.ops import rnn as R
+    from paddle_tpu.ops.pallas_kernels import lstm_sequence_fused
+
+    rs = np.random.RandomState(3)
+    B, T, D, H = 5, 7, 4, 6
+    x = jnp.asarray(rs.randn(B, T, D), jnp.float32)
+    lens = jnp.asarray(rs.randint(1, T + 1, B), jnp.int32)
+    w = jnp.asarray(rs.randn(D, 4 * H) * 0.3, jnp.float32)
+    u = jnp.asarray(rs.randn(H, 4 * H) * 0.3, jnp.float32)
+    b = jnp.asarray(rs.randn(4 * H) * 0.1, jnp.float32)
+
+    ref_out, ref_state = R.lstm(x, lens, w, u, b, forget_bias=1.0)
+    xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, 4 * H)
+    out, ht, ct = lstm_sequence_fused(xw, lens, u, b, forget_bias=1.0,
+                                      block_b=block_b, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ht), np.asarray(ref_state.h),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ct), np.asarray(ref_state.c),
+                               rtol=1e-6, atol=1e-6)

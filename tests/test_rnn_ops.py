@@ -77,3 +77,21 @@ def test_bidirectional_concat(np_rng):
     out_b, _ = rnn.lstm(x[1:2, :3], jnp.array([3]), w2, u2, b2, reverse=True)
     np.testing.assert_allclose(np.asarray(out[1, :3, H:]), np.asarray(out_b[0]),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_fused_lstm_vmem_guard_falls_back():
+    """Long sequences must fall back to the scan (whole-sequence tile would
+    blow the VMEM budget) instead of failing to compile."""
+    from paddle_tpu.ops import rnn as R
+    assert R._fused_block_b(100, 256) == 8          # bench shape fits
+    assert R._fused_block_b(1024, 512) is None      # 64MB tile -> scan
+    # fused=True on a too-big shape silently uses the scan
+    rs = np.random.RandomState(0)
+    B, T, D, H = 2, 40, 3, 4
+    x = jnp.asarray(rs.randn(B, T, D), jnp.float32)
+    lens = jnp.asarray([40, 20], jnp.int32)
+    w = jnp.asarray(rs.randn(D, 4 * H) * 0.3, jnp.float32)
+    u = jnp.asarray(rs.randn(H, 4 * H) * 0.3, jnp.float32)
+    ref, _ = R.lstm(x, lens, w, u)
+    got, _ = R.lstm(x, lens, w, u, fused=True)      # CPU -> scan fallback
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
