@@ -50,8 +50,9 @@ int main(int argc, char** argv) {
     fprintf(stderr, "forward failed (%d): %s\n", rc, pti_last_error());
     return 1;
   }
+  long long rows_n = out_ndim >= 1 ? out_shape[0] : 1; /* 0-dim -> 1 value */
   long long cols = out_ndim >= 2 ? out_shape[1] : 1;
-  for (long long r = 0; r < out_shape[0]; r++) {
+  for (long long r = 0; r < rows_n; r++) {
     for (long long c = 0; c < cols; c++)
       printf("%s%.6f", c ? " " : "", out[r * cols + c]);
     printf("\n");

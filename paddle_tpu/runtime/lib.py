@@ -14,6 +14,10 @@ _tried = False
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SO = os.path.join(_NATIVE_DIR, "libpaddle_tpu_host.so")
+# wheel installs ship the .so inside the package (setup.py copies it here;
+# the repo-relative path above covers source checkouts)
+_PKG_SO = os.path.join(os.path.dirname(os.path.dirname(__file__)), "_native",
+                       "libpaddle_tpu_host.so")
 
 
 def load_library() -> Optional[ctypes.CDLL]:
@@ -22,15 +26,20 @@ def load_library() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if _needs_build():
+        if os.path.isdir(_NATIVE_DIR) and _needs_build():
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR, "-j4"],
                                check=True, capture_output=True, timeout=120)
             except Exception:
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+                pass      # fall through: a packaged .so may still exist
+        lib = None
+        for so in (_SO, _PKG_SO):
+            try:
+                lib = ctypes.CDLL(so)
+                break
+            except OSError:
+                continue
+        if lib is None:
             return None
         _configure(lib)
         _lib = lib

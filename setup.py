@@ -17,6 +17,16 @@ class BuildWithNative(build_py):
         if os.path.isdir(native):
             try:
                 subprocess.run(["make", "-C", native], check=True)
+                # ship the libraries INSIDE the package so wheel installs
+                # find them (runtime/lib.py checks paddle_tpu/_native/ after
+                # the repo-relative path)
+                import glob
+                import shutil
+                dest = os.path.join(here, "paddle_tpu", "_native")
+                os.makedirs(dest, exist_ok=True)
+                open(os.path.join(dest, "__init__.py"), "a").close()
+                for so in glob.glob(os.path.join(native, "*.so")):
+                    shutil.copy2(so, dest)
             except (OSError, subprocess.CalledProcessError) as e:
                 print(f"[paddle_tpu] native build skipped ({e}); "
                       f"runtime falls back to gated pure-Python paths")
