@@ -135,6 +135,69 @@ def cmd_merge_model(args):
     return 0
 
 
+def cmd_checkgrad(args):
+    """--job=checkgrad (TrainerMain.cpp:54 / Trainer::checkGradient): compare
+    the program's autodiff gradients against central differences on sampled
+    parameter entries, through the executor (LayerGradUtil semantics)."""
+    import numpy as np
+
+    from . import fluid
+    cfg = _load_config(args.config)
+    trainer = _make_trainer(cfg)
+    feeder = None
+    if cfg.get("feeding"):
+        from .v2.trainer import _V2Feeder
+        feeder = _V2Feeder(cfg["feeding"])
+    rows = next(iter(cfg["train_reader"]()))
+    feed = feeder(rows) if feeder else rows
+    exe = trainer.exe
+    prog = fluid.default_main_program()
+    cost_name = cfg["cost"].var.name
+    params = [v.name for v in prog.global_block().all_parameters()]
+    # pruned programs: running the full program would fire the optimizer
+    # update ops and mutate params between evaluations. Stochastic ops key
+    # off the implicit __step__ feed — pin it so every evaluation sees the
+    # SAME dropout masks / negative samples.
+    feed = dict(feed)
+    feed["__step__"] = 0
+    cost_prog = prog.prune([cost_name])
+    grad_names = [p + "@GRAD" for p in params]
+    grad_prog = prog.prune(grad_names)
+    all_grads = exe.run(grad_prog, feed=feed, fetch_list=grad_names)
+    rs = np.random.RandomState(0)
+    eps = args.eps
+    worst = 0.0
+    ok = True
+    for pname, grad in zip(params, all_grads):
+        grad = np.asarray(grad)
+        base = np.asarray(exe.scope.get(pname)).copy()
+        flat = base.reshape(-1)
+        for idx in rs.choice(flat.size,
+                             size=min(args.checks_per_param, flat.size),
+                             replace=False):
+            orig = flat[idx]
+            vals = {}
+            for sign in (+1, -1):
+                flat[idx] = orig + sign * eps
+                exe.scope.set(pname, base.reshape(base.shape))
+                vals[sign], = exe.run(cost_prog, feed=feed,
+                                      fetch_list=[cost_name])
+            flat[idx] = orig
+            exe.scope.set(pname, base.reshape(base.shape))
+            numeric = (float(vals[+1]) - float(vals[-1])) / (2 * eps)
+            analytic = float(grad.reshape(-1)[idx])
+            denom = max(abs(numeric), abs(analytic), 1e-6)
+            rel = abs(numeric - analytic) / denom
+            worst = max(worst, rel)
+            if rel > args.rtol:
+                print(f"MISMATCH {pname}[{idx}]: numeric {numeric:.6g} "
+                      f"analytic {analytic:.6g} rel {rel:.3g}")
+                ok = False
+    print(f"checkgrad {'PASS' if ok else 'FAIL'} "
+          f"({len(params)} params, worst rel err {worst:.3g})")
+    return 0 if ok else 1
+
+
 def cmd_cluster_train(args):
     """Local cluster launcher — the scripts/cluster_train/paddle.py (ssh) and
     cluster_train_v2 fabric/openmpi analog, process-model edition.
@@ -236,6 +299,13 @@ def main(argv=None) -> int:
     mm.add_argument("--model_path", required=True)
     mm.add_argument("--output_dir", required=True)
     mm.set_defaults(fn=cmd_merge_model)
+
+    cg = sub.add_parser("checkgrad")
+    common(cg)
+    cg.add_argument("--eps", type=float, default=5e-3)
+    cg.add_argument("--rtol", type=float, default=5e-2)
+    cg.add_argument("--checks_per_param", type=int, default=3)
+    cg.set_defaults(fn=cmd_checkgrad)
 
     ct = sub.add_parser("cluster_train")
     ct.add_argument("script", help="training script run by every worker")

@@ -112,7 +112,12 @@ def _trace_ops(ops, env: Dict[str, Any], ctx: TraceContext):
 def _trace_autodiff(op, ops, env, ctx: TraceContext):
     loss_name = op.attrs["loss"]
     param_names = list(op.attrs["params"])
-    n_fwd = op.attrs["num_fwd_ops"]
+    # forward = every op BEFORE this one in the CURRENT list (backward/
+    # optimizer ops are appended after it). The op's own position — not the
+    # recorded num_fwd_ops attr — stays correct after Program.prune drops
+    # dangling forward ops and shifts indices (a stale count would make the
+    # replay include this op itself and recurse forever).
+    n_fwd = ops.index(op)
     init_env = ctx.entry_env
 
     def replay(param_vals):

@@ -26,14 +26,18 @@ def load_library() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
+        candidates = [_SO, _PKG_SO]
         if os.path.isdir(_NATIVE_DIR) and _needs_build():
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR, "-j4"],
                                check=True, capture_output=True, timeout=120)
             except Exception:
-                pass      # fall through: a packaged .so may still exist
+                # build failed with sources newer than the repo .so: loading
+                # that stale binary against new argtypes is the old-ABI
+                # hazard — only the packaged copy is eligible now
+                candidates = [_PKG_SO]
         lib = None
-        for so in (_SO, _PKG_SO):
+        for so in candidates:
             try:
                 lib = ctypes.CDLL(so)
                 break

@@ -24,7 +24,6 @@ class BuildWithNative(build_py):
                 import shutil
                 dest = os.path.join(here, "paddle_tpu", "_native")
                 os.makedirs(dest, exist_ok=True)
-                open(os.path.join(dest, "__init__.py"), "a").close()
                 for so in glob.glob(os.path.join(native, "*.so")):
                     shutil.copy2(so, dest)
             except (OSError, subprocess.CalledProcessError) as e:

@@ -32,3 +32,10 @@ def test_example_trains_and_cost_falls(config, passes):
     costs = [float(m) for m in re.findall(r"cost ([-\d.]+)", out)]
     assert len(costs) >= 2, out
     assert costs[-1] < costs[0], out
+
+
+def test_checkgrad_job():
+    """--job=checkgrad parity (TrainerMain.cpp:54): numeric vs analytic
+    gradients through the executor on a demo config."""
+    out = _run_cli("checkgrad", "--config", "examples/fit_a_line.py")
+    assert "checkgrad PASS" in out, out

@@ -144,3 +144,27 @@ def test_save_load_persistables(tmp_path):
     r2 = exe2.run(fluid.default_main_program(),
                   feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[out])[0]
     np.testing.assert_allclose(r1, r2, rtol=1e-6)
+
+
+def test_pruned_program_autodiff_grads_run():
+    """Pruning dangling forward ops must not break the autodiff replay
+    (regression: num_fwd_ops indexed the ORIGINAL op list, so a pruned
+    program recursed forever — the replay now uses the op's own position)."""
+    fluid.reset_default_programs()
+    x = fluid.layers.data("x", shape=(4,))
+    side = fluid.layers.fc(x, 3)              # dangling: not in the cost
+    h = fluid.layers.fc(x, 8, act="tanh")
+    out = fluid.layers.fc(h, 2)
+    loss = fluid.layers.mean(out)
+    fluid.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    params = [v.name
+              for v in fluid.default_main_program().global_block()
+              .all_parameters()
+              if not v.name.startswith("fc_w_1")]   # drop side's params
+    grad_names = [p + "@GRAD" for p in params if "fc" in p]
+    pruned = fluid.default_main_program().prune(grad_names)
+    xs = np.ones((3, 4), np.float32)
+    grads = exe.run(pruned, feed={"x": xs}, fetch_list=grad_names)
+    assert all(np.isfinite(np.asarray(g)).all() for g in grads)

@@ -199,3 +199,44 @@ def test_snapshot_crc_detects_corruption(tmp_path):
     open(snap, "wb").write(bytes(raw))
     with pytest.raises(IOError):
         TaskMaster().restore(snap)
+
+
+def test_master_concurrent_consumers_hammer():
+    """Thread-safety discipline (utils/Locks.h analog is a std::mutex in
+    task_master.cc): many concurrent consumers over one server must neither
+    lose nor double-complete tasks."""
+    import threading
+
+    from paddle_tpu.runtime.master_service import MasterClient, MasterServer
+
+    N_TASKS, N_WORKERS = 200, 8
+    srv = MasterServer(tick_interval=0.05).start()
+    try:
+        boot = MasterClient(*srv.address)
+        boot.set_dataset([f"chunk-{i:04d}" for i in range(N_TASKS)])
+        boot.close()
+
+        seen, lock = [], threading.Lock()
+
+        def worker():
+            c = MasterClient(*srv.address)
+            while True:
+                t = c.get_task()
+                if t is None:
+                    break
+                with lock:
+                    seen.append(t[1])
+                c.task_finished(t[0])
+            c.close()
+
+        threads = [threading.Thread(target=worker) for _ in range(N_WORKERS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(seen) == N_TASKS                      # no loss, no dupes
+        assert len(set(seen)) == N_TASKS
+        todo, pending, done, disc, _ = srv.master.stats()
+        assert (todo, pending, done, disc) == (0, 0, N_TASKS, 0)
+    finally:
+        srv.stop()
