@@ -58,7 +58,7 @@ def export_inference_model(dirname: str, feed_names, fetch_vars,
     # the source program, which must keep training un-fused.
     for block in prog_dict["blocks"]:
         for op in block["ops"]:
-            if op["type"] == "lstm":
+            if op["type"] in ("lstm", "gru"):
                 op["attrs"] = dict(op["attrs"], fused=True)
     meta = {"program": prog_dict,
             "feed_names": list(feed_names),

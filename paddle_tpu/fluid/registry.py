@@ -369,7 +369,8 @@ def _gru(ins, attrs):
     out, last = gru(ins["X"][0], ins["Lengths"][0] if "Lengths" in ins else None,
                     ins["W"][0], ins["U"][0],
                     ins["B"][0] if "B" in ins else None,
-                    reverse=attrs.get("reverse", False))
+                    reverse=attrs.get("reverse", False),
+                    fused=attrs.get("fused", False))
     return {"Out": [out], "LastH": [last]}
 
 

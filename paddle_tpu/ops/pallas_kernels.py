@@ -497,3 +497,86 @@ def lstm_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
         interpret=bool(interpret),
     )(xw_tm, lens, u, b2, h0, c0)
     return jnp.swapaxes(out, 0, 1)[:B], ht[:B], ct[:B]
+
+
+def _gru_seq_kernel(xw_ref, len_ref, u_ref, h0_ref, out_ref, ht_ref,
+                    *, T: int, H: int):
+    """Fused whole-sequence GRU (hl_gpu_gru.cuh analog) — one batch-tile
+    program, time-major xw [T, Bb, 3H] with the BIAS PRE-ADDED (Mosaic
+    rejects sliced-bias broadcasts; the bias is a per-gate constant, so it
+    folds into the input projection), u [H, 3H] packed [u_z | u_r | u_c],
+    gate order z, r, candidate (the reference's layout)."""
+    u = u_ref[...].astype(jnp.float32)
+    uz, ur, uc = u[:, :H], u[:, H:2 * H], u[:, 2 * H:]
+    lens = len_ref[...].astype(jnp.float32)
+    h0 = h0_ref[...].astype(jnp.float32)
+
+    def step(t, h):
+        xw_t = xw_ref[t].astype(jnp.float32)
+        xz, xr, xc = xw_t[:, :H], xw_t[:, H:2 * H], xw_t[:, 2 * H:]
+        z = jax.nn.sigmoid(
+            xz + jax.lax.dot(h, uz, preferred_element_type=jnp.float32))
+        r = jax.nn.sigmoid(
+            xr + jax.lax.dot(h, ur, preferred_element_type=jnp.float32))
+        c = jnp.tanh(
+            xc + jax.lax.dot(r * h, uc,
+                             preferred_element_type=jnp.float32))
+        h_new = (1.0 - z) * h + z * c
+        m = (t.astype(jnp.float32) < lens).astype(jnp.float32)
+        h = m * h_new + (1.0 - m) * h
+        out_ref[t] = (m * h).astype(out_ref.dtype)
+        return h
+
+    h = jax.lax.fori_loop(0, T, step, h0)
+    ht_ref[...] = h.astype(ht_ref.dtype)
+
+
+def gru_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
+                       b: Optional[jax.Array] = None,
+                       h0: Optional[jax.Array] = None, *,
+                       block_b: int = 8,
+                       interpret: Optional[bool] = None):
+    """Masked GRU over a whole sequence in one Pallas kernel; see
+    lstm_sequence_fused for the design notes. xw: x@W [B, T, 3H];
+    returns (out [B, T, H], hT [B, H])."""
+    B, T, G = xw.shape
+    if G % 3:
+        raise ValueError(f"xw last dim {G} must be 3*H (z/r/candidate gates)")
+    H = G // 3
+    if interpret is None:
+        interpret = not _on_tpu()
+    if b is not None:
+        xw = xw + b                       # bias folds into the projection
+    if h0 is None:
+        h0 = jnp.zeros((B, H), xw.dtype)
+    blk = min(block_b, B)
+    Bp = -(-B // blk) * blk
+    lens = lengths.astype(jnp.float32).reshape(B, 1)
+    if Bp > B:
+        pad = Bp - B
+        xw = jnp.pad(xw, ((0, pad), (0, 0), (0, 0)))
+        lens = jnp.pad(lens, ((0, pad), (0, 0)))
+        h0 = jnp.pad(h0, ((0, pad), (0, 0)))
+    xw_tm = jnp.swapaxes(xw, 0, 1)
+
+    kernel = functools.partial(_gru_seq_kernel, T=T, H=H)
+    out, ht = pl.pallas_call(
+        kernel,
+        grid=(Bp // blk,),
+        in_specs=[
+            pl.BlockSpec((T, blk, G), lambda i: (0, i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((H, G), lambda i: (0, 0)),
+            pl.BlockSpec((blk, H), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((T, blk, H), lambda i: (0, i, 0)),
+            pl.BlockSpec((blk, H), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((Bp, H), xw.dtype),
+        ],
+        interpret=bool(interpret),
+    )(xw_tm, lens, u, h0)
+    return jnp.swapaxes(out, 0, 1)[:B], ht[:B]

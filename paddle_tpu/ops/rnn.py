@@ -109,12 +109,15 @@ def lstm(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
     return _lstm_scan(x, lengths, w, u, b, h0, c0, reverse, forget_bias)
 
 
-def _fused_block_b(T: int, H: int, budget_bytes: int = 10_000_000):
+def _fused_block_b(T: int, H: int, gates: int = 4,
+                   budget_bytes: int = 10_000_000):
     """Largest batch tile whose whole-sequence VMEM working set (xw + out
-    blocks, double-buffered, plus resident u) fits; None -> use the scan."""
-    u_bytes = H * 4 * H * 4
+    blocks, double-buffered, plus resident u) fits; None -> use the scan.
+    ``gates``: 4 for LSTM, 3 for GRU (sizes the [H, gates*H] u and the
+    [T, blk, gates*H] xw tile)."""
+    u_bytes = H * gates * H * 4
     for blk in (8, 4, 2, 1):
-        tile = T * blk * (4 * H + H) * 4 * 2      # xw + out, double-buffered
+        tile = T * blk * (gates * H + H) * 4 * 2  # xw + out, double-buffered
         if u_bytes + tile <= budget_bytes:
             return blk
     return None
@@ -178,10 +181,24 @@ _lstm_fused.defvjp(_lstm_fused_fwd, _lstm_fused_bwd)
 
 def gru(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
         b: Optional[jax.Array] = None, h0: Optional[jax.Array] = None,
-        reverse: bool = False) -> Tuple[jax.Array, jax.Array]:
-    """Full-sequence GRU. x: [B, T, D]; w: [D, 3H]; u: [H, 3H]."""
+        reverse: bool = False,
+        fused: Optional[bool] = None) -> Tuple[jax.Array, jax.Array]:
+    """Full-sequence GRU. x: [B, T, D]; w: [D, 3H]; u: [H, 3H].
+
+    ``fused=True`` runs the forward through the Pallas whole-sequence kernel
+    (hl_gpu_gru.cuh analog) — same contract as lstm(fused=True): forward-only
+    paths; gradients replay the scan."""
     B, T, D = x.shape
     H = u.shape[0]
+    if fused and not reverse:
+        from . import pallas_kernels as _pk
+        blk = _fused_block_b(T, H, gates=3)
+        if _pk._on_tpu() and blk is not None:
+            lens = (lengths if lengths is not None
+                    else jnp.full((B,), T, jnp.int32))
+            b_ = b if b is not None else jnp.zeros((3 * H,), x.dtype)
+            h0_ = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+            return _gru_fused(x, lens, w, u, b_, h0_, blk)
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
     mask = (sequence_mask(lengths, T, x.dtype) if lengths is not None
             else jnp.ones((B, T), x.dtype))
@@ -197,6 +214,32 @@ def gru(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
     xs = (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(mask, 0, 1))
     h, ys = lax.scan(step, h, xs, reverse=reverse)
     return jnp.swapaxes(ys, 0, 1), h
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _gru_fused(x, lens, w, u, b, h0, block_b):
+    from .pallas_kernels import gru_sequence_fused
+    B, T, D = x.shape
+    xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
+    return gru_sequence_fused(xw, lens, u, b, h0=h0, block_b=block_b)
+
+
+def _gru_fused_fwd(x, lens, w, u, b, h0, block_b):
+    return _gru_fused(x, lens, w, u, b, h0, block_b), (x, lens, w, u, b, h0)
+
+
+def _gru_fused_bwd(block_b, res, g):
+    x, lens, w, u, b, h0 = res
+
+    def replay(x, w, u, b, h0):
+        return gru(x, lens, w, u, b, h0, fused=False)
+
+    _, vjp = jax.vjp(replay, x, w, u, b, h0)
+    dx, dw, du, db, dh0 = vjp(g)
+    return dx, np.zeros(lens.shape, jax.dtypes.float0), dw, du, db, dh0
+
+
+_gru_fused.defvjp(_gru_fused_fwd, _gru_fused_bwd)
 
 
 def bidirectional(rnn_fn: Callable, x, lengths, fwd_params: dict, bwd_params: dict,

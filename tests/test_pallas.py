@@ -118,3 +118,28 @@ def test_lstm_sequence_fused_matches_scan(block_b):
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(ct), np.asarray(ref_state.c),
                                rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("block_b", [2, 5])
+def test_gru_sequence_fused_matches_scan(block_b):
+    """Fused whole-sequence GRU kernel (hl_gpu_gru.cuh analog) vs the
+    lax.scan GRU: bit-exact incl. masking and padded batch tails."""
+    from paddle_tpu.ops import rnn as R
+    from paddle_tpu.ops.pallas_kernels import gru_sequence_fused
+
+    rs = np.random.RandomState(5)
+    B, T, D, H = 5, 7, 4, 6
+    x = jnp.asarray(rs.randn(B, T, D), jnp.float32)
+    lens = jnp.asarray(rs.randint(1, T + 1, B), jnp.int32)
+    w = jnp.asarray(rs.randn(D, 3 * H) * 0.3, jnp.float32)
+    u = jnp.asarray(rs.randn(H, 3 * H) * 0.3, jnp.float32)
+    b = jnp.asarray(rs.randn(3 * H) * 0.1, jnp.float32)
+
+    ref_out, ref_h = R.gru(x, lens, w, u, b)
+    xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, 3 * H)
+    out, ht = gru_sequence_fused(xw, lens, u, b, block_b=block_b,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ht), np.asarray(ref_h),
+                               rtol=1e-6, atol=1e-6)
