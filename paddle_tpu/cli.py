@@ -257,6 +257,43 @@ def cmd_cluster_train(args):
     return rc
 
 
+def cmd_make_diagram(args):
+    """Model visualization (scripts/submit_local.sh.in:13 make_diagram):
+    emit a graphviz .dot of the config's Program — ops as boxes, data flow
+    as edges, parameters dashed."""
+    from . import fluid
+    _load_config(args.config)
+    prog = fluid.default_main_program()
+    lines = ["digraph G {", "  rankdir=TB;",
+             '  node [fontsize=10, fontname="Helvetica"];']
+    params = {v.name for v in prog.global_block().all_parameters()}
+    var_nodes = set()
+    for bi, block in enumerate(prog.blocks):
+        for oi, op in enumerate(block.ops):
+            op_id = f"op_{bi}_{oi}"
+            lines.append(f'  {op_id} [shape=box, style=filled, '
+                         f'fillcolor="#DDEEFF", label="{op.type}"];')
+            for names in op.inputs.values():
+                for n in names:
+                    var_nodes.add(n)
+                    lines.append(f'  "{n}" -> {op_id};')
+            for names in op.outputs.values():
+                for n in names:
+                    var_nodes.add(n)
+                    lines.append(f'  {op_id} -> "{n}";')
+    for n in sorted(var_nodes):          # one declaration per variable
+        style = ", style=dashed" if n in params else ""
+        lines.append(f'  "{n}" [shape=ellipse{style}];')
+    lines.append("}")
+    import os
+    out = args.output or (os.path.splitext(args.config)[0] + ".dot")
+    with open(out, "w") as f:
+        f.write("\n".join(lines))
+    n_ops = sum(len(b.ops) for b in prog.blocks)
+    print(f"wrote {out} ({n_ops} ops, {len(params)} parameters)")
+    return 0
+
+
 def cmd_version(args):
     from . import __version__
     import jax
@@ -299,6 +336,11 @@ def main(argv=None) -> int:
     mm.add_argument("--model_path", required=True)
     mm.add_argument("--output_dir", required=True)
     mm.set_defaults(fn=cmd_merge_model)
+
+    md = sub.add_parser("make_diagram")
+    common(md)
+    md.add_argument("--output", default=None)
+    md.set_defaults(fn=cmd_make_diagram)
 
     cg = sub.add_parser("checkgrad")
     common(cg)

@@ -39,3 +39,14 @@ def test_checkgrad_job():
     gradients through the executor on a demo config."""
     out = _run_cli("checkgrad", "--config", "examples/fit_a_line.py")
     assert "checkgrad PASS" in out, out
+
+
+def test_make_diagram_job(tmp_path):
+    """make_diagram parity (submit_local.sh.in:13): emits a graphviz dot."""
+    out = str(tmp_path / "model.dot")
+    txt = _run_cli("make_diagram", "--config", "examples/fit_a_line.py",
+                   "--output", out)
+    assert "wrote" in txt
+    dot = open(out).read()
+    assert dot.startswith("digraph G {") and "shape=box" in dot
+    assert "square_error" in dot or "mul" in dot
