@@ -1339,3 +1339,19 @@ def _lambda_cost(ins, attrs):
     pair_loss = jnp.log1p(jnp.exp(-jnp.clip(sdiff, -30, 30)))
     per_row = jnp.sum(jnp.where(higher, dndcg * pair_loss, 0.0), axis=(1, 2))
     return {"Out": [per_row]}
+
+
+@OpRegistry.register("binary_f1")
+def _binary_f1(ins, attrs):
+    """Per-batch F1 for one positive class (evaluators.py:340 per-batch
+    role) — built on the shared precision/recall counting."""
+    from ..ops.metrics import precision_recall_counts
+    logits, label = ins["X"][0], ins["Label"][0]
+    pos = attrs.get("positive_label", 1)
+    pred = jnp.argmax(logits, -1).astype(jnp.int32)
+    counts = precision_recall_counts(pred, label.astype(jnp.int32),
+                                     int(logits.shape[-1]))
+    tp, fp, fn = counts[pos, 0], counts[pos, 1], counts[pos, 2]
+    prec = tp / jnp.maximum(tp + fp, 1)
+    rec = tp / jnp.maximum(tp + fn, 1)
+    return {"Out": [2 * prec * rec / jnp.maximum(prec + rec, 1e-12)]}

@@ -394,6 +394,8 @@ SPECS = {
                  attrs={"l1": 0.01, "l2": 0.01}),
     # -- gen-1 layer-zoo completions ----------------------------------------
     "argmax": dict(ins={"X": [f32(B, V)]}),
+    "binary_f1": dict(ins={"X": [f32(B, N)],
+                           "Label": [R.randint(0, N, B).astype(np.int32)]}),
     "power": dict(ins={"X": [pos32(B, D)], "W": [np.float32(1.5)]},
                   grad=[("X", 0), ("W", 0)]),
     "slope_intercept": dict(ins={"X": [f32(B, D)]},

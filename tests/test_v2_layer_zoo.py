@@ -428,3 +428,48 @@ def test_prebuilt_networks():
     ap = net.simple_attention_pool(emb)
     v = _run(ap, feeds)
     assert v.shape == (B, D)
+
+
+def test_v2_evaluator_dsl_metrics_in_events():
+    """trainer_config_helpers/evaluators.py analog: in-graph evaluators
+    attached as extra layers surface per-batch metrics in EndIteration."""
+    from paddle_tpu.trainer import event
+
+    x = L.data("x", DT.dense_vector(D))
+    y = L.data("y", DT.integer_value(2))
+    logits = L.fc(x, 2)
+    cost = L.classification_cost(logits, y)
+    err = paddle.evaluator.classification_error_evaluator(logits, y)
+    ssum = paddle.evaluator.sum_evaluator(logits)
+    f1 = paddle.evaluator.precision_recall_evaluator(logits, y)
+
+    rs = np.random.RandomState(0)
+    Xd = rs.randn(64, D).astype(np.float32)
+    Yd = (Xd.sum(-1) > 0).astype(np.int32)
+
+    def reader():
+        for i in range(0, 64, 16):
+            yield [(Xd[j], int(Yd[j])) for j in range(i, i + 16)]
+
+    seen = []
+    tr = paddle.SGD(cost, paddle.optimizer.Adam(5e-2),
+                    extra_layers=[err, ssum, f1])
+    tr.train(reader, num_passes=4,
+             event_handler=lambda e: seen.append(e.metrics)
+             if isinstance(e, event.EndIteration) else None,
+             feeding=[x, y])
+    assert seen and all(len(m) == 3 for m in seen)
+    errs = [m[err.var.name] for m in seen]
+    assert 0.0 <= errs[-1] <= 1.0 and errs[-1] <= errs[0]
+    f1s = [m[f1.var.name] for m in seen]
+    assert 0.0 <= f1s[-1] <= 1.0 and f1s[-1] >= f1s[0]
+
+
+def test_v2_auc_evaluator_from_logits():
+    """auc_evaluator accepts [B, C] logits (positive-class prob extracted)."""
+    x = L.data("x", DT.dense_vector(D))
+    y = L.data("y", DT.integer_value(2))
+    logits = L.fc(x, 2)
+    auc = paddle.evaluator.auc_evaluator(logits, y)
+    v = _run(auc, {"x": X, "y": RS.randint(0, 2, B).astype(np.int32)})
+    assert 0.0 <= float(v) <= 1.0
