@@ -131,23 +131,50 @@ int pti_forward(void* handle, const void** inputs, const long long* shapes,
 
   // build [(bytes, (dims...), dtype_code), ...]
   PyObject* args_list = PyList_New(n_inputs);
+  if (!args_list) {
+    set_error_from_python();
+    PyGILState_Release(gil);
+    return -1;
+  }
   long long shape_off = 0;
   for (int i = 0; i < n_inputs; i++) {
     long long numel = 1;
     PyObject* dims = PyTuple_New(ndims[i]);
+    if (!dims) {
+      set_error_from_python();
+      Py_DECREF(args_list);
+      PyGILState_Release(gil);
+      return -1;
+    }
     for (int d = 0; d < ndims[i]; d++) {
       long long dim = shapes[shape_off + d];
       numel *= dim;
-      PyTuple_SET_ITEM(dims, d, PyLong_FromLongLong(dim));
+      PyObject* dim_obj = PyLong_FromLongLong(dim);
+      if (!dim_obj) {
+        set_error_from_python();
+        Py_DECREF(dims);
+        Py_DECREF(args_list);
+        PyGILState_Release(gil);
+        return -1;
+      }
+      PyTuple_SET_ITEM(dims, d, dim_obj);
     }
     shape_off += ndims[i];
     size_t nbytes = (size_t)numel * 4;  // f32 and i32 are both 4 bytes
     PyObject* payload = PyBytes_FromStringAndSize(
         static_cast<const char*>(inputs[i]), (Py_ssize_t)nbytes);
-    PyObject* entry = PyTuple_Pack(3, payload, dims,
-                                   PyLong_FromLong(dtypes[i]));
-    Py_DECREF(payload);
+    PyObject* dtype_obj = payload ? PyLong_FromLong(dtypes[i]) : nullptr;
+    PyObject* entry =
+        dtype_obj ? PyTuple_Pack(3, payload, dims, dtype_obj) : nullptr;
+    Py_XDECREF(dtype_obj);
+    Py_XDECREF(payload);
     Py_DECREF(dims);
+    if (!entry) {
+      set_error_from_python();
+      Py_DECREF(args_list);
+      PyGILState_Release(gil);
+      return -1;
+    }
     PyList_SET_ITEM(args_list, i, entry);  // steals entry
   }
 

@@ -40,8 +40,6 @@ struct Master {
   int epoch = 0;  // bumped when todo refills from done (pass boundary)
 };
 
-double now_unused() { return 0; }
-
 }  // namespace
 
 extern "C" {
@@ -320,12 +318,10 @@ int ptm_restore(void* h, const char* path) {
   fclose(f);
   if (crc32_of(body) != crc_want) return -5;  // corruption detected
 
-  m->todo.clear();
-  m->pending.clear();
-  m->done.clear();
-  m->discarded.clear();
-  m->next_id = next_id;
-  m->epoch = epoch;
+  // parse into temporaries and commit only on full success, so a corrupt
+  // body can't leave the master half-restored (mirrors the v2 path)
+  std::deque<Task> todo;
+  std::vector<Task> done, discarded;
   size_t pos = 0;
   while (pos < body.size()) {
     size_t eol = body.find('\n', pos);
@@ -343,10 +339,16 @@ int ptm_restore(void* h, const char* path) {
     t.failures = failures;
     t.payload = body.substr(pos, len);
     pos += len + 1;
-    if (strcmp(tag, "todo") == 0) m->todo.push_back(t);
-    else if (strcmp(tag, "done") == 0) m->done.push_back(t);
-    else m->discarded.push_back(t);
+    if (strcmp(tag, "todo") == 0) todo.push_back(t);
+    else if (strcmp(tag, "done") == 0) done.push_back(t);
+    else discarded.push_back(t);
   }
+  m->todo = std::move(todo);
+  m->pending.clear();
+  m->done = std::move(done);
+  m->discarded = std::move(discarded);
+  m->next_id = next_id;
+  m->epoch = epoch;
   return 0;
 }
 

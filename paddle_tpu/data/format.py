@@ -202,6 +202,17 @@ class DataReader:
                 raise IOError("truncated record")
             yield self._decode(memoryview(payload))
 
+    @staticmethod
+    def _read_count(buf: memoryview, off: int) -> int:
+        """Bounds-checked SEQ/SUB_SEQ count prefix: corruption surfaces as
+        the documented IOError, and an absurd count (larger than the record
+        could possibly hold at 1 byte/element) fails before looping."""
+        _need(buf, off, 4)
+        (n,) = struct.unpack_from("<I", buf, off)
+        if n > len(buf):
+            raise IOError("corrupt record (count exceeds record length)")
+        return n
+
     def _decode(self, buf: memoryview):
         off = 0
         sample = []
@@ -209,18 +220,18 @@ class DataReader:
             if slot.seq == NO_SEQ:
                 v, off = _unpack_elem(slot, buf, off)
             elif slot.seq == SEQ:
-                (n,) = struct.unpack_from("<I", buf, off)
+                n = self._read_count(buf, off)
                 off += 4
                 v = []
                 for _ in range(n):
                     el, off = _unpack_elem(slot, buf, off)
                     v.append(el)
             else:
-                (ns,) = struct.unpack_from("<I", buf, off)
+                ns = self._read_count(buf, off)
                 off += 4
                 v = []
                 for _ in range(ns):
-                    (n,) = struct.unpack_from("<I", buf, off)
+                    n = self._read_count(buf, off)
                     off += 4
                     sub = []
                     for _ in range(n):

@@ -270,11 +270,23 @@ def _trace_beam_search_gen(op, env, ctx: TraceContext):
         new_mems = tuple(env2[n] for n in a["mem_update_names"])
         return logp, new_mems
 
+    constraint_fn = None
+    if a.get("constraint"):
+        from ..ops.beam_search import CONSTRAINTS
+        try:
+            constraint_fn = CONSTRAINTS[a["constraint"]]
+        except KeyError:
+            raise KeyError(
+                f"beam-search constraint {a['constraint']!r} is not "
+                "registered; call paddle_tpu.ops.beam_search."
+                "register_constraint(name, fn) before running the program")
+
     toks, scores = beam_search(
         boots, step_fn, batch_size=B,
         beam_size=K, max_len=a["max_length"], vocab_size=V,
         bos_id=a["bos_id"], eos_id=a["eos_id"],
-        length_penalty=a.get("length_penalty", 0.0))
+        length_penalty=a.get("length_penalty", 0.0),
+        constraint_fn=constraint_fn)
     env[op.outputs["Tokens"][0]] = toks
     env[op.outputs["Scores"][0]] = scores
 

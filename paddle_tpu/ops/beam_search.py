@@ -18,6 +18,26 @@ import jax.numpy as jnp
 from jax import lax
 
 
+#: Named constraint hooks, so the (JSON-serializable) Program IR can refer
+#: to a registered Python masking function by name — the registration role
+#: of the reference's BeamSearchControlCallbacks objects
+#: (RecurrentGradientMachine.h:106-123), which were likewise attached at
+#: generation time rather than stored in the model config.
+CONSTRAINTS: dict = {}
+
+
+def register_constraint(name: str, fn: Optional[Callable] = None):
+    """Register ``fn(logits [B, K, V], step) -> logits`` under ``name``.
+    Usable as a decorator: ``@register_constraint("no_digits")``."""
+    if fn is None:
+        def deco(f):
+            CONSTRAINTS[name] = f
+            return f
+        return deco
+    CONSTRAINTS[name] = fn
+    return fn
+
+
 def _gather_beams(tree, idx):
     """Reindex the beam axis (1) of every leaf by idx [B, K_new]."""
     def g(x):

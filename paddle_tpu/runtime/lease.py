@@ -4,26 +4,54 @@ The reference elects and registers its masters/pservers through etcd leases
 and locks (go/master/etcd_client.go: concurrency.NewSession + lock under
 a TTL lease; go/pserver/etcd_client.go slot registration). A TPU pod has no
 etcd, but every host mounts shared storage; :class:`FileLease` provides the
-same primitive there: a lock file holding ``owner expires_at``, acquirable
-when absent/expired, renewed by its holder, atomically replaced via
-write-temp-then-rename. A standby master blocks on the lease and takes over
-(restoring the CRC-checked snapshot) when the active master dies — removing
-the single-point-of-failure the round-1 review flagged.
+same primitive there: a lock file holding ``owner expires_at token``,
+acquirable when absent/expired and renewed by its holder. A standby master
+blocks on the lease and takes over (restoring the CRC-checked snapshot) when
+the active master dies — removing the single-point-of-failure the round-1
+review flagged.
 
-Contention protocol: writers re-read after renaming and only believe they
-hold the lease if the file names them (last-writer-wins + confirm), which is
-safe on POSIX rename atomicity for the single-shared-filesystem deployment.
-For cross-datacenter placement, point the path at a fencing-capable store.
+Contention protocol: every lease mutation (acquire / renew / release) is
+serialized under an ``flock`` on a sidecar ``<path>.lock`` file, so
+read-check-write sequences are atomic among contenders; readers see
+consistent contents because the lease file itself is replaced via
+write-temp-then-rename. flock is advisory but all participants go through
+this class; it holds across NFSv4 (and NFSv3 with lockd), the shared-storage
+deployments a TPU pod actually uses.
+
+Fencing: every acquisition is stamped with a monotonically increasing
+*fencing token* (persisted in a sidecar ``<path>.epoch`` counter, bumped
+under the same kind of flock so it never goes backwards, even across
+release/re-acquire cycles) — the role etcd revisions play in
+go/master/etcd_client.go. Resources that must never accept writes from a
+deposed master (the snapshot file) are guarded by :class:`FencedFile`: the
+check-and-publish runs under an flock, so a writer that stalls mid-operation
+(GC pause, NFS hiccup) either completes before the new generation's claim or
+finds itself refused — there is no window where a stale write lands on top
+of a newer generation's.
 """
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import os
 import socket
 import threading
 import time
 import uuid
 from typing import Optional, Tuple
+
+
+@contextlib.contextmanager
+def _flocked(lock_path: str):
+    """Exclusive advisory lock scope on ``lock_path`` (created if absent)."""
+    fd = os.open(lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
 
 
 class FileLease:
@@ -34,11 +62,20 @@ class FileLease:
         self.path = path
         self.owner = owner or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
         self.ttl = ttl
+        self._lock_path = f"{path}.lock"
+        #: fencing token of OUR current acquisition (None until we hold it)
+        self.token: Optional[int] = None
 
     # -- inspection ---------------------------------------------------------
     def holder(self) -> Optional[Tuple[str, float]]:
         """(owner, expires_at) of the current lease file, None if absent/bad."""
-        return self._read(self.path)
+        h = self._read(self.path)
+        return None if h is None else (h[0], h[1])
+
+    def current_token(self) -> Optional[int]:
+        """Fencing token of the current lease file (whoever holds it)."""
+        h = self._read(self.path)
+        return None if h is None else h[2]
 
     def held_by_me(self, now: Optional[float] = None) -> bool:
         h = self.holder()
@@ -49,56 +86,44 @@ class FileLease:
     def try_acquire(self, now: Optional[float] = None) -> bool:
         """Take the lease if it is free, expired, or already ours.
 
-        Mutual exclusion among contenders: a FREE lease is taken by O_EXCL
-        creation (exactly one creator wins); an EXPIRED lease is first
-        *claimed* by renaming it to a contender-unique path (exactly one
-        rename succeeds — the loser gets ENOENT), verified expired, then
-        replaced via O_EXCL. Residual race vs a live holder's renewal is
-        bounded by the renewal cadence (ttl/3 ≪ ttl); true fencing needs a
-        coordination service (see module docstring).
+        The whole read-check-write runs under the contender flock, so
+        exactly one contender wins an expired/free lease and nobody can
+        clobber a live holder's renewal.
         """
         now = time.time() if now is None else now
-        h = self.holder()
-        if h is not None:
-            if h[0] == self.owner:
-                self._write(now)             # refresh our own lease
-                return self.held_by_me(now)
-            if h[1] > now:
+        with _flocked(self._lock_path):
+            h = self._read(self.path)
+            if h is not None and h[0] != self.owner and h[1] > now:
                 return False                 # live foreign lease
-            # expired foreign lease: claim it by rename — only ONE contender
-            # can win this rename; everyone else fails with ENOENT
-            claim = f"{self.path}.claim.{self.owner}"
-            try:
-                os.rename(self.path, claim)
-            except OSError:
-                return False
-            claimed = self._read(claim)
-            if claimed is not None and claimed[1] > now and \
-                    claimed[0] != self.owner:
-                # it was renewed between our read and our claim: give it back
-                try:
-                    os.rename(claim, self.path)
-                except OSError:
-                    os.remove(claim)
-                return False
-            os.remove(claim)
-        return self._create_excl(now)
+            if h is not None and h[0] == self.owner:
+                if self.token is None:
+                    self.token = h[2]        # recover after restart
+            else:
+                self.token = self._next_token()
+            self._write(now)
+            return True
 
     def renew(self, now: Optional[float] = None) -> bool:
         """Extend our lease; False (lease LOST) if someone else took it."""
         now = time.time() if now is None else now
-        h = self.holder()
-        if h is None or h[0] != self.owner:
-            return False
-        self._write(now)
-        return self.held_by_me(now)
+        with _flocked(self._lock_path):
+            h = self._read(self.path)
+            if h is None or h[0] != self.owner:
+                return False
+            if self.token is None:
+                self.token = h[2]            # recover after restart
+            self._write(now)
+            return True
 
     def release(self):
-        if self.held_by_me():
-            try:
-                os.remove(self.path)
-            except OSError:
-                pass
+        with _flocked(self._lock_path):
+            h = self._read(self.path)
+            if h is not None and h[0] == self.owner:
+                try:
+                    os.remove(self.path)
+                except OSError:
+                    pass
+        self.token = None
 
     def wait_acquire(self, poll: float = 0.5,
                      timeout: Optional[float] = None) -> bool:
@@ -111,28 +136,110 @@ class FileLease:
                 return False
             time.sleep(poll)
 
-    def _read(self, path: str) -> Optional[Tuple[str, float]]:
+    def _read(self, path: str) -> Optional[Tuple[str, float, int]]:
         try:
             with open(path) as f:
-                owner, expires = f.read().split()
-                return owner, float(expires)
-        except (OSError, ValueError):
+                fields = f.read().split()
+                owner, expires = fields[0], float(fields[1])
+                token = int(fields[2]) if len(fields) > 2 else 0
+                return owner, expires, token
+        except (OSError, ValueError, IndexError):
             return None
 
-    def _create_excl(self, now: float) -> bool:
+    def _next_token(self) -> int:
+        """Monotonic across every acquisition, including after release():
+        the high-water mark lives in a sidecar counter file. The
+        read-bump-write is serialized under its own flock so a contender
+        that stalls mid-bump can never roll the counter backwards and mint
+        a duplicate token."""
+        epoch_path = f"{self.path}.epoch"
+        fd = os.open(epoch_path, os.O_RDWR | os.O_CREAT, 0o644)
         try:
-            fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
-        except FileExistsError:
-            return self.held_by_me(now)      # maybe we lost to a peer
-        with os.fdopen(fd, "w") as f:
-            f.write(f"{self.owner} {now + self.ttl}")
-        return True
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            raw = os.read(fd, 64)
+            try:
+                cur = int(raw) if raw else 0
+            except ValueError:
+                cur = 0
+            h = self._read(self.path)
+            if h is not None:
+                cur = max(cur, h[2])
+            nxt = cur + 1
+            os.lseek(fd, 0, os.SEEK_SET)
+            os.ftruncate(fd, 0)
+            os.write(fd, str(nxt).encode())
+            os.fsync(fd)
+            return nxt
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
 
     def _write(self, now: float):
+        # caller holds the contender flock; rename keeps readers consistent
         tmp = f"{self.path}.{self.owner}.tmp"
         with open(tmp, "w") as f:
-            f.write(f"{self.owner} {now + self.ttl}")
+            f.write(f"{self.owner} {now + self.ttl} {self.token or 0}")
         os.replace(tmp, self.path)
+
+
+class FencedFile:
+    """Token-checked write guard for a resource shared across master
+    generations (the snapshot file). A writer presents its fencing token;
+    once any higher token has claimed the resource, lower tokens are
+    refused — etcd-revision fencing (go/master/etcd_client.go) on a plain
+    filesystem. Check-and-publish is atomic under an flock: a stale writer
+    cannot land its file after a newer generation's claim."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.fence_path = f"{path}.fence"
+        self._lock_path = f"{path}.fencelock"
+
+    def _recorded(self) -> int:
+        try:
+            with open(self.fence_path) as f:
+                return int(f.read())
+        except (OSError, ValueError):
+            return 0
+
+    def _claim_locked(self, token: int) -> bool:
+        recorded = self._recorded()
+        if token < recorded:
+            return False
+        if token > recorded:
+            tmp = f"{self.fence_path}.{token}.tmp"
+            with open(tmp, "w") as f:
+                f.write(str(token))
+            os.replace(tmp, self.fence_path)
+        return True
+
+    def claim(self, token: Optional[int]) -> bool:
+        """Record `token` as the current generation; False if a higher
+        token already claimed the resource (caller is deposed)."""
+        if token is None:
+            return True                      # fencing not in use
+        with _flocked(self._lock_path):
+            return self._claim_locked(token)
+
+    def write(self, token: Optional[int], writer) -> bool:
+        """Run ``writer(tmp)`` then publish the result iff `token` is still
+        current. The (possibly slow) write happens outside the lock; the
+        check + rename are one atomic critical section, so a deposed
+        writer's file can never replace a newer generation's."""
+        tmp = f"{self.path}.w{token if token is not None else 0}.tmp"
+        writer(tmp)
+        if token is None:
+            os.replace(tmp, self.path)
+            return True
+        with _flocked(self._lock_path):
+            if not self._claim_locked(token):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                return False
+            os.replace(tmp, self.path)
+            return True
 
 
 class LeaseKeeper:

@@ -80,6 +80,11 @@ class MasterServer:
         self._tick_interval = tick_interval
         self.lease = lease
         self._keeper = None
+        self.fence_token = None   # set from the lease at start()
+        from .lease import FencedFile
+        self._fence = FencedFile(snapshot_path) if snapshot_path else None
+        self._deposed = False
+        self._fence_checked_at = float("-inf")
         self.lease_lost = threading.Event()
         outer = self
 
@@ -114,10 +119,24 @@ class MasterServer:
     def start(self):
         if self.lease is not None:
             from .lease import LeaseKeeper
-            if not self.lease.held_by_me() and not self.lease.try_acquire():
+            # try_acquire (not held_by_me) even when the lease already names
+            # us: it refreshes the TTL and recovers the fencing token after
+            # a same-owner restart
+            if not self.lease.try_acquire():
                 self._server.server_close()   # don't leak the bound socket
                 raise RuntimeError(
                     f"lease {self.lease.path} held by {self.lease.holder()}")
+            self.fence_token = self.lease.token
+            if self._fence is not None and \
+                    not self._fence.claim(self.fence_token):
+                self._server.server_close()
+                self.lease.release()   # don't wedge standby takeover
+                raise RuntimeError(
+                    "snapshot fence already claimed by a newer master "
+                    f"(our token {self.fence_token} < recorded "
+                    f"{self._fence._recorded()}); if the lease epoch file "
+                    f"was lost, remove {self._fence.fence_path} or seed "
+                    f"{self.lease.path}.epoch past the recorded value")
             self._keeper = LeaseKeeper(self.lease, on_lost=self._on_lease_lost)
             self._keeper.start()
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -155,18 +174,68 @@ class MasterServer:
             except OSError:
                 pass
 
+    def try_snapshot(self) -> bool:
+        """Fenced snapshot write: refused (False) once a newer master has
+        claimed the snapshot — a deposed master that wakes after its TTL
+        cannot clobber the new generation's state."""
+        if not self.snapshot_path:
+            return False
+        try:
+            ok = self._fence.write(
+                self.fence_token, lambda p: self.master.snapshot(p))
+        except IOError:
+            return False
+        if not ok:
+            self._deposed = True   # refusal is authoritative — don't wait
+        return ok
+
     def _housekeeping(self):
         while not self._stop.wait(self._tick_interval):
             self.master.tick()
-            if self.snapshot_path:
-                try:
-                    self.master.snapshot(self.snapshot_path)
-                except IOError:
-                    pass
+            if self.snapshot_path and not self.try_snapshot() \
+                    and self._fenced_out():
+                # a newer master owns the snapshot: we are deposed
+                self._on_lease_lost()
+                return
+
+    def _fenced_out(self) -> bool:
+        """Deposed-master check. Deposition is permanent, so a positive
+        result is cached; negative results are re-checked at most once per
+        tick_interval to keep filesystem reads off the RPC hot path."""
+        if self.fence_token is None:
+            return False
+        if self._deposed or self.lease_lost.is_set():
+            return True
+        # staleness bound = the lease renewal cadence: a takeover is
+        # reflected here no later than it would be noticed by the keeper
+        window = (self.lease.ttl / 3.0 if self.lease is not None
+                  else self._tick_interval)
+        now = time.monotonic()
+        if now - self._fence_checked_at < window:
+            return False
+        self._fence_checked_at = now
+        deposed = (self._fence is not None and
+                   self._fence._recorded() > self.fence_token)
+        if not deposed and self.lease is not None:
+            cur = self.lease.current_token()
+            deposed = cur is not None and cur > self.fence_token
+        if deposed:
+            self._deposed = True
+        return deposed
+
+    # get_task is included: it moves a task todo->pending, and a deposed
+    # master handing out tasks from its stale queue is exactly the
+    # split-brain fencing exists to stop
+    _MUTATING_OPS = frozenset(
+        {"set_dataset", "get_task", "task_finished", "task_failed",
+         "new_pass"})
 
     # -- dispatch ----------------------------------------------------------
     def _dispatch(self, req):
         op = req.get("op")
+        if op in self._MUTATING_OPS and self._fenced_out():
+            return {"ok": False,
+                    "error": f"fenced: stale master token {self.fence_token}"}
         if op == "set_dataset":
             self.master.set_dataset(req["payloads"])
             return {"ok": True}
@@ -242,6 +311,11 @@ class MasterClient:
                     resp = _recv_msg(self._sock)
                     if resp is None:
                         raise ConnectionError("server closed connection")
+                    if not resp.get("ok") and \
+                            str(resp.get("error", "")).startswith("fenced"):
+                        # deposed master: rotate to the standby and retry
+                        self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
+                        raise ConnectionError(resp["error"])
                     return resp
                 except (OSError, ConnectionError) as e:
                     last_err = e

@@ -85,9 +85,6 @@ def value_printer_evaluator(input: LayerOutput,
     """Printer evaluator (evaluators.py:576): surfaces the first values of a
     layer as a fetchable metric vector (host logging decides formatting)."""
     shp = _shape(input)
-    numel = 1
-    for d in shp:
-        numel = numel * d if d and d > 0 else numel
     known = all(d and d > 0 for d in shp[1:])   # batch dim may be dynamic
     if known and len(shp) >= 1:
         # static bound on the slice: never larger than one sample row
@@ -97,7 +94,12 @@ def value_printer_evaluator(input: LayerOutput,
         head = min(head, max(per_row, 1))
     flat = _emit("reshape", {"X": [input.var.name]}, {"shape": (-1,)},
                  out_shape=(-1,))
-    v = _emit("crop", {"X": [flat.name]}, {"offsets": [0], "shape": [head]},
+    # the flattened batch can still be shorter than `head` at runtime (tiny
+    # batch, dynamic row size): pad up to `head` so the crop never reads
+    # out of bounds
+    padded = _emit("pad", {"X": [flat.name]}, {"paddings": [[0, head]]},
+                   out_shape=(-1,))
+    v = _emit("crop", {"X": [padded.name]}, {"offsets": [0], "shape": [head]},
               out_shape=(head,))
     return LayerOutput(v)
 

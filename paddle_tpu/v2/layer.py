@@ -406,7 +406,8 @@ def recurrent_group(step, input, reverse: bool = False):
 
 
 def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
-                max_length: int = 20, length_penalty: float = 0.0):
+                max_length: int = 20, length_penalty: float = 0.0,
+                constraint: Optional[str] = None):
     """Beam-search generation over a user step net (layers.py beam_search /
     generateSequence:964). Returns (tokens, scores) LayerOutputs with shapes
     [B, beam, max_length] / [B, beam], best-first.
@@ -416,6 +417,13 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
     from outer layers via memory(..., boot_layer=...). The step must return
     per-class *probabilities* [_, vocab] (softmax output, like the
     reference's generating sub-model).
+
+    ``constraint`` names a logits-mask hook registered via
+    :func:`paddle_tpu.ops.beam_search.register_constraint` — the user-callback
+    capability of the reference's BeamSearchControlCallbacks
+    (RecurrentGradientMachine.h:106-123) as a token-masking function; the
+    name (not the callable) is stored in the Program so it stays
+    JSON-serializable.
     """
     main = default_main_program()
     gens = [i for i in input if isinstance(i, GeneratedInput)]
@@ -477,7 +485,8 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
          "prob_name": out.var.name,
          "beam_size": beam_size, "max_length": max_length,
          "bos_id": bos_id, "eos_id": eos_id,
-         "length_penalty": length_penalty})
+         "length_penalty": length_penalty,
+         "constraint": constraint or ""})
     return LayerOutput(tokens), LayerOutput(scores)
 
 

@@ -185,3 +185,80 @@ def test_beam_generation_deterministic_and_wellformed():
                           .astype(np.float32))
     t3, _ = exe.run(feed=feed, fetch_list=[tokens, scores])
     assert not np.array_equal(t1, t3)
+
+
+def test_beam_generation_with_registered_constraint():
+    """End-to-end BeamSearchControlCallbacks analog
+    (RecurrentGradientMachine.h:106-123): a registered logits-mask hook
+    drives the decode through the v2 DSL — a forbidden token family never
+    appears, and a min-length rule delays EOS, exactly the kind of
+    vocabulary control the reference's per-step callbacks were used for."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.beam_search import CONSTRAINTS, register_constraint
+
+    B, Ts = 3, 5
+    V_src, V, E, H = 12, 9, 6, 8
+    BOS, EOS, K, MAXLEN = 0, 1, 3, 6
+    FORBIDDEN = (4, 5)      # a "token family" (e.g. digits)
+    MIN_LEN = 3
+
+    @register_constraint("no_45_minlen3")
+    def _mask(logp, step):
+        for tok in FORBIDDEN:
+            logp = logp.at[..., tok].set(-1e9)
+        # min-length: EOS is illegal before MIN_LEN steps have been emitted
+        return jnp.where(step < MIN_LEN - 1,
+                         logp.at[..., EOS].set(-1e9), logp)
+
+    try:
+        src = L.data("src", integer_value_sequence(V_src))
+        enc, proj, enc_last = _encoder(src, V_src, E, H)
+
+        def gstep(y_t, enc_s, proj_s):
+            dec_mem = memory("dec_state", H, boot_layer=enc_last)
+            context = NW.simple_attention(enc_s, proj_s, dec_mem)
+            h = L.fc([y_t, context, dec_mem], H, act="tanh", name="dec_state")
+            return L.fc(h, V, act="softmax")
+
+        tokens, scores = beam_search(
+            gstep, [GeneratedInput(V, E), StaticInput(enc), StaticInput(proj)],
+            bos_id=BOS, eos_id=EOS, beam_size=K, max_length=MAXLEN,
+            constraint="no_45_minlen3")
+
+        exe = fluid.Executor()
+        _startup(exe)
+        rng = np.random.RandomState(3)
+        srcs = rng.randint(0, V_src, (B, Ts)).astype(np.int32)
+        feed = {"src": srcs, "src__len__": np.full((B,), Ts, np.int32)}
+        t1, s1 = exe.run(feed=feed, fetch_list=[tokens, scores])
+        assert t1.shape == (B, K, MAXLEN)
+        for tok in FORBIDDEN:                      # family never emitted
+            assert not np.any(t1 == tok)
+        for b in range(B):                         # EOS delayed to MIN_LEN
+            for k in range(K):
+                assert not np.any(t1[b, k, : MIN_LEN - 1] == EOS)
+    finally:
+        CONSTRAINTS.pop("no_45_minlen3", None)
+
+
+def test_beam_constraint_unregistered_name_is_loud():
+    src = L.data("src", integer_value_sequence(8))
+    enc, proj, enc_last = _encoder(src, 8, 4, 6)
+
+    def gstep(y_t, enc_s, proj_s):
+        dec_mem = memory("dec_state", 6, boot_layer=enc_last)
+        context = NW.simple_attention(enc_s, proj_s, dec_mem)
+        h = L.fc([y_t, context, dec_mem], 6, act="tanh", name="dec_state")
+        return L.fc(h, 5, act="softmax")
+
+    tokens, scores = beam_search(
+        gstep, [GeneratedInput(5, 4), StaticInput(enc), StaticInput(proj)],
+        bos_id=0, eos_id=1, beam_size=2, max_length=4,
+        constraint="never_registered")
+    exe = fluid.Executor()
+    _startup(exe)
+    feed = {"src": np.zeros((2, 3), np.int32),
+            "src__len__": np.full((2,), 3, np.int32)}
+    with pytest.raises(KeyError, match="never_registered"):
+        exe.run(feed=feed, fetch_list=[tokens, scores])
