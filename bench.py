@@ -55,10 +55,22 @@ def main():
     flagship_ok = False
     # secondary metrics first; the flagship (has a published baseline) last so
     # it is the line the driver's tail-parser records
-    for name in ("resnet50", "seq2seq_nmt", "lstm_textcls"):
+    try:
+        from benchmarks.image_suite import ROWS, bench_row
+        for model_key, bs, ref_ms in ROWS:
+            try:
+                print(json.dumps(bench_row(model_key, bs, ref_ms)),
+                      flush=True)
+            except Exception:
+                traceback.print_exc()
+    except Exception:
+        traceback.print_exc()
+    for name in ("resnet50", "seq2seq_nmt", "fused_rnn", "lstm_textcls"):
         try:
             mod = __import__(f"benchmarks.{name}", fromlist=["run"])
             print(json.dumps(mod.run()), flush=True)
+            if name == "resnet50":
+                print(json.dumps(mod.run_with_infeed()), flush=True)
             if name == "lstm_textcls":
                 flagship_ok = True
         except Exception:

@@ -21,8 +21,6 @@ the default jax device; ``vs_baseline`` = reference_ms / our_ms (>1 == faster).
 
 from __future__ import annotations
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -86,31 +84,29 @@ def build():
     lengths = jnp.asarray(rs.randint(MIN_LEN, SEQ_LEN + 1, (NBUF, BATCH)),
                           jnp.int32)
     labels = jnp.asarray(rs.randint(0, 2, (NBUF, BATCH)), jnp.int32)
-    return run_n, params, state, (data, lengths, labels)
+    return run_n, step_fn, params, state, (data, lengths, labels)
 
 
 def run(iters: int = 100, repeats: int = 3):
     """Difference a short and a long on-device loop so the fixed dispatch +
     host-fetch latency (large under the remote tunnel, where block_until_ready
     is unreliable) cancels; float(loss) forces completion."""
-    run_n, params, state, batch = build()
-    run_n(params, state, *batch, 2)          # compile
+    from benchmarks.mfu import attach_mfu, step_flops
+    from benchmarks.timing import chained_ms_per_step
 
-    def timed(n):
-        t0 = time.perf_counter()
-        _, _, loss = run_n(params, state, *batch, n)
-        float(loss)
-        return time.perf_counter() - t0
-
-    t_short = min(timed(2) for _ in range(repeats))
-    t_long = min(timed(iters + 2) for _ in range(repeats))
-    ms = max(t_long - t_short, 1e-9) / iters * 1e3
+    run_n, step_fn, params, state, batch = build()
+    ms = chained_ms_per_step(run_n, (params, state) + batch, iters, repeats,
+                             short=2)
+    flops = step_flops(step_fn, params, state, batch[0][0], batch[1][0],
+                       batch[2][0])
     # metric key carries the methodology (len30-100 varied) — renamed from the
     # round-1 all-len-100 key so trend tracking can't silently mix semantics
-    return {"metric": "lstm_textcls_train_ms_per_batch_bs64_h256_len30-100",
-            "value": round(ms, 3), "unit": "ms/batch",
-            "vs_baseline": round(BASELINE_MS / ms, 3),
-            "note": "varied lengths 30..100, 8 distinct rotating batches"}
+    return attach_mfu(
+        {"metric": "lstm_textcls_train_ms_per_batch_bs64_h256_len30-100",
+         "value": round(ms, 3), "unit": "ms/batch",
+         "vs_baseline": round(BASELINE_MS / ms, 3),
+         "note": "varied lengths 30..100, 8 distinct rotating batches"},
+        flops, ms / 1e3)
 
 
 if __name__ == "__main__":

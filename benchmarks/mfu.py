@@ -1,0 +1,73 @@
+"""Model-FLOP accounting for the benchmark harness.
+
+Every bench metric reports ``mfu`` (model FLOPs utilization): the training
+step's FLOPs — XLA's own cost analysis of the compiled step HLO — divided by
+measured step time and the chip's peak. The reference never measured this
+(its README reports raw ms/batch, benchmark/README.md); on TPU it is the
+number that says whether a throughput is actually good, so the harness
+carries it next to every throughput figure.
+
+Notes on methodology:
+* FLOPs come from ``compiled.cost_analysis()['flops']`` of ONE training
+  step (fwd + bwd + optimizer). Pallas custom calls report zero flops to
+  XLA, so benches that route through hand kernels must cost-analyze the
+  numerically identical non-Pallas step (same model math) and reuse that
+  count for both paths.
+* Peak is the chip's dense peak for the matmul precision actually used,
+  from a device_kind table (v5e: 197 bf16 TFLOP/s; bf16 and f32 share the
+  MXU peak via XLA's f32-as-3-bf16-passes, so f32 workloads are reported
+  against the same ceiling with the convention noted in the JSON).
+  Override with PADDLE_TPU_PEAK_TFLOPS for new chips.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+# dense bf16 peak TFLOP/s by jax device_kind
+_PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0,       # v5e
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,            # v5p
+    "TPU v4": 275.0,
+    "TPU v6 lite": 918.0,       # v6e / Trillium
+    "cpu": None,
+}
+
+
+def peak_flops_per_sec() -> Optional[float]:
+    """Chip peak in FLOP/s, or None when unknown (mfu omitted then)."""
+    env = os.environ.get("PADDLE_TPU_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = jax.devices()[0].device_kind
+    tf = _PEAK_TFLOPS.get(kind)
+    return None if tf is None else tf * 1e12
+
+
+def step_flops(fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one call of ``fn(*args)`` per XLA cost analysis."""
+    try:
+        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca["flops"])
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def attach_mfu(result: dict, flops_per_step: Optional[float],
+               sec_per_step: float) -> dict:
+    """Add mfu + gflops_per_step fields to a bench JSON record."""
+    if flops_per_step:
+        result["gflops_per_step"] = round(flops_per_step / 1e9, 2)
+        peak = peak_flops_per_sec()
+        if peak:
+            result["mfu"] = round(flops_per_step / sec_per_step / peak, 4)
+            result["peak_tflops"] = round(peak / 1e12, 1)
+    return result

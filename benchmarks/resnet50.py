@@ -78,28 +78,87 @@ def build(batch: int = BATCH, bf16: bool = True):
     rs = np.random.RandomState(0)
     xs = jnp.asarray(rs.rand(NBUF, batch, IMAGE, IMAGE, 3), jnp.float32)
     ys = jnp.asarray(rs.randint(0, CLASSES, (NBUF, batch)), jnp.int32)
-    return run_n, params, state, (xs, ys)
+    return run_n, step_fn, params, state, (xs, ys)
 
 
 def run(iters: int = 20, repeats: int = 2, batch: int = BATCH):
-    run_n, params, state, b = build(batch)
-    run_n(params, state, *b, 1)
+    from benchmarks.mfu import attach_mfu, step_flops
+    from benchmarks.timing import chained_ms_per_step
 
-    def timed(n):
-        t0 = time.perf_counter()
-        _, _, loss = run_n(params, state, *b, n)
-        float(loss)
-        return time.perf_counter() - t0
-
-    t_short = min(timed(1) for _ in range(repeats))
-    t_long = min(timed(iters + 1) for _ in range(repeats))
-    sec = max(t_long - t_short, 1e-9) / iters
+    run_n, step_fn, params, state, b = build(batch)
+    sec = chained_ms_per_step(run_n, (params, state) + b, iters,
+                              repeats) / 1e3
     ips = batch / sec
+    flops = step_flops(step_fn, params, state, b[0][0], b[1][0])
     # key carries train-mode-BN semantics (r1 measured inference-mode BN)
-    return {"metric": "resnet50_train_images_per_sec_bs64_224_trainbn",
-            "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": None,  # no published reference ResNet number
-            "note": "train-mode BN with stat updates, 4 distinct rotating batches"}
+    return attach_mfu(
+        {"metric": "resnet50_train_images_per_sec_bs64_224_trainbn",
+         "value": round(ips, 2), "unit": "images/sec",
+         "vs_baseline": None,  # no published reference ResNet number
+         "note": "train-mode BN with stat updates, 4 distinct rotating batches"},
+        flops, sec)
+
+
+def run_with_infeed(steps: int = 24, batch: int = BATCH):
+    """images/sec INCLUDING host->HBM infeed, via the data/prefetch.py
+    DoubleBuffer (the DataProvider.h:249 capability): a worker thread
+    converts numpy batches (bf16 on host — half the transfer bytes, and the
+    model computes in bf16 anyway) and device_puts them while the previous
+    step computes; dispatch is async so transfer and compute overlap.
+
+    Reports the end-to-end rate and the overlap ratio vs the compute-only
+    number (1.0 == infeed fully hidden). On this rig the host->device link
+    is a remote tunnel, so the ratio is a lower bound on what a local host
+    achieves.
+    """
+    from paddle_tpu.data.prefetch import DoubleBuffer
+
+    run_n, step_fn, params, state, b = build(batch)
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    rs = np.random.RandomState(1)
+    host_batches = [(rs.rand(batch, IMAGE, IMAGE, 3).astype(np.float32),
+                     rs.randint(0, CLASSES, (batch,)).astype(np.int32))
+                    for _ in range(NBUF)]
+
+    total = steps + 4                       # warmup + pipeline depth; the
+                                            # worker exits when exhausted
+                                            # (no leaked thread / pinned HBM)
+    def gen():
+        for i in range(total):
+            yield host_batches[i % NBUF]
+
+    def to_device(hb):
+        x, y = hb
+        return (jax.device_put(jnp.asarray(x, jnp.bfloat16)),
+                jax.device_put(jnp.asarray(y)))
+
+    db = iter(DoubleBuffer(gen, depth=2, transform=to_device))
+    for _ in range(2):                      # warm: compile + fill pipeline
+        x, y = next(db)
+        params, state, loss = step(params, state, x, y)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        x, y = next(db)
+        params, state, loss = step(params, state, x, y)
+    float(loss)                             # drain the async queue
+    e2e = (time.perf_counter() - t0) / steps
+
+    # compute-only rate for the overlap ratio (same method as run())
+    from benchmarks.timing import chained_ms_per_step
+    staged = (jnp.asarray(np.stack([hb[0] for hb in host_batches])),
+              jnp.asarray(np.stack([hb[1] for hb in host_batches])))
+    compute = chained_ms_per_step(run_n, (params, state) + staged, 12,
+                                  2) / 1e3
+    return {"metric": "resnet50_train_images_per_sec_bs64_incl_infeed",
+            "value": round(batch / e2e, 2), "unit": "images/sec",
+            "vs_baseline": None,
+            "compute_only_images_per_sec": round(batch / compute, 2),
+            "overlap_ratio": round(compute / e2e, 3),
+            "note": "DoubleBuffer host->HBM feed overlapped with compute; "
+                    "host link is a remote tunnel (deployment lower bound)"}
 
 
 if __name__ == "__main__":
@@ -108,3 +167,4 @@ if __name__ == "__main__":
     import sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     print(json.dumps(run()))
+    print(json.dumps(run_with_infeed()))

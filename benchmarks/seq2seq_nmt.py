@@ -15,8 +15,6 @@ Methodology (honest-bench notes):
 
 from __future__ import annotations
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -69,27 +67,25 @@ def build():
     tlens = jnp.asarray(rs.randint(MIN_LEN, SEQ + 1, (NBUF, BATCH)), jnp.int32)
     # true target tokens per step, averaged over the rotation
     tokens_per_step = float(np.asarray(tlens).sum()) / NBUF
-    return run_n, params, state, (srcs, slens, tins, touts, tlens), tokens_per_step
+    return (run_n, step_fn, params, state, (srcs, slens, tins, touts, tlens),
+            tokens_per_step)
 
 
 def run(iters: int = 30, repeats: int = 2):
-    run_n, params, state, b, tokens_per_step = build()
-    run_n(params, state, *b, 1)
+    from benchmarks.mfu import attach_mfu, step_flops
+    from benchmarks.timing import chained_ms_per_step
 
-    def timed(n):
-        t0 = time.perf_counter()
-        _, _, loss = run_n(params, state, *b, n)
-        float(loss)
-        return time.perf_counter() - t0
-
-    t_short = min(timed(1) for _ in range(repeats))
-    t_long = min(timed(iters + 1) for _ in range(repeats))
-    sec = max(t_long - t_short, 1e-9) / iters
+    run_n, step_fn, params, state, b, tokens_per_step = build()
+    sec = chained_ms_per_step(run_n, (params, state) + b, iters,
+                              repeats) / 1e3
+    flops = step_flops(step_fn, params, state, *(a[0] for a in b))
     # true-token semantics + varied lengths are in the key (vs r1's padded-len32)
-    return {"metric": "seq2seq_nmt_train_true_tokens_per_sec_h512_len16-32_bs64",
-            "value": round(tokens_per_step / sec, 1), "unit": "tokens/sec",
-            "vs_baseline": None,  # reference published no seq2seq number
-            "note": "varied lengths 16..32, true-token count, 4 rotating batches"}
+    return attach_mfu(
+        {"metric": "seq2seq_nmt_train_true_tokens_per_sec_h512_len16-32_bs64",
+         "value": round(tokens_per_step / sec, 1), "unit": "tokens/sec",
+         "vs_baseline": None,  # reference published no seq2seq number
+         "note": "varied lengths 16..32, true-token count, 4 rotating batches"},
+        flops, sec)
 
 
 if __name__ == "__main__":

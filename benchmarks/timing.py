@@ -1,0 +1,33 @@
+"""Shared chained-loop timing used by every bench.
+
+One methodology, one implementation: ``run_n(*args, n)`` executes n chained
+training steps in a single on-device ``lax.fori_loop`` dispatch and returns
+a carry whose last element is a scalar loss; we time a short and a long loop
+(best of ``repeats``) and difference them, cancelling the fixed dispatch +
+host-fetch latency that dominates under the remote TPU tunnel (where
+``block_until_ready`` timing is unreliable). Chained state (the carry
+threads params) prevents XLA from hoisting loop-invariant work out of the
+loop — the failure mode that invalidates naive forward-only timing loops.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def chained_ms_per_step(run_n, args, iters: int, repeats: int,
+                        short: int = 1) -> float:
+    """ms per step via short/long on-device-loop differencing."""
+
+    def timed(n):
+        t0 = time.perf_counter()
+        out = run_n(*args, n)
+        loss = out[-1]
+        float(loss)                     # force completion
+        return time.perf_counter() - t0
+
+    timed(short)                        # compile both trip counts
+    timed(short + iters)
+    t_short = min(timed(short) for _ in range(repeats))
+    t_long = min(timed(short + iters) for _ in range(repeats))
+    return max(t_long - t_short, 1e-9) / iters * 1e3

@@ -52,14 +52,11 @@ def export_inference_model(dirname: str, feed_names, fetch_vars,
     pruned = program.prune(fetch_names)
     os.makedirs(dirname, exist_ok=True)
     prog_dict = pruned.to_dict()
-    # forward-only bundle: route recurrent ops through the fused Pallas
-    # sequence kernel (no autodiff replay cost on an inference program).
-    # Marked on the SERIALIZED dict — prune() shares live op objects with
-    # the source program, which must keep training un-fused.
-    for block in prog_dict["blocks"]:
-        for op in block["ops"]:
-            if op["type"] in ("lstm", "gru"):
-                op["attrs"] = dict(op["attrs"], fused=True)
+    # recurrent ops in the bundle keep fused=auto (ops/rnn.py picks the
+    # Pallas whole-sequence kernel for small latency-bound batches and
+    # XLA's scan for large ones — the measured crossover is documented in
+    # docs/design/fused_rnn_bench.md); ops with an explicit fused attr
+    # keep it
     meta = {"program": prog_dict,
             "feed_names": list(feed_names),
             "fetch_names": fetch_names}

@@ -359,7 +359,7 @@ def _lstm(ins, attrs):
                       forget_bias=attrs.get("forget_bias", 1.0),
                       # inference bundles set this at export: forward-only
                       # programs run the fused Pallas sequence kernel
-                      fused=attrs.get("fused", False))
+                      fused=attrs.get("fused", None))
     return {"Out": [out], "LastH": [state.h], "LastC": [state.c]}
 
 
@@ -370,7 +370,7 @@ def _gru(ins, attrs):
                     ins["W"][0], ins["U"][0],
                     ins["B"][0] if "B" in ins else None,
                     reverse=attrs.get("reverse", False),
-                    fused=attrs.get("fused", False))
+                    fused=attrs.get("fused", None))
     return {"Out": [out], "LastH": [last]}
 
 

@@ -3,13 +3,15 @@
 
 from .embeddings import DeepFM, Recommender, Word2Vec
 from .generative import GAN, VAE
-from .image import LeNet, ResNet, SmallNet, VGG, resnet50
+from .image import (AlexNet, GoogleNet, LeNet, ResNet, SmallNet,
+                    VGG, resnet50)
 from .mlp import MnistMLP
 from .seq2seq import AttentionSeq2Seq
 from .tagger import BiLSTMCRFTagger, LinearCRFTagger
 from .text_cls import BiLSTMTextCls, ConvTextCls, LSTMTextCls
 
-__all__ = ["MnistMLP", "LeNet", "SmallNet", "VGG", "ResNet", "resnet50",
+__all__ = [
+    "AlexNet", "GoogleNet", "MnistMLP", "LeNet", "SmallNet", "VGG", "ResNet", "resnet50",
            "LSTMTextCls", "BiLSTMTextCls", "ConvTextCls",
            "AttentionSeq2Seq", "LinearCRFTagger", "BiLSTMCRFTagger",
            "Word2Vec", "Recommender", "DeepFM", "GAN", "VAE"]

@@ -5,6 +5,9 @@ Analogs:
 * VGG-16      — ``benchmark/paddle/image/vgg.py`` + networks.py vgg_16_network:468
 * ResNet-N    — ``benchmark/paddle/image/resnet.py`` (layer_num 50/101/152)
 * SmallNet    — ``benchmark/paddle/image/smallnet_mnist_cifar.py`` (cifar-quick)
+* AlexNet     — ``benchmark/paddle/image/alexnet.py``
+* GoogleNet   — ``benchmark/paddle/image/googlenet.py`` (inception v1 with
+                the two auxiliary towers, loss-weighted 0.3 as in the config)
 
 TPU-first: NHWC layout (XLA's preferred conv layout on TPU), BatchNorm running
 stats via the Module 'stats' convention, bottleneck convs sized to keep the MXU
@@ -206,3 +209,150 @@ class ResNet(nn.Module):
 
 def resnet50(classes: int = 1000, **kw) -> ResNet:
     return ResNet(50, classes, **kw)
+
+
+class AlexNet(nn.Module):
+    """AlexNet (benchmark/paddle/image/alexnet.py): 5 convs with LRN after
+    the first two, 3 pools, two dropout-4096 fcs. Input [B, 224, 224, 3].
+
+    ``rng=None`` skips dropout (deterministic eval); pass a PRNG key and
+    train=True for the reference's training configuration.
+    """
+
+    def __init__(self, classes: int = 1000, in_ch: int = 3):
+        super().__init__()
+        self.c1 = nn.Conv2D(in_ch, 96, 11, stride=4, padding=2, act="relu")
+        self.c2 = nn.Conv2D(96, 256, 5, padding=2, act="relu")
+        self.c3 = nn.Conv2D(256, 384, 3, padding=1, act="relu")
+        self.c4 = nn.Conv2D(384, 384, 3, padding=1, act="relu")
+        self.c5 = nn.Conv2D(384, 256, 3, padding=1, act="relu")
+        self.fc1 = nn.Linear(6 * 6 * 256, 4096, act="relu")
+        self.fc2 = nn.Linear(4096, 4096, act="relu")
+        self.out = nn.Linear(4096, classes)
+
+    def __call__(self, params, x, train=False, rng=None, **kw):
+        from ..ops.norm import lrn
+        from ..ops.random import dropout
+        h = self.c1(params["c1"], x)
+        h = P.max_pool2d(lrn(h), 3, 2)
+        h = self.c2(params["c2"], h)
+        h = P.max_pool2d(lrn(h), 3, 2)
+        h = self.c3(params["c3"], h)
+        h = self.c4(params["c4"], h)
+        h = P.max_pool2d(self.c5(params["c5"], h), 3, 2)
+        h = h.reshape(h.shape[0], -1)
+        h = self.fc1(params["fc1"], h)
+        if train and rng is not None:
+            r1, r2 = jax.random.split(rng)
+            h = dropout(h, 0.5, r1)
+        h = self.fc2(params["fc2"], h)
+        if train and rng is not None:
+            h = dropout(h, 0.5, r2)
+        return self.out(params["out"], h)
+
+    def loss(self, params, x, labels, train=False, rng=None):
+        logits = self(params, x, train=train, rng=rng)
+        return jnp.mean(L.softmax_with_cross_entropy(logits, labels))
+
+
+class _Inception(nn.Module):
+    """One inception-v1 block (googlenet.py inception()): 1x1 / 1x1-3x3 /
+    1x1-5x5 / pool-1x1 branches, channel-concatenated (NHWC)."""
+
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = nn.Conv2D(cin, c1, 1, act="relu")
+        self.b3r = nn.Conv2D(cin, c3r, 1, act="relu")
+        self.b3 = nn.Conv2D(c3r, c3, 3, padding=1, act="relu")
+        self.b5r = nn.Conv2D(cin, c5r, 1, act="relu")
+        self.b5 = nn.Conv2D(c5r, c5, 5, padding=2, act="relu")
+        self.bp = nn.Conv2D(cin, proj, 1, act="relu")
+        self.cout = c1 + c3 + c5 + proj
+
+    def __call__(self, params, x, **kw):
+        a = self.b1(params["b1"], x)
+        b = self.b3(params["b3"], self.b3r(params["b3r"], x))
+        c = self.b5(params["b5"], self.b5r(params["b5r"], x))
+        d = self.bp(params["bp"], P.max_pool2d(x, 3, 1, padding=1))
+        return jnp.concatenate([a, b, c, d], axis=-1)
+
+
+class _AuxHead(nn.Module):
+    """GoogleNet auxiliary classifier (googlenet.py o1/o2 towers)."""
+
+    def __init__(self, cin, classes):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, 128, 1, act="relu")
+        self.fc = nn.Linear(4 * 4 * 128, 1024, act="relu")
+        self.out = nn.Linear(1024, classes)
+
+    def __call__(self, params, x, train=False, rng=None, **kw):
+        from ..ops.random import dropout
+        h = P.avg_pool2d(x, 5, 3)
+        h = self.conv(params["conv"], h)
+        h = h.reshape(h.shape[0], -1)
+        h = self.fc(params["fc"], h)
+        if train and rng is not None:
+            h = dropout(h, 0.7, rng)
+        return self.out(params["out"], h)
+
+
+class GoogleNet(nn.Module):
+    """GoogLeNet / inception v1 (benchmark/paddle/image/googlenet.py).
+    Input [B, 224, 224, 3]; train mode returns (main, aux1, aux2) logits,
+    combined in :meth:`loss` with the config's 0.3 aux weights."""
+
+    def __init__(self, classes: int = 1000, in_ch: int = 3):
+        super().__init__()
+        self.stem1 = nn.Conv2D(in_ch, 64, 7, stride=2, padding=3, act="relu")
+        self.stem2 = nn.Conv2D(64, 64, 1, act="relu")
+        self.stem3 = nn.Conv2D(64, 192, 3, padding=1, act="relu")
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.aux1 = _AuxHead(512, classes)   # after 4a
+        self.aux2 = _AuxHead(528, classes)   # after 4d
+        self.head = nn.Linear(1024, classes)
+
+    def __call__(self, params, x, train=False, rng=None, **kw):
+        from ..ops.norm import lrn
+        from ..ops.random import dropout
+        r1 = r2 = r3 = None
+        if train and rng is not None:
+            r1, r2, r3 = jax.random.split(rng, 3)
+        h = P.max_pool2d(self.stem1(params["stem1"], x), 3, 2, padding=1)
+        h = lrn(h)
+        h = self.stem3(params["stem3"], self.stem2(params["stem2"], h))
+        h = P.max_pool2d(lrn(h), 3, 2, padding=1)
+        h = self.i3b(params["i3b"], self.i3a(params["i3a"], h))
+        h = P.max_pool2d(h, 3, 2, padding=1)
+        h = self.i4a(params["i4a"], h)
+        a1 = (self.aux1(params["aux1"], h, train=train, rng=r1)
+              if train else None)
+        h = self.i4c(params["i4c"], self.i4b(params["i4b"], h))
+        h = self.i4d(params["i4d"], h)
+        a2 = (self.aux2(params["aux2"], h, train=train, rng=r2)
+              if train else None)
+        h = self.i4e(params["i4e"], h)
+        h = P.max_pool2d(h, 3, 2, padding=1)
+        h = self.i5b(params["i5b"], self.i5a(params["i5a"], h))
+        h = P.global_avg_pool2d(h)
+        if train and rng is not None:
+            h = dropout(h, 0.4, r3)
+        main = self.head(params["head"], h)
+        return (main, a1, a2) if train else main
+
+    def loss(self, params, x, labels, train=False, rng=None):
+        out = self(params, x, train=train, rng=rng)
+        if train:
+            main, a1, a2 = out
+            l = jnp.mean(L.softmax_with_cross_entropy(main, labels))
+            l = l + 0.3 * jnp.mean(L.softmax_with_cross_entropy(a1, labels))
+            return l + 0.3 * jnp.mean(L.softmax_with_cross_entropy(a2, labels))
+        return jnp.mean(L.softmax_with_cross_entropy(out, labels))

@@ -24,7 +24,6 @@ CPU mesh (tests/test_pallas.py); numerics match the jnp reference path.
 from __future__ import annotations
 
 import functools
-import math
 from typing import Optional, Tuple
 
 import jax
@@ -187,13 +186,16 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # layout helpers + pallas_call wrappers
 # ---------------------------------------------------------------------------
 
-def _blocks(T: int, block_q: int, block_k: int) -> Tuple[int, int, int]:
+def _blocks(T: int, S: int, block_q: int,
+            block_k: int) -> Tuple[int, int, int, int]:
+    """Block sizes + padded lengths for q (len T) and kv (len S). The two
+    sides pad independently — cross-attention / half-block calls (zigzag
+    ring steps) have S != T."""
     blk_q = min(block_q, max(8, T))
-    blk_k = min(block_k, max(8, T))
-    # padded length must tile exactly under BOTH block sizes
-    step = math.lcm(blk_q, blk_k)
-    Tp = -(-T // step) * step
-    return blk_q, blk_k, Tp
+    blk_k = min(block_k, max(8, S))
+    Tp = -(-T // blk_q) * blk_q
+    Sp = -(-S // blk_k) * blk_k
+    return blk_q, blk_k, Tp, Sp
 
 
 def _to_bh(x, Tp):
@@ -220,20 +222,23 @@ def _row_to_bh(x, Tp):
 
 
 def _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
-    """Returns (o [B,T,H,D], lse [B,T,H] f32)."""
+    """Returns (o [B,T,H,D], lse [B,T,H] f32). k/v may be shorter or longer
+    than q (S != T) for cross-attention-shaped blocks; ``causal`` assumes
+    S == T."""
     B, T, H, D = q.shape
-    blk_q, blk_k, Tp = _blocks(T, block_q, block_k)
-    qb, kb, vb = _to_bh(q, Tp), _to_bh(k, Tp), _to_bh(v, Tp)
+    S = k.shape[1]
+    blk_q, blk_k, Tp, Sp = _blocks(T, S, block_q, block_k)
+    qb, kb, vb = _to_bh(q, Tp), _to_bh(k, Sp), _to_bh(v, Sp)
     kernel = functools.partial(_fa_fwd_kernel, block_k=blk_k, scale=scale,
-                               causal=causal, seq_len=Tp, true_len=T)
+                               causal=causal, seq_len=Sp, true_len=S)
     grid = (B * H, Tp // blk_q)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, Tp, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, Tp, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Sp, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Sp, D), lambda bh, qi: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
@@ -252,50 +257,57 @@ def _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                  interpret, delta=None):
-    """Returns (dq, dk, dv) with the same [B,T,H,D] layout as q/k/v."""
+    """Returns (dq, dk, dv); dq follows q's [B,T,H,D], dk/dv follow k/v's
+    [B,S,H,D] (S != T for the zigzag half-block steps)."""
     B, T, H, D = q.shape
-    blk_q, blk_k, Tp = _blocks(T, block_q, block_k)
+    S = k.shape[1]
+    blk_q, blk_k, Tp, Sp = _blocks(T, S, block_q, block_k)
     if delta is None:
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1)
-    qb, kb, vb, dob = (_to_bh(x, Tp) for x in (q, k, v, do))
+    qb, dob = _to_bh(q, Tp), _to_bh(do, Tp)
+    kb, vb = _to_bh(k, Sp), _to_bh(v, Sp)
     lseb, deltab = _row_to_bh(lse, Tp), _row_to_bh(delta, Tp)
 
     q_spec = pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0))
-    full_spec = pl.BlockSpec((1, Tp, D), lambda bh, i: (bh, 0, 0))
+    q_full_spec = pl.BlockSpec((1, Tp, D), lambda bh, i: (bh, 0, 0))
+    kv_full_spec = pl.BlockSpec((1, Sp, D), lambda bh, i: (bh, 0, 0))
     row_q_spec = pl.BlockSpec((1, blk_q, 1), lambda bh, qi: (bh, qi, 0))
     row_full_spec = pl.BlockSpec((1, Tp, 1), lambda bh, i: (bh, 0, 0))
     k_spec = pl.BlockSpec((1, blk_k, D), lambda bh, ki: (bh, ki, 0))
 
+    # dq: grid over q blocks, stream kv tiles (loop bound Sp, mask keys >= S)
     dq_kernel = functools.partial(_fa_bwd_dq_kernel, block_k=blk_k,
-                                  scale=scale, causal=causal, seq_len=Tp,
-                                  true_len=T)
+                                  scale=scale, causal=causal, seq_len=Sp,
+                                  true_len=S)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B * H, Tp // blk_q),
-        in_specs=[q_spec, full_spec, full_spec, q_spec, row_q_spec,
+        in_specs=[q_spec, kv_full_spec, kv_full_spec, q_spec, row_q_spec,
                   row_q_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
         interpret=interpret,
     )(qb, kb, vb, dob, lseb, deltab)
 
+    # dk/dv: grid over kv blocks, stream q tiles (loop bound Tp; padded q
+    # rows have zero do/delta so they contribute nothing); mask keys >= S
     dkv_kernel = functools.partial(_fa_bwd_dkv_kernel, block_q=blk_q,
                                    scale=scale, causal=causal, seq_len=Tp,
-                                   true_len=T)
+                                   true_len=S)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(B * H, Tp // blk_k),
-        in_specs=[full_spec, k_spec, k_spec, full_spec, row_full_spec,
+        grid=(B * H, Sp // blk_k),
+        in_specs=[q_full_spec, k_spec, k_spec, q_full_spec, row_full_spec,
                   row_full_spec],
         out_specs=[k_spec, k_spec],
-        out_shape=[jax.ShapeDtypeStruct((B * H, Tp, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, Tp, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Sp, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, Sp, D), v.dtype)],
         interpret=interpret,
     )(qb, kb, vb, dob, lseb, deltab)
 
-    return (_from_bh(dq, B, T, H, D), _from_bh(dk, B, T, H, D),
-            _from_bh(dv, B, T, H, D))
+    return (_from_bh(dq, B, T, H, D), _from_bh(dk, B, S, H, D),
+            _from_bh(dv, B, S, H, D))
 
 
 # ---------------------------------------------------------------------------
@@ -432,20 +444,56 @@ def _lstm_seq_kernel(xw_ref, len_ref, u_ref, b_ref, h0_ref, c0_ref,
     ct_ref[...] = c.astype(ct_ref.dtype)
 
 
+def _lstm_seq_train_kernel(xw_ref, len_ref, u_ref, b_ref, h0_ref, c0_ref,
+                           out_ref, ht_ref, ct_ref, cseq_ref, *, T: int,
+                           H: int, forget_bias: float):
+    """Training-mode forward: identical math to _lstm_seq_kernel, plus the
+    post-mask cell sequence saved for the hand-written backward (the
+    reference's fused hl_lstm likewise saved per-step cell state)."""
+    u = u_ref[...].astype(jnp.float32)
+    bias = b_ref[...].astype(jnp.float32)
+    lens = len_ref[...].astype(jnp.float32)
+    h0 = h0_ref[...].astype(jnp.float32)
+    c0 = c0_ref[...].astype(jnp.float32)
+
+    def step(t, carry):
+        h, c = carry
+        xw_t = xw_ref[t].astype(jnp.float32)
+        gates = xw_t + jax.lax.dot(h, u,
+                                   preferred_element_type=jnp.float32) + bias
+        i = jax.nn.sigmoid(gates[:, :H])
+        f = jax.nn.sigmoid(gates[:, H:2 * H] + forget_bias)
+        g = jnp.tanh(gates[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(gates[:, 3 * H:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        m = (t.astype(jnp.float32) < lens).astype(jnp.float32)
+        h = m * h_new + (1.0 - m) * h
+        c = m * c_new + (1.0 - m) * c
+        out_ref[t] = (m * h).astype(out_ref.dtype)
+        cseq_ref[t] = c.astype(cseq_ref.dtype)
+        return h, c
+
+    h, c = jax.lax.fori_loop(0, T, step, (h0, c0))
+    ht_ref[...] = h.astype(ht_ref.dtype)
+    ct_ref[...] = c.astype(ct_ref.dtype)
+
+
 def lstm_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
                         b: Optional[jax.Array] = None,
                         h0: Optional[jax.Array] = None,
                         c0: Optional[jax.Array] = None, *,
                         forget_bias: float = 0.0, block_b: int = 8,
+                        save_cell: bool = False,
                         interpret: Optional[bool] = None):
     """Masked LSTM over a whole sequence in one Pallas kernel.
 
     xw: precomputed x@W [B, T, 4H]; lengths: [B] int; u: [H, 4H];
-    returns (out [B, T, H], hT [B, H], cT [B, H]).
-
-    Forward-path kernel (inference / frozen encoders): gradients flow through
-    the lax.scan implementation in ops/rnn.py, which computes identical math
-    — use this where the reference used the fused hl_lstm forward kernels.
+    returns (out [B, T, H], hT [B, H], cT [B, H]), plus the post-mask cell
+    sequence c_seq [B, T, H] when ``save_cell`` (the residual the
+    hand-written backward kernel consumes — ops/rnn.py wires the custom
+    VJP, so training uses this kernel in BOTH directions, matching the
+    reference's training-mode fused hl_lstm kernels).
     """
     B, T, G = xw.shape
     if G % 4:
@@ -471,32 +519,188 @@ def lstm_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
     xw_tm = jnp.swapaxes(xw, 0, 1)               # time-major [T, Bp, 4H]
     b2 = b.reshape(1, G)
 
+    in_specs = [
+        pl.BlockSpec((T, blk, G), lambda i: (0, i, 0)),
+        pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+        pl.BlockSpec((H, G), lambda i: (0, 0)),
+        pl.BlockSpec((1, G), lambda i: (0, 0)),
+        pl.BlockSpec((blk, H), lambda i: (i, 0)),
+        pl.BlockSpec((blk, H), lambda i: (i, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((T, blk, H), lambda i: (0, i, 0)),
+        pl.BlockSpec((blk, H), lambda i: (i, 0)),
+        pl.BlockSpec((blk, H), lambda i: (i, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((T, Bp, H), xw.dtype),
+        jax.ShapeDtypeStruct((Bp, H), xw.dtype),
+        jax.ShapeDtypeStruct((Bp, H), xw.dtype),
+    ]
+    if save_cell:
+        out_specs.append(pl.BlockSpec((T, blk, H), lambda i: (0, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((T, Bp, H), xw.dtype))
+        kernel = functools.partial(_lstm_seq_train_kernel, T=T, H=H,
+                                   forget_bias=forget_bias)
+        out, ht, ct, cseq = pl.pallas_call(
+            kernel, grid=(Bp // blk,), in_specs=in_specs,
+            out_specs=out_specs, out_shape=out_shape,
+            interpret=bool(interpret))(xw_tm, lens, u, b2, h0, c0)
+        return (jnp.swapaxes(out, 0, 1)[:B], ht[:B], ct[:B],
+                jnp.swapaxes(cseq, 0, 1)[:B])
+
     kernel = functools.partial(_lstm_seq_kernel, T=T, H=H,
                                forget_bias=forget_bias)
     out, ht, ct = pl.pallas_call(
         kernel,
         grid=(Bp // blk,),
-        in_specs=[
-            pl.BlockSpec((T, blk, G), lambda i: (0, i, 0)),
-            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
-            pl.BlockSpec((H, G), lambda i: (0, 0)),
-            pl.BlockSpec((1, G), lambda i: (0, 0)),
-            pl.BlockSpec((blk, H), lambda i: (i, 0)),
-            pl.BlockSpec((blk, H), lambda i: (i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((T, blk, H), lambda i: (0, i, 0)),
-            pl.BlockSpec((blk, H), lambda i: (i, 0)),
-            pl.BlockSpec((blk, H), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, Bp, H), xw.dtype),
-            jax.ShapeDtypeStruct((Bp, H), xw.dtype),
-            jax.ShapeDtypeStruct((Bp, H), xw.dtype),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=bool(interpret),
     )(xw_tm, lens, u, b2, h0, c0)
     return jnp.swapaxes(out, 0, 1)[:B], ht[:B], ct[:B]
+
+
+def _lstm_seq_bwd_kernel(xw_ref, len_ref, u_ref, b_ref, h0_ref, c0_ref,
+                         out_ref, cseq_ref, gout_ref, ght_ref, gct_ref,
+                         dxw_ref, dh0_ref, dc0_ref, du_ref, *, T: int,
+                         H: int, forget_bias: float):
+    """Hand-written whole-sequence LSTM backward — the
+    hl_lstm_parallel_backward_data/_weight analog: the reverse-time gate
+    recurrence runs entirely in VMEM, recomputing gate activations from the
+    saved (h, c) sequences instead of storing [T, B, 4H] activations.
+
+    Per reverse step: recompute gates from xw_t + h_{t-1}·u + b (h_{t-1} is
+    the saved masked output — identical to the true carry on every live
+    step, and irrelevant on dead steps where the mask zeroes all grads),
+    then the standard LSTM adjoints. dW/dx/db are large batched matmuls
+    left to XLA outside (ops/rnn.py); dU accumulates in VMEM here because
+    it needs the per-step h_{t-1}·dgates products.
+    """
+    u = u_ref[...].astype(jnp.float32)
+    bias = b_ref[...].astype(jnp.float32)
+    lens = len_ref[...].astype(jnp.float32)
+    h0 = h0_ref[...].astype(jnp.float32)
+    c0 = c0_ref[...].astype(jnp.float32)
+
+    def step(s, carry):
+        dh, dc, du = carry
+        t = T - 1 - s
+        tm1 = jnp.maximum(t - 1, 0)
+        live_prev = (t > 0).astype(jnp.float32)
+        h_prev = (live_prev * out_ref[tm1].astype(jnp.float32)
+                  + (1.0 - live_prev) * h0)
+        c_prev = (live_prev * cseq_ref[tm1].astype(jnp.float32)
+                  + (1.0 - live_prev) * c0)
+        xw_t = xw_ref[t].astype(jnp.float32)
+        gates = xw_t + jax.lax.dot(h_prev, u,
+                                   preferred_element_type=jnp.float32) + bias
+        i = jax.nn.sigmoid(gates[:, :H])
+        f = jax.nn.sigmoid(gates[:, H:2 * H] + forget_bias)
+        g = jnp.tanh(gates[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(gates[:, 3 * H:])
+        c_cur = f * c_prev + i * g
+        tc = jnp.tanh(c_cur)
+
+        m = (t.astype(jnp.float32) < lens).astype(jnp.float32)   # [Bb, 1]
+        dh_t = dh + m * gout_ref[t].astype(jnp.float32)
+        dhp = m * dh_t
+        dct = m * dc + dhp * o * (1.0 - tc * tc)
+        do_ = dhp * tc
+        dgi = (dct * g) * i * (1.0 - i)
+        dgf = (dct * c_prev) * f * (1.0 - f)
+        dgg = (dct * i) * (1.0 - g * g)
+        dgo = do_ * o * (1.0 - o)
+        dgates = jnp.concatenate([dgi, dgf, dgg, dgo], axis=1)   # [Bb, 4H]
+        dxw_ref[t] = dgates.astype(dxw_ref.dtype)
+        du = du + jax.lax.dot_general(
+            h_prev, dgates, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [H, 4H]
+        dh_prev = (1.0 - m) * dh_t + jax.lax.dot_general(
+            dgates, u, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dc_prev = (1.0 - m) * dc + dct * f
+        return dh_prev, dc_prev, du
+
+    dh0_i = ght_ref[...].astype(jnp.float32)
+    dc0_i = gct_ref[...].astype(jnp.float32)
+    du0 = jnp.zeros((H, 4 * H), jnp.float32)
+    dh, dc, du = jax.lax.fori_loop(0, T, step, (dh0_i, dc0_i, du0))
+    dh0_ref[...] = dh.astype(dh0_ref.dtype)
+    dc0_ref[...] = dc.astype(dc0_ref.dtype)
+
+    # the du output block is shared by every grid program; the TPU grid is
+    # sequential, so accumulate across batch tiles in place
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        du_ref[...] = jnp.zeros_like(du_ref)
+
+    du_ref[...] += du.astype(du_ref.dtype)
+
+
+def lstm_sequence_fused_bwd(xw, lengths, u, b, h0, c0, out_seq, c_seq,
+                            g_out, g_ht, g_ct, *, forget_bias: float = 0.0,
+                            block_b: int = 8,
+                            interpret: Optional[bool] = None):
+    """Backward of :func:`lstm_sequence_fused` (save_cell residuals).
+
+    Returns (dxw [B,T,4H], dh0 [B,H], dc0 [B,H], du [H,4H] f32).
+    """
+    B, T, G = xw.shape
+    H = G // 4
+    if interpret is None:
+        interpret = not _on_tpu()
+    blk = min(block_b, B)
+    Bp = -(-B // blk) * blk
+    lens = lengths.astype(jnp.float32).reshape(B, 1)
+    if Bp > B:
+        pad = Bp - B
+        pad3 = ((0, pad), (0, 0), (0, 0))
+        pad2 = ((0, pad), (0, 0))
+        xw = jnp.pad(xw, pad3)
+        out_seq = jnp.pad(out_seq, pad3)
+        c_seq = jnp.pad(c_seq, pad3)
+        g_out = jnp.pad(g_out, pad3)
+        lens = jnp.pad(lens, pad2)
+        h0 = jnp.pad(h0, pad2)
+        c0 = jnp.pad(c0, pad2)
+        g_ht = jnp.pad(g_ht, pad2)
+        g_ct = jnp.pad(g_ct, pad2)
+    tm = lambda a: jnp.swapaxes(a, 0, 1)
+    b2 = b.reshape(1, G)
+
+    kernel = functools.partial(_lstm_seq_bwd_kernel, T=T, H=H,
+                               forget_bias=forget_bias)
+    seq_spec = lambda width: pl.BlockSpec((T, blk, width), lambda i: (0, i, 0))
+    vec_spec = pl.BlockSpec((blk, H), lambda i: (i, 0))
+    dxw, dh0, dc0, du = pl.pallas_call(
+        kernel,
+        grid=(Bp // blk,),
+        in_specs=[
+            seq_spec(G),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((H, G), lambda i: (0, 0)),
+            pl.BlockSpec((1, G), lambda i: (0, 0)),
+            vec_spec, vec_spec,
+            seq_spec(H), seq_spec(H), seq_spec(H),
+            vec_spec, vec_spec,
+        ],
+        out_specs=[
+            seq_spec(G),
+            vec_spec, vec_spec,
+            pl.BlockSpec((H, G), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, Bp, G), xw.dtype),
+            jax.ShapeDtypeStruct((Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((H, G), jnp.float32),
+        ],
+        interpret=bool(interpret),
+    )(tm(xw), lens, u, b2, h0, c0, tm(out_seq), tm(c_seq), tm(g_out),
+      g_ht, g_ct)
+    return jnp.swapaxes(dxw, 0, 1)[:B], dh0[:B], dc0[:B], du
 
 
 def _gru_seq_kernel(xw_ref, len_ref, u_ref, h0_ref, out_ref, ht_ref,
@@ -529,6 +733,125 @@ def _gru_seq_kernel(xw_ref, len_ref, u_ref, h0_ref, out_ref, ht_ref,
 
     h = jax.lax.fori_loop(0, T, step, h0)
     ht_ref[...] = h.astype(ht_ref.dtype)
+
+
+def _gru_seq_bwd_kernel(xw_ref, len_ref, u_ref, h0_ref, out_ref, gout_ref,
+                        ght_ref, dxw_ref, dh0_ref, du_ref, *, T: int, H: int):
+    """Hand-written whole-sequence GRU backward (hl_gpu_gru.cuh backward
+    analog). Everything is recomputable from xw (bias pre-added) and the
+    saved masked output sequence, so no extra residuals are stored; the
+    reverse recurrence and dU accumulation stay in VMEM."""
+    u = u_ref[...].astype(jnp.float32)
+    uz, ur, uc = u[:, :H], u[:, H:2 * H], u[:, 2 * H:]
+    lens = len_ref[...].astype(jnp.float32)
+    h0 = h0_ref[...].astype(jnp.float32)
+
+    def step(s, carry):
+        dh, du = carry
+        t = T - 1 - s
+        tm1 = jnp.maximum(t - 1, 0)
+        live_prev = (t > 0).astype(jnp.float32)
+        h_prev = (live_prev * out_ref[tm1].astype(jnp.float32)
+                  + (1.0 - live_prev) * h0)
+        xw_t = xw_ref[t].astype(jnp.float32)
+        xz, xr, xc = xw_t[:, :H], xw_t[:, H:2 * H], xw_t[:, 2 * H:]
+        z = jax.nn.sigmoid(
+            xz + jax.lax.dot(h_prev, uz, preferred_element_type=jnp.float32))
+        r = jax.nn.sigmoid(
+            xr + jax.lax.dot(h_prev, ur, preferred_element_type=jnp.float32))
+        rh = r * h_prev
+        c = jnp.tanh(
+            xc + jax.lax.dot(rh, uc, preferred_element_type=jnp.float32))
+
+        m = (t.astype(jnp.float32) < lens).astype(jnp.float32)
+        dh_t = dh + m * gout_ref[t].astype(jnp.float32)
+        dhp = m * dh_t                              # grad wrt h'_t
+        # h' = (1-z) h_prev + z c
+        dgz = (dhp * (c - h_prev)) * z * (1.0 - z)
+        dgc = (dhp * z) * (1.0 - c * c)
+        drh = jax.lax.dot_general(dgc, uc, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dgr = (drh * h_prev) * r * (1.0 - r)
+        dh_prev = ((1.0 - m) * dh_t + dhp * (1.0 - z) + drh * r
+                   + jax.lax.dot_general(dgz, uz, (((1,), (1,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+                   + jax.lax.dot_general(dgr, ur, (((1,), (1,)), ((), ())),
+                                         preferred_element_type=jnp.float32))
+        dxw_ref[t] = jnp.concatenate([dgz, dgr, dgc],
+                                     axis=1).astype(dxw_ref.dtype)
+        ha = lambda lhs, rhs: jax.lax.dot_general(
+            lhs, rhs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        du = du + jnp.concatenate([ha(h_prev, dgz), ha(h_prev, dgr),
+                                   ha(rh, dgc)], axis=1)
+        return dh_prev, du
+
+    dh0_i = ght_ref[...].astype(jnp.float32)
+    du0 = jnp.zeros((H, 3 * H), jnp.float32)
+    dh, du = jax.lax.fori_loop(0, T, step, (dh0_i, du0))
+    dh0_ref[...] = dh.astype(dh0_ref.dtype)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        du_ref[...] = jnp.zeros_like(du_ref)
+
+    du_ref[...] += du.astype(du_ref.dtype)
+
+
+def gru_sequence_fused_bwd(xw, lengths, u, h0, out_seq, g_out, g_ht, *,
+                           block_b: int = 8,
+                           interpret: Optional[bool] = None):
+    """Backward of :func:`gru_sequence_fused` (xw carries the pre-added
+    bias, so its grad is also the bias grad summed outside).
+
+    Returns (dxw [B,T,3H], dh0 [B,H], du [H,3H] f32).
+    """
+    B, T, G = xw.shape
+    H = G // 3
+    if interpret is None:
+        interpret = not _on_tpu()
+    blk = min(block_b, B)
+    Bp = -(-B // blk) * blk
+    lens = lengths.astype(jnp.float32).reshape(B, 1)
+    if Bp > B:
+        pad = Bp - B
+        pad3 = ((0, pad), (0, 0), (0, 0))
+        pad2 = ((0, pad), (0, 0))
+        xw = jnp.pad(xw, pad3)
+        out_seq = jnp.pad(out_seq, pad3)
+        g_out = jnp.pad(g_out, pad3)
+        lens = jnp.pad(lens, pad2)
+        h0 = jnp.pad(h0, pad2)
+        g_ht = jnp.pad(g_ht, pad2)
+    tm = lambda a: jnp.swapaxes(a, 0, 1)
+
+    kernel = functools.partial(_gru_seq_bwd_kernel, T=T, H=H)
+    seq_spec = lambda width: pl.BlockSpec((T, blk, width), lambda i: (0, i, 0))
+    vec_spec = pl.BlockSpec((blk, H), lambda i: (i, 0))
+    dxw, dh0, du = pl.pallas_call(
+        kernel,
+        grid=(Bp // blk,),
+        in_specs=[
+            seq_spec(G),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((H, G), lambda i: (0, 0)),
+            vec_spec,
+            seq_spec(H), seq_spec(H),
+            vec_spec,
+        ],
+        out_specs=[
+            seq_spec(G),
+            vec_spec,
+            pl.BlockSpec((H, G), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, Bp, G), xw.dtype),
+            jax.ShapeDtypeStruct((Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((H, G), jnp.float32),
+        ],
+        interpret=bool(interpret),
+    )(tm(xw), lens, u, h0, tm(out_seq), tm(g_out), g_ht)
+    return jnp.swapaxes(dxw, 0, 1)[:B], dh0[:B], du
 
 
 def gru_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,

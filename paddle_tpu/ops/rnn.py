@@ -79,20 +79,28 @@ def lstm(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
     carries through unchanged and the output is zero (LoD semantics — downstream
     sequence pooling then ignores padding for free).
 
-    ``fused=True`` routes the forward pass through the Pallas whole-sequence
-    kernel (hl_cuda_lstm.cu analog: u and h/c resident in VMEM for all T
-    steps); both paths compute identical math. Use it on forward-only paths
-    (inference bundles set it automatically at export,
-    fluid/io.py export_inference_model) — under autodiff the backward
-    replays the scan, so training should keep the default.
+    ``fused=True`` routes through the Pallas whole-sequence kernels in BOTH
+    directions (hl_cuda_lstm.cu analog: u and h/c resident in VMEM for all
+    T steps; the backward is the hand-written reverse-recurrence kernel,
+    hl_lstm_parallel_backward_data/_weight analog). Both paths compute
+    identical math, so fused training == scan training numerically (see
+    tests/test_pallas.py).
+
+    ``fused=None`` (default) auto-selects by batch size, per measurement on
+    the v5e chip (benchmarks/fused_rnn.py, docs/design/fused_rnn_bench.md):
+    the whole-sequence kernel wins latency-bound small batches (B=1 fwd
+    2.0x faster), while XLA's scan wins MXU-bound large batches (B=64
+    train 2.2x faster — VMEM caps the kernel's batch tile at 8 rows, which
+    starves the 128-wide MXU, and XLA already keeps the scan carry
+    on-chip). So auto = kernel iff B <= 8.
     """
     if fused is None:
-        fused = False
+        fused = x.shape[0] <= 8
     if fused and not reverse:
         from . import pallas_kernels as _pk
         B, T, _ = x.shape
         H = u.shape[0]
-        blk = _fused_block_b(T, H)
+        blk = _fused_block_b(T, H, seq_h_units=6, batch=B)
         if not _pk._on_tpu() or blk is None:
             # off-TPU, or the sequence is too long for the whole-sequence
             # tile to fit VMEM even at block_b=1 — the scan handles any shape
@@ -110,17 +118,37 @@ def lstm(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
 
 
 def _fused_block_b(T: int, H: int, gates: int = 4,
-                   budget_bytes: int = 10_000_000):
-    """Largest batch tile whose whole-sequence VMEM working set (xw + out
-    blocks, double-buffered, plus resident u) fits; None -> use the scan.
-    ``gates``: 4 for LSTM, 3 for GRU (sizes the [H, gates*H] u and the
-    [T, blk, gates*H] xw tile)."""
-    u_bytes = H * gates * H * 4
-    for blk in (8, 4, 2, 1):
-        tile = T * blk * (gates * H + H) * 4 * 2  # xw + out, double-buffered
-        if u_bytes + tile <= budget_bytes:
-            return blk
-    return None
+                   seq_h_units: Optional[int] = None,
+                   batch: Optional[int] = None,
+                   budget_bytes: int = 15_500_000):
+    """Largest LEGAL batch tile whose whole-sequence VMEM working set fits;
+    None -> use the scan. ``gates``: 4 for LSTM, 3 for GRU (sizes the
+    [H, gates*H] u and the [T, blk, gates*H] xw tile). ``seq_h_units``:
+    total width of the per-step sequence buffers in multiples of H
+    (default xw + out = gates + 1; the train forward adds the saved cell
+    sequence, the backward roughly doubles it).
+
+    Mosaic tiling: the batch tile is the second-to-last block dim, so it
+    must be a multiple of 8 — or equal the whole (padded) batch, i.e. a
+    single grid program, which is how sub-8 batches run. Cost model
+    calibrated against the chip's 16 MB scoped VMEM (measured on v5e):
+    with more than one grid program Pallas double-buffers every
+    batch-varying block, so the tile costs 2×; a single-program grid is
+    single-buffered (which is why tiny-batch probes fit shapes that OOM
+    at full batch)."""
+    if seq_h_units is None:
+        seq_h_units = gates + 1
+    u_bytes = H * gates * H * 4          # u resident + du accumulator
+
+    def fits(blk, grid_is_1):
+        tile = T * blk * seq_h_units * H * 4
+        return 2 * u_bytes + (tile if grid_is_1 else 2 * tile) <= budget_bytes
+
+    if batch is not None and batch < 8:
+        return batch if fits(batch, True) else None
+    if batch is not None and batch <= 8:
+        return 8 if fits(8, True) else None
+    return 8 if fits(8, False) else None
 
 
 def _lstm_scan(x, lengths, w, u, b, h0, c0, reverse, forget_bias):
@@ -148,9 +176,11 @@ def _lstm_scan(x, lengths, w, u, b, h0, c0, reverse, forget_bias):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
 def _lstm_fused(x, lens, w, u, b, h0, c0, forget_bias, block_b):
-    """Forward through the Pallas fused kernel; backward recomputes through
-    the (bit-identical) scan implementation — the hand-kernel-forward /
-    autodiff-backward split of the reference's fused hl_lstm."""
+    """Forward through the Pallas fused kernel; under autodiff the VJP pairs
+    it with the hand-written reverse-recurrence kernel
+    (pallas_kernels.lstm_sequence_fused_bwd) — fused in BOTH directions,
+    the training-mode discipline of the reference's hl_lstm kernels
+    (hl_cuda_lstm.cu hl_lstm_parallel_backward_data/_weight)."""
     from .pallas_kernels import lstm_sequence_fused
     B, T, D = x.shape
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
@@ -159,21 +189,77 @@ def _lstm_fused(x, lens, w, u, b, h0, c0, forget_bias, block_b):
 
 
 def _lstm_fused_fwd(x, lens, w, u, b, h0, c0, forget_bias, block_b):
-    out = _lstm_fused(x, lens, w, u, b, h0, c0, forget_bias, block_b)
-    return out, (x, lens, w, u, b, h0, c0)
+    from .pallas_kernels import lstm_sequence_fused
+    B, T, D = x.shape
+    xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
+    out, ht, ct, c_seq = lstm_sequence_fused(
+        xw, lens, u, b, h0=h0, c0=c0, forget_bias=forget_bias,
+        block_b=block_b, save_cell=True)
+    return (out, ht, ct), (x, lens, w, u, b, h0, c0, xw, out, c_seq)
+
+
+def _bwd_chunk_len(T: int, H: int, gates: int, seq_h_units: int,
+                   budget_bytes: int = 15_500_000) -> Optional[int]:
+    """Longest time-chunk whose blk=8 backward tile fits VMEM (double-
+    buffered). The reverse recurrence splits cleanly at chunk boundaries —
+    the saved (out, c) sequences provide each chunk's initial state — so
+    long sequences run as a few kernel launches instead of falling back to
+    the T-step scan."""
+    u_bytes = H * gates * H * 4
+    avail = budget_bytes - 2 * u_bytes
+    per_step = 2 * 8 * seq_h_units * H * 4
+    if avail < 8 * per_step:
+        return None
+    return min(T, avail // per_step)
 
 
 def _lstm_fused_bwd(forget_bias, block_b, res, g):
-    x, lens, w, u, b, h0, c0 = res
-
-    def replay(x, w, u, b, h0, c0):
-        out, state = _lstm_scan(x, lens, w, u, b, h0, c0, False, forget_bias)
-        return out, state.h, state.c
-
-    _, vjp = jax.vjp(replay, x, w, u, b, h0, c0)
-    dx, dw, du, db, dh0, dc0 = vjp(g)
+    x, lens, w, u, b, h0, c0, xw, out, c_seq = res
     zero_lens = np.zeros(lens.shape, jax.dtypes.float0)
-    return dx, zero_lens, dw, du, db, dh0, dc0
+    B, T, D = x.shape
+    H = u.shape[0]
+    chunk = _bwd_chunk_len(T, H, 4, 11)      # 2*(xw+dxw) + 3 H-wide seqs
+    if chunk is None:
+        # VMEM won't hold even an 8-step backward tile: replay the
+        # (bit-identical) scan under autodiff instead
+        def replay(x, w, u, b, h0, c0):
+            out, state = _lstm_scan(x, lens, w, u, b, h0, c0, False,
+                                    forget_bias)
+            return out, state.h, state.c
+
+        _, vjp = jax.vjp(replay, x, w, u, b, h0, c0)
+        dx, dw, du, db, dh0, dc0 = vjp(g)
+        return dx, zero_lens, dw, du, db, dh0, dc0
+
+    from .pallas_kernels import lstm_sequence_fused_bwd
+    g_out, g_ht, g_ct = g
+    blk = 8 if B >= 8 else B
+    dh, dc = g_ht, g_ct
+    du = jnp.zeros((H, 4 * H), jnp.float32)
+    parts = []
+    starts = list(range(0, T, chunk))
+    for s in reversed(starts):
+        e = min(T, s + chunk)
+        h0_k = h0 if s == 0 else out[:, s - 1]
+        c0_k = c0 if s == 0 else c_seq[:, s - 1]
+        dxw_k, dh, dc, du_k = lstm_sequence_fused_bwd(
+            xw[:, s:e], lens - s, u, b, h0_k, c0_k, out[:, s:e],
+            c_seq[:, s:e], g_out[:, s:e], dh, dc,
+            forget_bias=forget_bias, block_b=blk)
+        du = du + du_k
+        parts.append(dxw_k)
+    dxw = parts[0] if len(parts) == 1 else jnp.concatenate(parts[::-1],
+                                                           axis=1)
+    dh0, dc0 = dh, dc
+    G = 4 * H
+    dxw2 = dxw.reshape(B * T, G).astype(jnp.float32)
+    dx = jnp.matmul(dxw2, w.T.astype(jnp.float32)).reshape(x.shape)\
+        .astype(x.dtype)
+    dw = jnp.matmul(x.reshape(B * T, D).T.astype(jnp.float32), dxw2)\
+        .astype(w.dtype)
+    db = dxw2.sum(0).astype(b.dtype)
+    return (dx, zero_lens, dw, du.astype(u.dtype), db, dh0.astype(h0.dtype),
+            dc0.astype(c0.dtype))
 
 
 _lstm_fused.defvjp(_lstm_fused_fwd, _lstm_fused_bwd)
@@ -185,14 +271,18 @@ def gru(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
         fused: Optional[bool] = None) -> Tuple[jax.Array, jax.Array]:
     """Full-sequence GRU. x: [B, T, D]; w: [D, 3H]; u: [H, 3H].
 
-    ``fused=True`` runs the forward through the Pallas whole-sequence kernel
-    (hl_gpu_gru.cuh analog) — same contract as lstm(fused=True): forward-only
-    paths; gradients replay the scan."""
+    ``fused=True`` runs both directions through the Pallas whole-sequence
+    kernels (hl_gpu_gru.cuh analog) — same contract as lstm(fused=True):
+    identical math to the scan, hand-written backward kernel;
+    ``fused=None`` auto-selects the kernel only for small batches (see
+    lstm() docstring for the measured crossover)."""
     B, T, D = x.shape
     H = u.shape[0]
+    if fused is None:
+        fused = B <= 8
     if fused and not reverse:
         from . import pallas_kernels as _pk
-        blk = _fused_block_b(T, H, gates=3)
+        blk = _fused_block_b(T, H, gates=3, batch=B)
         if _pk._on_tpu() and blk is not None:
             lens = (lengths if lengths is not None
                     else jnp.full((B,), T, jnp.int32))
@@ -225,18 +315,54 @@ def _gru_fused(x, lens, w, u, b, h0, block_b):
 
 
 def _gru_fused_fwd(x, lens, w, u, b, h0, block_b):
-    return _gru_fused(x, lens, w, u, b, h0, block_b), (x, lens, w, u, b, h0)
+    from .pallas_kernels import gru_sequence_fused
+    B, T, D = x.shape
+    xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
+    if b is not None:
+        xw = xw + b                        # kernel expects bias pre-added
+    out, ht = gru_sequence_fused(xw, lens, u, None, h0=h0, block_b=block_b)
+    return (out, ht), (x, lens, w, u, b, h0, xw, out)
 
 
 def _gru_fused_bwd(block_b, res, g):
-    x, lens, w, u, b, h0 = res
+    x, lens, w, u, b, h0, xw, out = res
+    zero_lens = np.zeros(lens.shape, jax.dtypes.float0)
+    B, T, D = x.shape
+    H = u.shape[0]
+    chunk = _bwd_chunk_len(T, H, 3, 8)       # 2*(xw+dxw) + 2 H-wide seqs
+    if chunk is None:
+        def replay(x, w, u, b, h0):
+            return gru(x, lens, w, u, b, h0, fused=False)
 
-    def replay(x, w, u, b, h0):
-        return gru(x, lens, w, u, b, h0, fused=False)
+        _, vjp = jax.vjp(replay, x, w, u, b, h0)
+        dx, dw, du, db, dh0 = vjp(g)
+        return dx, zero_lens, dw, du, db, dh0
 
-    _, vjp = jax.vjp(replay, x, w, u, b, h0)
-    dx, dw, du, db, dh0 = vjp(g)
-    return dx, np.zeros(lens.shape, jax.dtypes.float0), dw, du, db, dh0
+    from .pallas_kernels import gru_sequence_fused_bwd
+    g_out, g_ht = g
+    blk = 8 if B >= 8 else B
+    dh = g_ht
+    du = jnp.zeros((H, 3 * H), jnp.float32)
+    parts = []
+    for s in reversed(range(0, T, chunk)):
+        e = min(T, s + chunk)
+        h0_k = h0 if s == 0 else out[:, s - 1]
+        dxw_k, dh, du_k = gru_sequence_fused_bwd(
+            xw[:, s:e], lens - s, u, h0_k, out[:, s:e], g_out[:, s:e], dh,
+            block_b=blk)
+        du = du + du_k
+        parts.append(dxw_k)
+    dxw = parts[0] if len(parts) == 1 else jnp.concatenate(parts[::-1],
+                                                           axis=1)
+    dh0 = dh
+    G = 3 * H
+    dxw2 = dxw.reshape(B * T, G).astype(jnp.float32)
+    dx = jnp.matmul(dxw2, w.T.astype(jnp.float32)).reshape(x.shape)\
+        .astype(x.dtype)
+    dw = jnp.matmul(x.reshape(B * T, D).T.astype(jnp.float32), dxw2)\
+        .astype(w.dtype)
+    db = None if b is None else dxw2.sum(0).astype(b.dtype)
+    return dx, zero_lens, dw, du.astype(u.dtype), db, dh0.astype(h0.dtype)
 
 
 _gru_fused.defvjp(_gru_fused_fwd, _gru_fused_bwd)

@@ -130,28 +130,122 @@ def _step_attention(q, k, v, diag, causal, scale, interpret):
     return o.astype(jnp.float32), lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+# ---------------------------------------------------------------------------
+# zigzag (load-balanced causal) layout
+#
+# The contiguous layout wastes ~(n-1)/2n of causal ring FLOPs: whole KV
+# blocks from the future are computed then discarded. The zigzag layout
+# (llama3-style: split the sequence into 2n chunks, device d holds chunks
+# (d, 2n-1-d)) makes every step do the same ~half-block of useful work:
+#
+#   * src == my (diagonal): the local 2c-causal mask is EXACTLY right for
+#     the (d, 2n-1-d) chunk pair — chunk d attends itself causally and never
+#     reaches chunk 2n-1-d's keys; chunk 2n-1-d attends chunk d fully and
+#     itself causally. One plain causal flash call, nothing wasted.
+#   * src < my (block from the past): both local q chunks attend only the
+#     held block's FIRST chunk (its second chunk 2n-1-src is in both q
+#     chunks' future) -> one half-width kernel call.
+#   * src > my (block from the future): only the local SECOND q chunk
+#     attends (the held block is entirely in chunk 2n-1-my's past) -> one
+#     half-height kernel call.
+#
+# _zigzag_step_pairs() is the work accounting used by the balance test.
+# ---------------------------------------------------------------------------
+
+def zigzag_order(T: int, n: int):
+    """Global position order such that contiguous equal shards of the
+    REORDERED sequence give device d chunks (d, 2n-1-d) of the original."""
+    if T % (2 * n):
+        raise ValueError(f"T={T} must divide into 2*{n} zigzag chunks")
+    c = T // (2 * n)
+    idx = []
+    for d in range(n):
+        idx.extend(range(d * c, (d + 1) * c))
+        idx.extend(range((2 * n - 1 - d) * c, (2 * n - d) * c))
+    return jnp.asarray(idx, jnp.int32)
+
+
+def zigzag_inverse(T: int, n: int):
+    order = zigzag_order(T, n)
+    inv = jnp.zeros((T,), jnp.int32).at[order].set(jnp.arange(T, dtype=jnp.int32))
+    return inv
+
+
+def _zigzag_step_pairs(c: int):
+    """(diagonal, off-diagonal) attended (q, key) pair counts per ring step
+    per device — the layout's work model. Diagonal: the 2c-causal triangle
+    (= 2c^2 + c pairs); every off-diagonal step: exactly half the 2c x 2c
+    block (2c^2), whichever direction the held block came from."""
+    diag = 2 * c * (2 * c + 1) // 2
+    off = 2 * c * c
+    return diag, off
+
+
+def _zigzag_step(q, k, v, case, scale, interpret):
+    """One zigzag ring step: lax.switch over diagonal/past/future shapes.
+
+    Returns (o [B,2c,H,D] f32, lse [B,2c,H]) with -inf lse on rows that
+    attend nothing this step (only q chunk 1 on future steps)."""
+    B, T2, H, D = q.shape
+    c = T2 // 2
+
+    def diag(_):
+        o, lse = pk.flash_attention_with_lse(q, k, v, causal=True,
+                                             scale=scale, interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def past(_):
+        # all q rows vs the held block's first chunk
+        o, lse = pk.flash_attention_with_lse(q, k[:, :c], v[:, :c],
+                                             causal=False, scale=scale,
+                                             interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def future(_):
+        # only the local second q chunk vs the whole held block; padding
+        # rows derive from q so they carry its device-varying type under
+        # shard_map
+        o2, lse2 = pk.flash_attention_with_lse(q[:, c:], k, v, causal=False,
+                                               scale=scale,
+                                               interpret=interpret)
+        zo = (q[:, :c] * 0).astype(jnp.float32)
+        zl = (q[:, :c, :, 0] * 0).astype(jnp.float32) + _NEG
+        o = jnp.concatenate([zo, o2.astype(jnp.float32)], axis=1)
+        lse = jnp.concatenate([zl, lse2], axis=1)
+        return o, lse
+
+    return lax.switch(case, (diag, past, future), None)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
                    causal: bool = False, scale: Optional[float] = None,
-                   interpret: Optional[bool] = None) -> jax.Array:
+                   interpret: Optional[bool] = None,
+                   zigzag: bool = False) -> jax.Array:
     """Exact attention with KV rotating around the ``axis_name`` ring.
 
     Call inside shard_map with q/k/v time-sharded: [B, T_local, H, D]. Each of
     the n ring steps runs the Pallas flash kernel on the local Q block against
     the currently-held KV block, then passes KV to the neighbour (ppermute
-    over ICI); partials merge exactly via logaddexp. Causal steps where the
-    held block is entirely in the future are masked out (a zigzag layout that
-    balances that work is a future optimisation).
+    over ICI); partials merge exactly via logaddexp.
+
+    ``zigzag`` (causal only): the local block must hold chunks
+    (d, 2n-1-d) of the zigzag-reordered sequence (zigzag_order();
+    ring_self_attention does the reordering) — every ring step then does
+    ~half-block useful work instead of discarding whole future blocks,
+    recovering the ~(n-1)/2n of FLOPs the contiguous layout wastes.
     """
-    o, _ = _ring_forward(q, k, v, axis_name, causal, scale, interpret)
+    o, _ = _ring_forward(q, k, v, axis_name, causal, scale, interpret, zigzag)
     return o
 
 
-def _ring_forward(q, k, v, axis_name, causal, scale, interpret):
+def _ring_forward(q, k, v, axis_name, causal, scale, interpret, zigzag=False):
     B, T, H, D = q.shape
     scale_v = scale if scale is not None else D ** -0.5
     if interpret is None:
         interpret = not pk._on_tpu()
+    if zigzag and not causal:
+        raise ValueError("zigzag layout only applies to causal attention")
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
 
@@ -164,12 +258,16 @@ def _ring_forward(q, k, v, axis_name, causal, scale, interpret):
     def body(i, carry):
         o, lse, k, v = carry
         src = (my - i) % n                   # whose KV block we hold now
-        o_i, lse_i = _step_attention(q, k, v, src == my, causal, scale_v,
-                                     interpret)
-        if causal:
-            # blocks strictly in the future contribute nothing
-            skip = src > my
-            lse_i = jnp.where(skip, _NEG, lse_i)
+        if zigzag:
+            case = jnp.where(src == my, 0, jnp.where(src < my, 1, 2))
+            o_i, lse_i = _zigzag_step(q, k, v, case, scale_v, interpret)
+        else:
+            o_i, lse_i = _step_attention(q, k, v, src == my, causal, scale_v,
+                                         interpret)
+            if causal:
+                # blocks strictly in the future contribute nothing
+                skip = src > my
+                lse_i = jnp.where(skip, _NEG, lse_i)
         o, lse = _merge_partials(o, lse, o_i, lse_i)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
@@ -179,14 +277,16 @@ def _ring_forward(q, k, v, axis_name, causal, scale, interpret):
     return o.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, axis_name, causal, scale, interpret):
-    o, lse = _ring_forward(q, k, v, axis_name, causal, scale, interpret)
+def _ring_fwd(q, k, v, axis_name, causal, scale, interpret, zigzag=False):
+    o, lse = _ring_forward(q, k, v, axis_name, causal, scale, interpret,
+                           zigzag)
     return o, (q, k, v, o, lse)
 
 
-def _ring_bwd(axis_name, causal, scale, interpret, res, g):
+def _ring_bwd(axis_name, causal, scale, interpret, zigzag, res, g):
     q, k, v, o, lse = res
     B, T, H, D = q.shape
+    c = T // 2
     scale_v = scale if scale is not None else D ** -0.5
     if interpret is None:
         interpret = not pk._on_tpu()
@@ -216,6 +316,36 @@ def _ring_bwd(axis_name, causal, scale, interpret, res, g):
                                               delta=delta),
             (k_blk, v_blk))
 
+    def zz_block_grads(k_blk, v_blk, case):
+        """Zigzag block gradients — the same three work shapes as
+        _zigzag_step, zero-padded to full-block accumulators."""
+        f32 = lambda *ts: tuple(t.astype(jnp.float32) for t in ts)
+
+        def diag(_):
+            return f32(*pk.flash_block_grads(
+                q, k_blk, v_blk, o, lse, g, causal=True, scale=scale_v,
+                interpret=interpret, delta=delta))
+
+        def past(_):
+            dq, dk1, dv1 = pk.flash_block_grads(
+                q, k_blk[:, :c], v_blk[:, :c], o, lse, g, causal=False,
+                scale=scale_v, interpret=interpret, delta=delta)
+            z = (q[:, :c] * 0).astype(jnp.float32)   # device-varying zeros
+            return (dq.astype(jnp.float32),
+                    jnp.concatenate([dk1.astype(jnp.float32), z], axis=1),
+                    jnp.concatenate([dv1.astype(jnp.float32), z], axis=1))
+
+        def future(_):
+            dq2, dk, dv = pk.flash_block_grads(
+                q[:, c:], k_blk, v_blk, o[:, c:], lse[:, c:], g[:, c:],
+                causal=False, scale=scale_v, interpret=interpret,
+                delta=delta[:, c:])
+            z = (q[:, :c] * 0).astype(jnp.float32)   # device-varying zeros
+            return (jnp.concatenate([z, dq2.astype(jnp.float32)], axis=1),
+                    dk.astype(jnp.float32), dv.astype(jnp.float32))
+
+        return lax.switch(case, (diag, past, future), None)
+
     dq0 = (q * 0).astype(jnp.float32)
     dk0 = (k * 0).astype(jnp.float32)
     dv0 = (v * 0).astype(jnp.float32)
@@ -223,12 +353,16 @@ def _ring_bwd(axis_name, causal, scale, interpret, res, g):
     def body(i, carry):
         dq, k_blk, v_blk, dk, dv = carry
         src = (my - i) % n
-        dq_i, dk_i, dv_i = block_grads(k_blk, v_blk, src == my)
-        if causal:
-            skip = src > my
-            dq_i = jnp.where(skip, 0.0, dq_i.astype(jnp.float32))
-            dk_i = jnp.where(skip, 0.0, dk_i.astype(jnp.float32))
-            dv_i = jnp.where(skip, 0.0, dv_i.astype(jnp.float32))
+        if zigzag:
+            case = jnp.where(src == my, 0, jnp.where(src < my, 1, 2))
+            dq_i, dk_i, dv_i = zz_block_grads(k_blk, v_blk, case)
+        else:
+            dq_i, dk_i, dv_i = block_grads(k_blk, v_blk, src == my)
+            if causal:
+                skip = src > my
+                dq_i = jnp.where(skip, 0.0, dq_i.astype(jnp.float32))
+                dk_i = jnp.where(skip, 0.0, dk_i.astype(jnp.float32))
+                dv_i = jnp.where(skip, 0.0, dv_i.astype(jnp.float32))
         dq = dq + dq_i.astype(jnp.float32)
         dk = dk + dk_i.astype(jnp.float32)
         dv = dv + dv_i.astype(jnp.float32)
@@ -248,18 +382,37 @@ ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_self_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
-                        causal: bool = False):
+                        causal: bool = False,
+                        layout: Optional[str] = None):
     """Host-level wrapper: shard_map ring_attention over the mesh's seq axis.
 
-    q/k/v: [B, T_global, H, D] (replicated or already seq-sharded on dim 1).
+    q/k/v: [B, T_global, H, D] in ORIGINAL sequence order (replicated or
+    already seq-sharded on dim 1). ``layout``: "zigzag" (default for
+    causal — load-balanced, no discarded future blocks) or "contiguous".
+    The zigzag permutation and its inverse are applied here, so callers
+    always see original-order tensors.
     """
+    if layout is None:
+        layout = "zigzag" if causal else "contiguous"
+    zigzag = layout == "zigzag" and causal
     spec = P(None, seq_axis, None, None)
+    n = mesh.shape[seq_axis]
+    T = q.shape[1]
+    if zigzag and T % (2 * n):
+        zigzag = False                       # shape can't chunk: fall back
+    if zigzag:
+        order = zigzag_order(T, n)
+        q, k, v = (jnp.take(x, order, axis=1) for x in (q, k, v))
     # check_vma=False: pallas_call out_shapes carry no varying-mesh-axes info
     fn = jax.shard_map(
-        partial(ring_attention, axis_name=seq_axis, causal=causal),
+        partial(ring_attention, axis_name=seq_axis, causal=causal,
+                zigzag=zigzag),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
-    return fn(q, k, v)
+    o = fn(q, k, v)
+    if zigzag:
+        o = jnp.take(o, zigzag_inverse(T, n), axis=1)
+    return o
 
 
 def ulysses_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",

@@ -101,7 +101,11 @@ class FileLease:
             else:
                 self.token = self._next_token()
             self._write(now)
-            return True
+            # confirm-after-write: on mounts where advisory flock silently
+            # no-ops (NFSv3 without lockd, some FUSE/SMB), a concurrent
+            # writer's os.replace can land after ours — only believe we
+            # hold the lease if the file still names us
+            return self.held_by_me(now)
 
     def renew(self, now: Optional[float] = None) -> bool:
         """Extend our lease; False (lease LOST) if someone else took it."""

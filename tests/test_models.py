@@ -166,3 +166,32 @@ def test_deepfm_learns():
     params = model.init(jax.random.PRNGKey(0))
     params, costs = _train(model.loss, params, batches, passes=3)
     assert costs[-1] < costs[0] * 0.9
+
+
+@pytest.mark.parametrize("cls", ["alexnet", "googlenet"])
+def test_alexnet_googlenet_forward_and_grad(cls):
+    """AlexNet / GoogleNet (benchmark/paddle/image/{alexnet,googlenet}.py):
+    ImageNet-shaped forward, and a finite training gradient with dropout /
+    LRN / aux towers live (GoogleNet combines its two 0.3-weighted aux
+    losses in train mode)."""
+    from paddle_tpu.models import AlexNet, GoogleNet
+    model = AlexNet(classes=7) if cls == "alexnet" else GoogleNet(classes=7)
+    params = model.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 224, 224, 3)) * 0.1
+    y = jnp.array([1, 5])
+
+    logits = model(params, x)                 # eval mode: single head
+    assert logits.shape == (2, 7)
+
+    rng = jax.random.PRNGKey(2)
+    l0 = float(model.loss(params, x, y, train=True, rng=rng))
+    assert np.isfinite(l0)
+    if cls == "googlenet":                    # aux losses included
+        l_eval = float(model.loss(params, x, y))
+        assert l0 > l_eval * 1.2
+
+    g = jax.jit(lambda p: jax.grad(
+        lambda p: model.loss(p, x, y, train=True, rng=rng))(p))(params)
+    total = float(jax.tree_util.tree_reduce(
+        lambda a, b: a + jnp.sum(jnp.abs(b)), g, 0.0))
+    assert np.isfinite(total) and total > 0

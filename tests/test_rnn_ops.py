@@ -83,8 +83,15 @@ def test_fused_lstm_vmem_guard_falls_back():
     """Long sequences must fall back to the scan (whole-sequence tile would
     blow the VMEM budget) instead of failing to compile."""
     from paddle_tpu.ops import rnn as R
-    assert R._fused_block_b(100, 256) == 8          # bench shape fits
+    # bench shape fits; tiles are Mosaic-legal (multiple of 8, or == batch)
+    assert R._fused_block_b(100, 256) == 8
     assert R._fused_block_b(1024, 512) is None      # 64MB tile -> scan
+    assert R._fused_block_b(100, 256, batch=5) == 5  # sub-8: single tile
+    # backward runs time-chunked: h256 splits T=100 into VMEM-sized chunks,
+    # h1280 can't fit even 8 steps (u alone is 26 MB) -> scan replay
+    c = R._bwd_chunk_len(100, 256, 4, 11)
+    assert c is not None and 8 <= c < 100
+    assert R._bwd_chunk_len(100, 1280, 4, 11) is None
     # fused=True on a too-big shape silently uses the scan
     rs = np.random.RandomState(0)
     B, T, D, H = 2, 40, 3, 4
