@@ -17,9 +17,9 @@ from .reader import (map_readers, shuffle, chain, compose, buffered, firstn,
 from .feeder import (DataFeeder, DenseSlot, IndexSlot, SeqSlot, SparseSlot,
                      to_lod_batch)
 from .prefetch import DoubleBuffer
-from . import dataset, format
+from . import dataset, format, parsers
 
-__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
+__all__ = ["parsers", "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
            "xmap_readers", "cache", "batch", "mix",
            "DataFeeder", "DenseSlot", "IndexSlot", "SeqSlot", "SparseSlot",
            "to_lod_batch", "DoubleBuffer", "dataset", "format"]

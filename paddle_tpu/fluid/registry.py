@@ -1009,7 +1009,8 @@ def _mb_loss(ins, attrs):
         lambda lp, cl, gb, gl, gm: multibox_loss(
             lp, cl, ins["PriorBox"][0], ins["PriorVar"][0], gb, gl, gm,
             neg_pos_ratio=attrs.get("neg_pos_ratio", 3.0),
-            overlap_threshold=attrs.get("overlap_threshold", 0.5))
+            overlap_threshold=attrs.get("overlap_threshold", 0.5),
+            background_id=attrs.get("background_id", 0))
     )(ins["Loc"][0], ins["Conf"][0], ins["GTBox"][0], ins["GTLabel"][0],
       ins["GTMask"][0])
     return {"Loss": [loss]}
@@ -1355,3 +1356,99 @@ def _binary_f1(ins, attrs):
     prec = tp / jnp.maximum(tp + fp, 1)
     rec = tp / jnp.maximum(tp + fn, 1)
     return {"Out": [2 * prec * rec / jnp.maximum(prec + rec, 1e-12)]}
+
+
+# -------------------------------------------------- gen-1 tail (round 3) ----
+
+@OpRegistry.register("lstm_step")
+def _lstm_step(ins, attrs):
+    """Pre-projected-gates LSTM step with peephole connections
+    (LstmStepLayer.cpp; layers.py:3544 lstm_step_layer)."""
+    from ..ops.rnn import lstm_peephole_step
+    h, c = lstm_peephole_step(_x(ins), ins["CPrev"][0], ins["WPeep"][0],
+                              ins["B"][0] if "B" in ins else None,
+                              forget_bias=attrs.get("forget_bias", 0.0))
+    return {"H": [h], "C": [c]}
+
+
+@OpRegistry.register("kmax_seq_score")
+def _kmax_seq_score(ins, attrs):
+    from ..ops.sequence import kmax_seq_score
+    return {"Out": [kmax_seq_score(_x(ins), ins["Lengths"][0],
+                                   attrs["beam_size"])]}
+
+
+@OpRegistry.register("sub_nested_seq")
+def _sub_nested_seq(ins, attrs):
+    from ..ops.sequence import sub_nested_seq
+    out, sub = sub_nested_seq(_x(ins), ins["SubLengths"][0],
+                              ins["Indices"][0])
+    return {"Out": [out], "SubLengthsOut": [sub]}
+
+
+@OpRegistry.register("equal_scalar")
+def _equal_scalar(ins, attrs):
+    """Elementwise id == constant (EosIdCheckLayer role, layers.py:4224);
+    distinct from the two-input "equal" compare op."""
+    val = attrs["value"]
+    return {"Out": [(_x(ins) == val).astype(jnp.int32)]}
+
+
+@OpRegistry.register("dyn_conv2d")
+def _dyn_conv2d(ins, attrs):
+    """Per-sample dynamic-filter conv (ConvOperator.cpp: the filter is an
+    INPUT, not a parameter — e.g. attention-generated kernels). NHWC."""
+    from ..ops.conv import conv2d
+    x = _x(ins)                                        # [B, H, W, C]
+    k = attrs["filter_size"]
+    c, nf = attrs["channels"], attrs["num_filters"]
+    # flat layout is the reference's (F, C, k, k) per-sample packing;
+    # transpose to HWIO for the NHWC conv
+    filt = ins["Filter"][0].reshape((-1, nf, c, k, k)).transpose(
+        (0, 3, 4, 2, 1))                               # [B, k, k, C, F]
+
+    def one(img, f):
+        return conv2d(img[None], f, stride=attrs.get("stride", 1),
+                      padding=attrs.get("padding", 0))[0]
+
+    return {"Out": [jax.vmap(one)(x, filt)]}
+
+
+@OpRegistry.register("scale_sub_region")
+def _scale_sub_region(ins, attrs):
+    """Multiply a per-sample (C,H,W) box by a constant
+    (ScaleSubRegionLayer.cpp). X: [B, H, W, C] NHWC; Indices [B, 6]
+    1-based inclusive (C_s, C_e, H_s, H_e, W_s, W_e)."""
+    x = _x(ins)
+    idx = ins["Indices"][0].astype(jnp.int32)
+    B, H, W, C = x.shape
+    hh = jnp.arange(H)[None, :, None, None]
+    ww = jnp.arange(W)[None, None, :, None]
+    cc = jnp.arange(C)[None, None, None, :]
+    e = lambda i: idx[:, i][:, None, None, None]
+    inside = ((cc >= e(0) - 1) & (cc <= e(1) - 1) &
+              (hh >= e(2) - 1) & (hh <= e(3) - 1) &
+              (ww >= e(4) - 1) & (ww <= e(5) - 1))
+    return {"Out": [jnp.where(inside, x * attrs["value"], x)]}
+
+
+@OpRegistry.register("cross_entropy_over_beam")
+def _ce_over_beam(ins, attrs):
+    """Beam-training CE (CrossEntropyOverBeamLayer role): softmax over each
+    sample's beam scores, with the reference's per-sample append-gold
+    construction — the gold's own score joins as slot K ONLY for samples
+    whose gold fell out of the beam (gold_idx == K); in-beam samples mask
+    slot K so their gold is never double-counted in the partition."""
+    scores = _x(ins)                              # [B, K]
+    gold_idx = ins["GoldIdx"][0].astype(jnp.int32)  # [B]; K == out-of-beam
+    K = scores.shape[-1]
+    if "GoldScore" in ins:
+        gs = ins["GoldScore"][0].reshape(-1, 1)   # [B, 1]
+        in_beam = (gold_idx < K).reshape(-1, 1)
+        slot_k = jnp.where(in_beam, -1e9, gs)
+        logits = jnp.concatenate([scores, slot_k], axis=-1)
+    else:
+        logits = scores
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, gold_idx[:, None], axis=-1)[:, 0]
+    return {"Out": [-picked]}

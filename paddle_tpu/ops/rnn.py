@@ -406,3 +406,35 @@ def simple_rnn(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array,
     xs = (jnp.swapaxes(xw, 0, 1), jnp.swapaxes(mask, 0, 1))
     h, ys = lax.scan(step, h, xs, reverse=reverse)
     return jnp.swapaxes(ys, 0, 1), h
+
+
+def lstm_peephole_step(xw: jax.Array, c_prev: jax.Array, w_peep: jax.Array,
+                       b: Optional[jax.Array] = None,
+                       forget_bias: float = 0.0) -> Tuple[jax.Array, jax.Array]:
+    """One LSTM step with PRE-PROJECTED gates and peephole connections —
+    the reference's LstmStepLayer (gserver/layers/LstmStepLayer.cpp,
+    trainer_config_helpers/layers.py:3544 lstm_step_layer): the user's
+    mixed_layer computes Wx_t + Wh_{t-1}; this step only adds the
+    c_{t-1}/c_t peephole terms, bias, and the cell recurrence.
+
+        i = sigmoid(g_i + w_ci * c_prev + b_i)
+        f = sigmoid(g_f + w_cf * c_prev + b_f [+ forget_bias])
+        c = f * c_prev + i * tanh(g_c + b_c)
+        o = sigmoid(g_o + w_co * c + b_o)      # peeps at the NEW cell
+        h = o * tanh(c)
+
+    xw: [B, 4H] packed (i, f, c, o); w_peep: [3, H] packed (ci, cf, co).
+    Returns (h, c).
+    """
+    H = c_prev.shape[-1]
+    gi, gf, gc, go = (xw[..., :H], xw[..., H:2 * H], xw[..., 2 * H:3 * H],
+                      xw[..., 3 * H:])
+    if b is not None:
+        bi, bf, bc, bo = (b[..., :H], b[..., H:2 * H], b[..., 2 * H:3 * H],
+                          b[..., 3 * H:])
+        gi, gf, gc, go = gi + bi, gf + bf, gc + bc, go + bo
+    i = jax.nn.sigmoid(gi + c_prev * w_peep[0])
+    f = jax.nn.sigmoid(gf + c_prev * w_peep[1] + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    o = jax.nn.sigmoid(go + c * w_peep[2])
+    return o * jnp.tanh(c), c

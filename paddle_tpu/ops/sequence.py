@@ -226,3 +226,38 @@ def nested_rnn(rnn_fn, nb: NestedSeqBatch, *args, **kwargs):
     out_n = out.reshape((B, S) + out.shape[1:])
     h = last.h if hasattr(last, "h") else last
     return out_n, nb.outer(h)
+
+
+def kmax_seq_score(scores: jax.Array, lengths: jax.Array,
+                   k: int) -> jax.Array:
+    """Indices of the k highest-scoring positions per sequence, padding
+    masked out (KmaxSeqScoreLayer, gserver/layers/KmaxSeqScoreLayer.cpp /
+    trainer_config_helpers/layers.py:6927). scores: [B, T] (or [B, T, 1]);
+    returns int32 [B, k], positions beyond a sequence's true length never
+    selected (they score -inf; for length < k the tail indices repeat the
+    mask's argmin — callers gate on lengths as the reference's beam code
+    did)."""
+    if scores.ndim == 3:
+        scores = scores[..., 0]
+    T = scores.shape[1]
+    mask = sequence_mask(lengths, T, scores.dtype)
+    masked = jnp.where(mask > 0, scores, -jnp.inf)
+    _, idx = jax.lax.top_k(masked, k)
+    return idx.astype(jnp.int32)
+
+
+def sub_nested_seq(x: jax.Array, sub_lengths: jax.Array,
+                   indices: jax.Array):
+    """Select sub-sequences of a nested sequence by per-sample indices
+    (SubNestedSequenceLayer, layers.py:6781 — the beam-training trim).
+
+    x: [B, S, T, ...]; sub_lengths: [B, S]; indices: [B, K] int. Returns
+    (x_out [B, K, T, ...], sub_lengths_out [B, K]). Indices are clamped to
+    the valid sub-sequence range, matching the defensive clipping of the
+    reference's CPU gather."""
+    S = x.shape[1]
+    idx = jnp.clip(indices.astype(jnp.int32), 0, S - 1)
+    gather = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    sub_out = jnp.take_along_axis(sub_lengths, idx, axis=1)
+    return gather, sub_out

@@ -25,6 +25,9 @@ class LayerOutput:
     input_type: Optional[InputType] = None
     sub_lengths: Optional[Variable] = None  # set for nested (2-level LoD) data
     values: Optional[Variable] = None       # set for sparse (ids, vals) data
+    #: secondary outputs by arg_name (the reference's multi-output layers,
+    #: e.g. lstm_step's 'state') — fetched via get_output_layer()
+    outputs: Optional[dict] = None
 
     @property
     def name(self):
@@ -1225,3 +1228,520 @@ def hsigmoid_layer(input: LayerOutput, label: LayerOutput,
               {"num_classes": num_classes},
               out_shape=(), out_slot="Cost")
     return LayerOutput(v)
+
+
+# ======================================================================
+# gen-1 tail (round 3): the last ~20 trainer_config_helpers/layers.py
+# functions — see docs/v2_layer_parity.md for the name-for-name table.
+# ======================================================================
+
+def lstm_step_layer(input: LayerOutput, state: LayerOutput,
+                    size: Optional[int] = None,
+                    forget_bias: float = 0.0, bias_attr: bool = True,
+                    name: Optional[str] = None) -> LayerOutput:
+    """LSTM step with PRE-PROJECTED gates + peephole connections, for use
+    inside recurrent_group (layers.py:3544; LstmStepLayer.cpp). ``input``
+    is Wx_t + Wh_{t-1} [_, 4*size] built with mixed_layer projections;
+    ``state`` the c_{t-1} memory. Default output h_t; the cell is the
+    'state' secondary output (get_output_layer(out, 'state') — the
+    reference's exact idiom for wiring the cell memory)."""
+    if size is None:
+        size = _shape(input)[-1] // 4
+    w_peep = FL._create_parameter("lstm_step_peep", (3, size), "float32",
+                                  I.zeros)
+    ins = {"X": [input.var.name], "CPrev": [state.var.name],
+           "WPeep": [w_peep.name]}
+    if bias_attr:
+        bias = FL._create_parameter("lstm_step_b", (4 * size,), "float32",
+                                    I.zeros)
+        ins["B"] = [bias.name]
+    b = default_main_program().current_block()
+    h = b.create_var(shape=(-1, size), dtype="float32")
+    c = b.create_var(shape=(-1, size), dtype="float32")
+    b.append_op("lstm_step", ins, {"H": [h.name], "C": [c.name]},
+                {"forget_bias": forget_bias})
+    _register_named(name, h)
+    return LayerOutput(h, outputs={"state": c})
+
+
+def gru_step_layer(input: LayerOutput, output_mem: LayerOutput,
+                   size: Optional[int] = None, bias_attr: bool = True,
+                   name: Optional[str] = None) -> LayerOutput:
+    """GRU step for recurrent_group (layers.py:3642; GruStepLayer.cpp):
+    ``input`` is x_t @ W [_, 3*size] (projected outside, as the reference
+    requires); the recurrent transform of ``output_mem`` (h_{t-1}) happens
+    here via the step's own U parameter."""
+    if size is None:
+        size = _shape(input)[-1] // 3
+    u = FL._create_parameter("gru_step_u", (size, 3 * size), "float32",
+                             I.uniform(-0.08, 0.08))
+    ins = {"X": [input.var.name], "HPrev": [output_mem.var.name],
+           "U": [u.name]}
+    if bias_attr:
+        bias = FL._create_parameter("gru_step_b", (3 * size,), "float32",
+                                    I.zeros)
+        ins["B"] = [bias.name]
+    b = default_main_program().current_block()
+    h = b.create_var(shape=(-1, size), dtype="float32")
+    b.append_op("gru_unit", ins, {"H": [h.name]}, {})
+    _register_named(name, h)
+    return LayerOutput(h)
+
+
+def get_output_layer(input: LayerOutput, arg_name: str,
+                     name: Optional[str] = None) -> LayerOutput:
+    """Fetch a layer's secondary output by name (layers.py:3802), e.g.
+    lstm_step_layer's 'state' (the cell)."""
+    if not input.outputs or arg_name not in input.outputs:
+        have = sorted(input.outputs or {})
+        raise ValueError(f"layer has no output {arg_name!r}; it has {have}")
+    v = input.outputs[arg_name]
+    _register_named(name, v)
+    return LayerOutput(v, input.lengths, input.input_type)
+
+
+def selective_fc_layer(input, size: int, select: Optional[LayerOutput] = None,
+                       act: Optional[str] = "tanh",
+                       bias_attr: bool = True,
+                       name: Optional[str] = None) -> LayerOutput:
+    """Selective fc (layers.py:4967, SelectiveFullyConnectedLayer.cpp):
+    only the columns flagged by ``select`` (a 0/1 mask [B, size]) are
+    produced. The reference exploits output sparsity on CPU
+    (mul_ratio heuristics); on TPU a masked dense matmul IS the fast
+    path — the MXU computes the full [B, size] tile either way, so the
+    select mask is applied to the result (zeros where unselected, matching
+    the reference's sparse output semantics). Without ``select`` it is
+    exactly fc_layer."""
+    out = fc(input, size, act=act, bias_attr=bias_attr, name=None)
+    if select is None:
+        _register_named(name, out.var)
+        return out
+    masked = _emit("elementwise_mul",
+                   {"X": [out.var.name], "Y": [select.var.name]},
+                   out_shape=(-1, size))
+    _register_named(name, masked)
+    return LayerOutput(masked)
+
+
+def gated_unit_layer(input: LayerOutput, size: int,
+                     act: Optional[str] = None,
+                     name: Optional[str] = None) -> LayerOutput:
+    """Gated linear unit y = act(XW + b) * sigmoid(XV + c)
+    (layers.py:6589, after arXiv:1612.08083). Sequence inputs keep their
+    lengths: the projections are per-position matmuls (fc would flatten
+    the time dim)."""
+    d = _shape(input)[-1]
+    w = FL._create_parameter("gated_w", (d, size), "float32", I.xavier())
+    v_ = FL._create_parameter("gated_v", (d, size), "float32", I.xavier())
+    bw = FL._create_parameter("gated_bw", (size,), "float32", I.zeros)
+    bv = FL._create_parameter("gated_bv", (size,), "float32", I.zeros)
+    shp = _shape(input)[:-1] + (size,)
+    proj = _emit("matmul", {"X": [input.var.name], "Y": [w.name]},
+                 out_shape=shp)
+    proj = _emit("elementwise_add", {"X": [proj.name], "Y": [bw.name]},
+                 out_shape=shp)
+    if act:
+        proj = _emit(act, {"X": [proj.name]}, out_shape=shp)
+    gate = _emit("matmul", {"X": [input.var.name], "Y": [v_.name]},
+                 out_shape=shp)
+    gate = _emit("elementwise_add", {"X": [gate.name], "Y": [bv.name]},
+                 out_shape=shp)
+    gate = _emit("sigmoid", {"X": [gate.name]}, out_shape=shp)
+    out = _emit("elementwise_mul", {"X": [proj.name], "Y": [gate.name]},
+                out_shape=shp)
+    _register_named(name, out)
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def dot_prod_layer(input1: LayerOutput, input2: LayerOutput) -> LayerOutput:
+    """Row-wise dot product [B, D] x [B, D] -> [B, 1] (layers.py:4146)."""
+    prod = _emit("elementwise_mul",
+                 {"X": [input1.var.name], "Y": [input2.var.name]},
+                 out_shape=_shape(input1))
+    out = _emit("reduce_sum", {"X": [prod.name]},
+                {"dim": -1, "keep_dim": True}, out_shape=(-1, 1))
+    return LayerOutput(out)
+
+
+def out_prod_layer(input1: LayerOutput, input2: LayerOutput) -> LayerOutput:
+    """Outer product [B, D1] x [B, D2] -> [B, D1*D2] (layers.py:4185)."""
+    d1, d2 = _shape(input1)[-1], _shape(input2)[-1]
+    a3 = _emit("unsqueeze", {"X": [input1.var.name]}, {"axis": -1},
+               out_shape=(-1, d1, 1))
+    b3 = _emit("unsqueeze", {"X": [input2.var.name]}, {"axis": 1},
+               out_shape=(-1, 1, d2))
+    m = _emit("matmul", {"X": [a3.name], "Y": [b3.name]},
+              out_shape=(-1, d1, d2))
+    out = _emit("reshape", {"X": [m.name]}, {"shape": (-1, d1 * d2)},
+                out_shape=(-1, d1 * d2))
+    return LayerOutput(out)
+
+
+def eos_layer(input: LayerOutput, eos_id: int) -> LayerOutput:
+    """1 where the id equals eos_id (layers.py:4224, EosIdCheckLayer) —
+    the recurrent-group stop predicate."""
+    v = _emit("equal_scalar", {"X": [input.var.name]}, {"value": eos_id},
+              out_shape=_shape(input), out_dtype="int32")
+    return LayerOutput(v, input.lengths, input.input_type)
+
+
+def cross_channel_norm_layer(input: LayerOutput,
+                             channels: Optional[int] = None) -> LayerOutput:
+    """SSD's cross-channel L2 norm with a trainable per-channel scale
+    (layers.py:1357, NormProjectionLayer cross-channel-norm). NHWC: the
+    channel axis is last."""
+    c = channels or _shape(input)[-1]
+    scale = FL._create_parameter("ccn_scale", (c,), "float32", I.ones)
+    normed = _emit("l2_normalize", {"X": [input.var.name]}, {"axis": -1},
+                   out_shape=_shape(input))
+    out = _emit("elementwise_mul", {"X": [normed.name], "Y": [scale.name]},
+                out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def row_l2_norm_layer(input: LayerOutput) -> LayerOutput:
+    """Row-wise L2 normalization (layers.py:3191, RowL2NormLayer)."""
+    v = _emit("l2_normalize", {"X": [input.var.name]}, {"axis": -1},
+              out_shape=_shape(input))
+    return LayerOutput(v, input.lengths, input.input_type)
+
+
+def scale_shift_layer(input: LayerOutput, bias_attr: bool = True) -> LayerOutput:
+    """y = w * x + b with SCALAR trainable w (and b) — layers.py:7114,
+    ScaleShiftLayer (the trainable SlopeIntercept)."""
+    w = FL._create_parameter("scale_shift_w", (1,), "float32", I.ones)
+    out = _emit("elementwise_mul", {"X": [input.var.name], "Y": [w.name]},
+                out_shape=_shape(input))
+    if bias_attr:
+        bias = FL._create_parameter("scale_shift_b", (1,), "float32", I.zeros)
+        out = _emit("elementwise_add", {"X": [out.name], "Y": [bias.name]},
+                    out_shape=_shape(input))
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def resize_layer(input: LayerOutput, size: int) -> LayerOutput:
+    """Reflow the batch matrix to row width ``size`` (layers.py:7155,
+    ResizeLayer): [H, W] -> [H*W/size, size]."""
+    v = _emit("reshape", {"X": [input.var.name]}, {"shape": (-1, size)},
+              out_shape=(-1, size))
+    return LayerOutput(v)
+
+
+def switch_order_layer(input: LayerOutput) -> LayerOutput:
+    """NCHW -> NHWC transpose (layers.py:6682, SwitchOrderLayer). This
+    build is NHWC-native (XLA's preferred TPU conv layout), so this layer
+    exists for reference configs that interleave layout switches; it
+    performs the same permutation on an explicitly NCHW tensor."""
+    s = _shape(input)
+    if len(s) != 4:
+        raise ValueError(f"switch_order_layer needs a 4-D NCHW input, "
+                         f"got shape {s}")
+    v = _emit("transpose", {"X": [input.var.name]}, {"axis": (0, 2, 3, 1)},
+              out_shape=(s[0], s[2], s[3], s[1]))
+    return LayerOutput(v)
+
+
+# ------------------------------------------------- sub-sequence family ---
+
+def sub_seq_layer(input: LayerOutput, offsets: LayerOutput,
+                  sizes: LayerOutput) -> LayerOutput:
+    """Per-sequence slice by (offset, size) index layers (layers.py:7176,
+    SubSequenceLayer). Output lengths are the sizes."""
+    if input.lengths is None:
+        raise ValueError("sub_seq_layer needs a sequence input")
+    max_t = _shape(input)[1] if len(_shape(input)) > 2 else -1
+    b = default_main_program().current_block()
+    out = b.create_var(shape=_shape(input), dtype="float32")
+    b.append_op("sequence_slice",
+                {"X": [input.var.name], "Lengths": [input.lengths.name],
+                 "Offset": [offsets.var.name], "Length": [sizes.var.name]},
+                {"Out": [out.name]},
+                {"max_out": max_t} if max_t and max_t > 0 else {})
+    return LayerOutput(out, sizes.var, input.input_type)
+
+
+def seq_slice_layer(input: LayerOutput, starts: Optional[LayerOutput],
+                    ends: Optional[LayerOutput]) -> LayerOutput:
+    """Slice each sequence between per-sample start/end indices
+    (layers.py:6861, SequenceSliceLayer). starts=None slices from the
+    beginning; ends=None to the sequence end. (The reference's multi-slice
+    form — several (start, end) pairs per sequence — is expressed by
+    calling this layer per pair and seq_concat_layer-ing the results.)"""
+    if input.lengths is None:
+        raise ValueError("seq_slice_layer needs a sequence input")
+    if starts is None and ends is None:
+        raise ValueError("give at least one of starts/ends")
+    if starts is None:
+        start_var = _emit("scale", {"X": [input.lengths.name]}, {"scale": 0},
+                          out_shape=(-1,), out_dtype="int32")
+    else:
+        start_var = starts.var
+    end_var = input.lengths if ends is None else ends.var
+    length = _emit("elementwise_sub", {"X": [end_var.name],
+                                       "Y": [start_var.name]},
+                   out_shape=(-1,), out_dtype="int32")
+    return sub_seq_layer(input, LayerOutput(start_var), LayerOutput(length))
+
+
+def seq_concat_layer(a: LayerOutput, b: LayerOutput) -> LayerOutput:
+    """Concatenate two sequences per sample: [a1..am, b1..bn]
+    (layers.py:3391, SequenceConcatLayer)."""
+    if a.lengths is None or b.lengths is None:
+        raise ValueError("seq_concat_layer needs two sequence inputs")
+    blk = default_main_program().current_block()
+    ta = _shape(a)[1] if len(_shape(a)) > 2 else -1
+    tb = _shape(b)[1] if len(_shape(b)) > 2 else -1
+    t_out = (ta + tb) if (ta and tb and ta > 0 and tb > 0) else -1
+    out = blk.create_var(shape=(_shape(a)[0], t_out) + _shape(a)[2:],
+                         dtype="float32")
+    lens = blk.create_var(shape=(-1,), dtype="int32")
+    blk.append_op("sequence_concat",
+                  {"X": [a.var.name], "XLengths": [a.lengths.name],
+                   "Y": [b.var.name], "YLengths": [b.lengths.name]},
+                  {"Out": [out.name], "OutLengths": [lens.name]}, {})
+    return LayerOutput(out, lens, a.input_type)
+
+
+def kmax_seq_score_layer(input: LayerOutput,
+                         beam_size: int = 1) -> LayerOutput:
+    """Indices of the beam_size highest-scoring positions per sequence
+    (layers.py:6927, KmaxSeqScoreLayer); padding never selected."""
+    if input.lengths is None:
+        raise ValueError("kmax_seq_score_layer needs a sequence input")
+    v = _emit("kmax_seq_score",
+              {"X": [input.var.name], "Lengths": [input.lengths.name]},
+              {"beam_size": beam_size}, out_shape=(-1, beam_size),
+              out_dtype="int32")
+    return LayerOutput(v)
+
+
+def sub_nested_seq_layer(input: LayerOutput,
+                         selected_indices: LayerOutput) -> LayerOutput:
+    """Trim a nested sequence to the selected sub-sequences
+    (layers.py:6781, SubNestedSequenceLayer — the beam-training trim);
+    pairs with kmax_seq_score_layer."""
+    if input.sub_lengths is None:
+        raise ValueError("sub_nested_seq_layer needs a nested sequence "
+                         "input (sub_lengths)")
+    blk = default_main_program().current_block()
+    k = _shape(selected_indices)[-1]
+    out = blk.create_var(shape=(_shape(input)[0], k) + _shape(input)[2:],
+                         dtype=input.var.dtype)
+    sub = blk.create_var(shape=(-1, k), dtype="int32")
+    blk.append_op("sub_nested_seq",
+                  {"X": [input.var.name],
+                   "SubLengths": [input.sub_lengths.name],
+                   "Indices": [selected_indices.var.name]},
+                  {"Out": [out.name], "SubLengthsOut": [sub.name]}, {})
+    lens = _emit("scale", {"X": [input.lengths.name]},
+                 {"scale": 0, "bias": k}, out_shape=(-1,), out_dtype="int32")
+    return LayerOutput(out, lens, input.input_type, sub_lengths=sub)
+
+
+# ------------------------------------------------- detection DSL trio ---
+
+def _concat_heads(inputs, last_dim: int) -> Variable:
+    """Normalize one-or-list of per-feature-map heads to a single
+    [B, P_total, last_dim] variable (SSD multi-scale head concat)."""
+    heads = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(heads) == 1:
+        return heads[0].var
+    return _emit("concat", {"X": [h.var.name for h in heads]}, {"axis": 1},
+                 out_shape=(-1, -1, last_dim))
+
+
+def priorbox_layer(input: LayerOutput, image: LayerOutput,
+                   aspect_ratio, variance, min_size, max_size=(),
+                   flip: bool = True, clip: bool = True) -> LayerOutput:
+    """SSD prior boxes for one feature map (layers.py:1114). NHWC shapes
+    are read statically from the feature/image layers; returns boxes
+    [P, 4] with the variances as the 'variances' secondary output."""
+    fh, fw = _shape(input)[1], _shape(input)[2]
+    ih, iw = _shape(image)[1], _shape(image)[2]
+    mins = list(min_size) if isinstance(min_size, (list, tuple)) else [min_size]
+    maxs = list(max_size) if isinstance(max_size, (list, tuple)) else [max_size]
+    blk = default_main_program().current_block()
+    box_parts, var_parts = [], []
+    for i, mn in enumerate(mins):
+        boxes = blk.create_var(shape=(-1, 4), dtype="float32")
+        variances = blk.create_var(shape=(-1, 4), dtype="float32")
+        blk.append_op("prior_box", {},
+                      {"Boxes": [boxes.name], "Variances": [variances.name]},
+                      {"feature_hw": (fh, fw), "image_hw": (ih, iw),
+                       "min_size": mn,
+                       "max_size": maxs[i] if i < len(maxs) else None,
+                       "aspect_ratios": tuple(aspect_ratio), "flip": flip,
+                       "clip": clip, "variance": tuple(variance)})
+        box_parts.append(boxes)
+        var_parts.append(variances)
+    if len(box_parts) == 1:
+        return LayerOutput(box_parts[0], outputs={"variances": var_parts[0]})
+    boxes = _emit("concat", {"X": [b.name for b in box_parts]}, {"axis": 0},
+                  out_shape=(-1, 4))
+    variances = _emit("concat", {"X": [v.name for v in var_parts]},
+                      {"axis": 0}, out_shape=(-1, 4))
+    return LayerOutput(boxes, outputs={"variances": variances})
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox: LayerOutput,
+                        label: LayerOutput, num_classes: int,
+                        overlap_threshold: float = 0.5,
+                        neg_pos_ratio: float = 3.0,
+                        background_id: int = 0) -> LayerOutput:
+    """SSD loss (layers.py:1160): localization smooth-L1 + mined softmax
+    confidence vs matched priors. ``label`` packs ground truth as
+    (boxes [B,G,4], classes [B,G], mask [B,G]) secondary outputs of a
+    ground-truth data composite (see tests) or a LayerOutput with
+    .outputs {'gt_label','gt_mask'}."""
+    loc = _concat_heads(input_loc, 4)
+    conf = _concat_heads(input_conf, num_classes)
+    if not label.outputs or not {"gt_label", "gt_mask"} <= set(label.outputs):
+        raise ValueError("multibox_loss_layer label needs outputs "
+                         "{'gt_label', 'gt_mask'} (ground-truth composite)")
+    v = _emit("multibox_loss",
+              {"Loc": [loc.name], "Conf": [conf.name],
+               "PriorBox": [priorbox.var.name],
+               "PriorVar": [priorbox.outputs["variances"].name],
+               "GTBox": [label.var.name],
+               "GTLabel": [label.outputs["gt_label"].name],
+               "GTMask": [label.outputs["gt_mask"].name]},
+              {"overlap_threshold": overlap_threshold,
+               "neg_pos_ratio": neg_pos_ratio,
+               "background_id": background_id},
+              out_shape=(-1,), out_slot="Loss")
+    return _mean_of(v)
+
+
+def detection_output_layer(input_loc, input_conf, priorbox: LayerOutput,
+                           num_classes: int, nms_threshold: float = 0.45,
+                           confidence_threshold: float = 0.01,
+                           keep_top_k: int = 100,
+                           background_id: int = 0) -> LayerOutput:
+    """SSD inference head (layers.py:1233): decode + per-class NMS. Boxes
+    are the default output; scores and the valid mask are secondary
+    outputs ('scores', 'valid')."""
+    loc = _concat_heads(input_loc, 4)
+    conf = _concat_heads(input_conf, num_classes)
+    blk = default_main_program().current_block()
+    nc = num_classes - 1                     # per non-background class
+    boxes = blk.create_var(shape=(-1, nc, keep_top_k, 4), dtype="float32")
+    scores = blk.create_var(shape=(-1, nc, keep_top_k), dtype="float32")
+    valid = blk.create_var(shape=(-1, nc, keep_top_k), dtype="float32")
+    blk.append_op("detection_output",
+                  {"Loc": [loc.name], "Conf": [conf.name],
+                   "PriorBox": [priorbox.var.name],
+                   "PriorVar": [priorbox.outputs["variances"].name]},
+                  {"Boxes": [boxes.name], "Scores": [scores.name],
+                   "Valid": [valid.name]},
+                  {"num_classes": num_classes,
+                   "nms_threshold": nms_threshold,
+                   "score_threshold": confidence_threshold,
+                   "keep_top_k": keep_top_k,
+                   "background_id": background_id})
+    return LayerOutput(boxes, outputs={"scores": scores, "valid": valid})
+
+
+def conv_operator(img: LayerOutput, filter: LayerOutput, filter_size: int,
+                  num_filters: int, num_channels: Optional[int] = None,
+                  stride: int = 1, padding: int = 0) -> _Projection:
+    """Conv with a DYNAMIC filter input inside mixed_layer
+    (layers.py conv_operator; ConvOperator.cpp): the second input IS the
+    filter tensor (parameter-free), e.g. attention-generated kernels.
+    ``filter``: [B, num_filters*C*k*k] per-sample filters flattened in the
+    reference's (num_filters, C, k, k) order; the conv runs per sample
+    (vmap in the op)."""
+    c = num_channels or _shape(img)[-1]
+
+    def emit():
+        return _emit("dyn_conv2d",
+                     {"X": [img.var.name], "Filter": [filter.var.name]},
+                     {"filter_size": filter_size, "num_filters": num_filters,
+                      "channels": c, "stride": stride, "padding": padding},
+                     out_shape=(-1, -1, -1, num_filters))
+    return _Projection(emit, num_filters, src=img)
+
+
+def conv_projection(input: LayerOutput, filter_size: int, num_filters: int,
+                    num_channels: Optional[int] = None, stride: int = 1,
+                    padding: int = 0) -> _Projection:
+    """Conv with a TRAINABLE filter as a mixed_layer projection
+    (layers.py conv_projection; ConvProjection.cpp). NHWC."""
+    c = num_channels or _shape(input)[-1]
+
+    def emit():
+        w = FL._create_parameter(
+            "convproj_w", (filter_size, filter_size, c, num_filters),
+            "float32", I.msra())
+        return _emit("conv2d", {"Input": [input.var.name],
+                                "Filter": [w.name]},
+                     {"strides": stride, "paddings": padding},
+                     out_shape=(-1, -1, -1, num_filters))
+    return _Projection(emit, num_filters, src=input)
+
+
+def scale_sub_region_layer(input: LayerOutput, indices: LayerOutput,
+                           value: float) -> LayerOutput:
+    """Scale a per-sample sub-region of a CHW/HWC feature map by ``value``
+    (layers.py scale_sub_region_layer; ScaleSubRegionLayer.cpp). indices:
+    [B, 6] = (C_start, C_end, H_start, H_end, W_start, W_end), 1-based
+    inclusive, matching the reference layout."""
+    v = _emit("scale_sub_region",
+              {"X": [input.var.name], "Indices": [indices.var.name]},
+              {"value": value}, out_shape=_shape(input))
+    return LayerOutput(v)
+
+
+def slice_projection(input: LayerOutput, slices) -> _Projection:
+    """Concatenate feature slices [(start, end), ...] of the input
+    (SliceProjection, layers.py slice_projection)."""
+    total = sum(e - s for s, e in slices)
+
+    def emit():
+        parts = []
+        ndim = len(_shape(input))
+        for s, e in slices:
+            starts = [0] * (ndim - 1) + [s]
+            shape = [-1] * (ndim - 1) + [e - s]
+            parts.append(_emit("crop", {"X": [input.var.name]},
+                               {"offsets": starts, "shape": shape},
+                               out_shape=_shape(input)[:-1] + (e - s,)))
+        if len(parts) == 1:
+            return parts[0]
+        return _emit("concat", {"X": [p.name for p in parts]}, {"axis": -1},
+                     out_shape=_shape(input)[:-1] + (total,))
+    return _Projection(emit, total, src=input)
+
+
+def cross_entropy_over_beam(scores: LayerOutput, gold_index: LayerOutput,
+                            gold_score: Optional[LayerOutput] = None) -> LayerOutput:
+    """Beam-training cross entropy (CrossEntropyOverBeamLayer,
+    layers.py cross_entropy_over_beam): softmax CE over each sample's beam
+    candidate scores [B, K] with the gold candidate's beam position as the
+    label. When the gold fell OUT of the beam, pass gold_index = K and its
+    model score via ``gold_score`` — it joins as a (K+1)-th slot, the
+    reference's append-gold construction. In-beam samples never see the
+    appended slot (it is masked), so their gold score is counted exactly
+    once in the softmax partition."""
+    ins = {"X": [scores.var.name], "GoldIdx": [gold_index.var.name]}
+    if gold_score is not None:
+        ins["GoldScore"] = [gold_score.var.name]
+    v = _emit("cross_entropy_over_beam", ins, out_shape=(-1,))
+    return _mean_of(v)
+
+
+def print_layer(input: LayerOutput, head: int = 8) -> LayerOutput:
+    """Forward-value printer (layers.py print_layer / PrintLayer): registers
+    a fetchable head-of-values metric (the v2 evaluator DSL's printer) and
+    passes the input through unchanged — host-side logging decides
+    formatting, as in the reference."""
+    from .evaluator import value_printer_evaluator
+    value_printer_evaluator(input, head=head)
+    return input
+
+
+# name-parity aliases (the reference exports these spellings in __all__)
+convex_comb_layer = linear_comb_layer
+cross_entropy = cross_entropy_cost
+cross_entropy_with_selfnorm = cross_entropy_with_selfnorm_cost
+multi_binary_label_cross_entropy = multi_binary_label_cross_entropy_cost
+hsigmoid = hsigmoid_layer

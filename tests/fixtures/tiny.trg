@@ -1,0 +1,3 @@
+a house
+the car is red
+a car

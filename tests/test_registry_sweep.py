@@ -306,6 +306,31 @@ SPECS = {
     "gru_unit": dict(ins={"X": [f32(B, 3 * H)], "HPrev": [f32(B, H)],
                           "U": [f32(H, 3 * H)], "B": [f32(3 * H)]}, out="H",
                      grad=[("X", 0)]),
+    "lstm_step": dict(ins={"X": [f32(B, 4 * H)], "CPrev": [f32(B, H)],
+                           "WPeep": [f32(3, H)], "B": [f32(4 * H)]},
+                      out="H", grad=[("X", 0), ("CPrev", 0), ("WPeep", 0)]),
+    "kmax_seq_score": dict(ins={"X": [f32(B, T)], "Lengths": [LENGTHS]},
+                           attrs={"beam_size": 2}),
+    "sub_nested_seq": dict(
+        ins={"X": [f32(B, N, T, D)],
+             "SubLengths": [R.randint(1, T + 1, (B, N)).astype(np.int32)],
+             "Indices": [R.randint(0, N, (B, 2)).astype(np.int32)]},
+        out="Out", grad=[("X", 0)]),
+    "cross_entropy_over_beam": dict(
+        ins={"X": [f32(B, 4)], "GoldIdx": [np.array([0, 4], np.int32)],
+             "GoldScore": [f32(B, 1)]}, grad=[("X", 0)]),
+    "equal_scalar": dict(
+        ins={"X": [R.randint(0, V, (B, T)).astype(np.int32)]},
+        attrs={"value": 3}),
+    "dyn_conv2d": dict(
+        ins={"X": [f32(B, 5, 5, 2)], "Filter": [f32(B, 3 * 3 * 2 * 4)]},
+        attrs={"filter_size": 3, "num_filters": 4, "channels": 2,
+               "padding": 1}, grad=[("X", 0), ("Filter", 0)]),
+    "scale_sub_region": dict(
+        ins={"X": [f32(B, 4, 4, 2)],
+             "Indices": [np.tile(np.array([1, 2, 1, 3, 2, 4], np.int32),
+                                 (B, 1))]},
+        attrs={"value": 2.0}, grad=[("X", 0)]),
     # -- CRF / CTC / NCE -----------------------------------------------------
     "linear_chain_crf": dict(
         ins={"Emission": [f32(B, T, N)],

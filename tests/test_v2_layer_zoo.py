@@ -473,3 +473,357 @@ def test_v2_auc_evaluator_from_logits():
     auc = paddle.evaluator.auc_evaluator(logits, y)
     v = _run(auc, {"x": X, "y": RS.randint(0, 2, B).astype(np.int32)})
     assert 0.0 <= float(v) <= 1.0
+
+
+# ------------------------------------------------- gen-1 tail (round 3) ------
+
+def test_lstm_gru_step_layers_in_recurrent_group():
+    """User-composed LSTM/GRU cells from step layers inside
+    recurrent_group — the reference's signature capability
+    (layers.py:3544 lstm_step_layer, :3642 gru_step_layer): the step net
+    builds gates with mixed-style projections, lstm_step adds peephole +
+    cell recurrence, and the cell memory is wired through
+    get_output_layer(out, 'state')."""
+    H = 5
+    s = _seq("s")
+
+    def lstm_step(x_t):
+        h_mem = L.memory("h", H)
+        c_mem = L.memory("c", H)
+        gates = L.mixed_layer(size=4 * H, input=[
+            L.full_matrix_projection(x_t, 4 * H),
+            L.full_matrix_projection(h_mem, 4 * H)])
+        out = L.lstm_step_layer(gates, c_mem, size=H, name="h")
+        L.identity(L.get_output_layer(out, "state"), name="c")
+        return out
+
+    out = L.recurrent_group(lstm_step, s)
+    last = L.last_seq(out)
+    v = _run(last, {"s": SEQ, "s__len__": LENS})
+    assert v.shape == (B, H) and np.isfinite(v).all()
+
+    fluid.reset_default_programs()
+    s = _seq("s2")
+
+    def gru_step(x_t):
+        h_mem = L.memory("h", H)
+        xw = L.mixed_layer(size=3 * H,
+                           input=[L.full_matrix_projection(x_t, 3 * H)])
+        return L.gru_step_layer(xw, h_mem, size=H, name="h")
+
+    out = L.recurrent_group(gru_step, s)
+    v = _run(L.last_seq(out), {"s2": SEQ, "s2__len__": LENS})
+    assert v.shape == (B, H) and np.isfinite(v).all()
+
+
+def test_lstm_step_matches_builtin_lstm_without_peephole():
+    """With zero peephole weights and matched parameters, a
+    recurrent_group of lstm_step_layer computes exactly what the
+    whole-sequence lstm op computes (the composition is real, not a
+    lookalike)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.rnn import lstm, lstm_peephole_step
+
+    rs = np.random.RandomState(3)
+    Bb, Tt, Dd, Hh = 3, 5, 4, 6
+    x = jnp.asarray(rs.randn(Bb, Tt, Dd), np.float32)
+    w = jnp.asarray(rs.randn(Dd, 4 * Hh) * 0.3, np.float32)
+    u = jnp.asarray(rs.randn(Hh, 4 * Hh) * 0.3, np.float32)
+    bias = jnp.asarray(rs.randn(4 * Hh) * 0.1, np.float32)
+    ref_out, ref_state = lstm(x, None, w, u, bias, fused=False)
+
+    h = jnp.zeros((Bb, Hh))
+    c = jnp.zeros((Bb, Hh))
+    zero_peep = jnp.zeros((3, Hh))
+    for t in range(Tt):
+        gates = x[:, t] @ w + h @ u
+        h, c = lstm_peephole_step(gates, c, zero_peep, bias)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(ref_state.h),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(ref_state.c),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_selective_fc_and_gated_unit():
+    x = _dense("x")
+    sel = L.data("sel", DT.dense_vector(5))
+    out = L.selective_fc_layer(x, 5, select=sel)
+    mask = (RS.rand(B, 5) > 0.5).astype(np.float32)
+    v = _run(out, {"x": X, "sel": mask})
+    assert v.shape == (B, 5)
+    assert np.all(v[mask == 0] == 0)           # unselected columns are zero
+
+    fluid.reset_default_programs()
+    s = _seq("s")
+    out = L.gated_unit_layer(s, 7, act="tanh")
+    assert out.lengths is not None             # sequence-ness preserved
+    v = _run(out, {"s": SEQ, "s__len__": LENS})
+    assert v.shape == (B, T, 7)
+    assert np.all(np.abs(v) <= 1.0 + 1e-6)     # tanh * sigmoid bound
+
+
+def test_elementwise_tail_layers():
+    x = _dense("x")
+    y = _dense("y")
+    d = L.dot_prod_layer(x, y)
+    v = _run(d, {"x": X, "y": X2})
+    np.testing.assert_allclose(v[:, 0], (X * X2).sum(-1), rtol=1e-5)
+
+    fluid.reset_default_programs()
+    x = _dense("x")
+    y = L.data("y", DT.dense_vector(3))
+    o = L.out_prod_layer(x, y)
+    v = _run(o, {"x": X, "y": X2[:, :3]})
+    np.testing.assert_allclose(
+        v.reshape(B, D, 3), np.einsum("bi,bj->bij", X, X2[:, :3]), rtol=1e-5)
+
+    fluid.reset_default_programs()
+    ids = L.data("ids", DT.integer_value(V))
+    e = L.eos_layer(ids, eos_id=3)
+    idv = np.array([3, 1, 3, 0], np.int32)
+    v = _run(e, {"ids": idv})
+    np.testing.assert_array_equal(v, (idv == 3).astype(np.int32))
+
+    fluid.reset_default_programs()
+    x = _dense("x")
+    n = L.row_l2_norm_layer(x)
+    v = _run(n, {"x": X})
+    np.testing.assert_allclose(np.linalg.norm(v, axis=1),
+                               np.ones(B), rtol=1e-4)
+
+    fluid.reset_default_programs()
+    x = _dense("x")
+    ss = L.scale_shift_layer(x)
+    v = _run(ss, {"x": X})        # w=1, b=0 at init
+    np.testing.assert_allclose(v, X, rtol=1e-6)
+
+    fluid.reset_default_programs()
+    x = _dense("x")
+    r = L.resize_layer(x, D // 2)
+    v = _run(r, {"x": X})
+    assert v.shape == (B * 2, D // 2)
+    np.testing.assert_allclose(v.reshape(B, D), X, rtol=1e-6)
+
+
+def test_cross_channel_norm_and_switch_order():
+    img = L.data("img", DT.dense_vector(4 * 4 * 6))
+    nchw = L.identity(img)
+    nchw.var = fluid.layers.reshape(img.var, (-1, 6, 4, 4))
+    sw = L.switch_order_layer(nchw)            # NCHW -> NHWC
+    ccn = L.cross_channel_norm_layer(sw)
+    raw = RS.randn(B, 6 * 4 * 4).astype(np.float32)
+    v = _run(ccn, {"img": raw})
+    assert v.shape == (B, 4, 4, 6)
+    np.testing.assert_allclose(np.linalg.norm(v, axis=-1),
+                               np.ones((B, 4, 4)), rtol=1e-4)
+
+
+def test_sub_seq_family():
+    s = _seq("s")
+    offs = L.data("offs", DT.integer_value(T))
+    szs = L.data("szs", DT.integer_value(T))
+    sub = L.sub_seq_layer(s, offs, szs)
+    off_v = np.array([1, 0, 1, 0], np.int32)
+    sz_v = np.array([3, 2, 2, 2], np.int32)
+    v = _run(sub, {"s": SEQ, "s__len__": LENS, "offs": off_v, "szs": sz_v})
+    for bi in range(B):
+        np.testing.assert_allclose(
+            v[bi, :sz_v[bi]], SEQ[bi, off_v[bi]:off_v[bi] + sz_v[bi]],
+            rtol=1e-6)
+
+    fluid.reset_default_programs()
+    s = _seq("s")
+    ends = L.data("ends", DT.integer_value(T))
+    sl = L.seq_slice_layer(s, None, ends)      # slice from the beginning
+    v = _run(sl, {"s": SEQ, "s__len__": LENS,
+                  "ends": np.array([2, 2, 1, 1], np.int32)})
+    np.testing.assert_allclose(v[:, 0], SEQ[:, 0], rtol=1e-6)
+
+    fluid.reset_default_programs()
+    a = _seq("a")
+    bseq = _seq("b")
+    cat = L.seq_concat_layer(a, bseq)
+    assert cat.lengths is not None
+    exe = Executor()
+    exe.run(fluid.default_startup_program())
+    out, lens = exe.run(
+        fluid.default_main_program(),
+        feed={"a": SEQ, "a__len__": LENS, "b": SEQ, "b__len__": LENS},
+        fetch_list=[cat.var.name, cat.lengths.name])
+    lens = np.asarray(lens)
+    np.testing.assert_array_equal(lens, LENS * 2)
+    for bi in range(B):
+        got = np.asarray(out)[bi]
+        np.testing.assert_allclose(got[:LENS[bi]], SEQ[bi, :LENS[bi]],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(got[LENS[bi]:2 * LENS[bi]],
+                                   SEQ[bi, :LENS[bi]], rtol=1e-6)
+
+
+def test_kmax_and_sub_nested_seq():
+    scores = L.data("sc", DT.dense_vector_sequence(1))
+    km = L.kmax_seq_score_layer(scores, beam_size=2)
+    sv = RS.randn(B, T, 1).astype(np.float32)
+    sv[0, 5] = 100.0                           # but len(0)=6 -> selectable
+    sv[3, 4] = 100.0                           # len(3)=2 -> NOT selectable
+    v = _run(km, {"sc": sv, "sc__len__": LENS})
+    assert v.shape == (B, 2)
+    assert 5 in v[0]
+    assert 4 not in v[3]                       # padding never selected
+
+    fluid.reset_default_programs()
+    nested = L.data("ns", DT.dense_vector_sub_sequence(D))
+    idx = L.LayerOutput(fluid.layers.data("idx", shape=(1,), dtype="int32"))
+    trimmed = L.sub_nested_seq_layer(nested, idx)
+    ns = RS.randn(B, 3, T, D).astype(np.float32)
+    sub_lens = RS.randint(1, T + 1, (B, 3)).astype(np.int32)
+    n_lens = np.full((B,), 3, np.int32)
+    pick = np.array([[2], [0], [1], [2]], np.int32)
+    exe = Executor()
+    exe.run(fluid.default_startup_program())
+    out, slo = exe.run(
+        fluid.default_main_program(),
+        feed={"ns": ns, "ns__sublen__": sub_lens, "ns__len__": n_lens,
+              "idx": pick},
+        fetch_list=[trimmed.var.name, trimmed.sub_lengths.name])
+    out = np.asarray(out)
+    for bi in range(B):
+        np.testing.assert_allclose(out[bi, 0], ns[bi, pick[bi, 0]],
+                                   rtol=1e-6)
+        assert np.asarray(slo)[bi, 0] == sub_lens[bi, pick[bi, 0]]
+
+
+def test_detection_dsl_trio():
+    """priorbox -> multibox_loss (train) / detection_output (infer) at the
+    v2 DSL level (layers.py:1114,1160,1233) over the existing detection
+    ops."""
+    F, IMG, P_, C = 4, 32, 4 * 4 * 4, 3   # 4x4 map, 4 priors/cell
+    # (min + sqrt(min*max) + aspect 2 flipped = 4)
+    feat = L.data("feat", DT.dense_vector(F * F * 8))
+    img = L.data("img", DT.dense_vector(IMG * IMG * 3))
+    featm = L.identity(feat)
+    featm.var = fluid.layers.reshape(feat.var, (-1, F, F, 8))
+    imgm = L.identity(img)
+    imgm.var = fluid.layers.reshape(img.var, (-1, IMG, IMG, 3))
+    pb = L.priorbox_layer(featm, imgm, aspect_ratio=[2.0],
+                          variance=[0.1, 0.1, 0.2, 0.2],
+                          min_size=[10.0], max_size=[20.0])
+    assert pb.outputs and "variances" in pb.outputs
+
+    loc = L.data("loc", DT.dense_vector(P_ * 4))
+    conf = L.data("conf", DT.dense_vector(P_ * C))
+    locm = L.identity(loc)
+    locm.var = fluid.layers.reshape(loc.var, (-1, P_, 4))
+    confm = L.identity(conf)
+    confm.var = fluid.layers.reshape(conf.var, (-1, P_, C))
+
+    G = 2
+    gtb = L.data("gtb", DT.dense_vector(G * 4))
+    gtl = fluid.layers.data("gtl", shape=(G,), dtype="int32")
+    gtm = L.data("gtm", DT.dense_vector(G))
+    gt = L.identity(gtb)
+    gt.var = fluid.layers.reshape(gtb.var, (-1, G, 4))
+    gt.outputs = {"gt_label": gtl, "gt_mask": gtm.var}
+
+    loss = L.multibox_loss_layer(locm, confm, pb, gt, num_classes=C)
+    det = L.detection_output_layer(locm, confm, pb, num_classes=C,
+                                   keep_top_k=5)
+
+    exe = Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"feat": RS.randn(B, F * F * 8).astype(np.float32),
+            "img": RS.randn(B, IMG * IMG * 3).astype(np.float32),
+            "loc": (RS.randn(B, P_ * 4) * 0.1).astype(np.float32),
+            "conf": RS.randn(B, P_ * C).astype(np.float32),
+            "gtb": RS.rand(B, G * 4).astype(np.float32),
+            "gtl": RS.randint(1, C, (B, G)).astype(np.int32),
+            "gtm": np.ones((B, G), np.float32)}
+    lv, bv, sv2, vv = exe.run(
+        fluid.default_main_program(), feed=feed,
+        fetch_list=[loss.var.name, det.var.name,
+                    det.outputs["scores"].name, det.outputs["valid"].name])
+    assert np.isfinite(np.asarray(lv)).all()
+    assert np.asarray(bv).shape == (B, C - 1, 5, 4)   # per non-bg class
+    assert np.asarray(sv2).shape == (B, C - 1, 5)
+
+
+def test_conv_projection_and_operator_in_mixed():
+    """conv_projection (trainable filter) and conv_operator (dynamic,
+    input-supplied filter) as mixed_layer components (ConvProjection.cpp /
+    ConvOperator.cpp)."""
+    from paddle_tpu.fluid import layers as FL
+    img = L.data("img", DT.dense_vector(6 * 6 * 2))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 6, 6, 2))), (B, 6, 6, 2))
+    out = L.mixed_layer(size=4, input=[
+        L.conv_projection(x, filter_size=3, num_filters=4, padding=1)])
+    v = _run(out, {"img": RS.randn(B, 6 * 6 * 2).astype(np.float32)})
+    assert v.shape == (B, 6, 6, 4)
+
+    fluid.reset_default_programs()
+    img = L.data("img", DT.dense_vector(6 * 6 * 2))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 6, 6, 2))), (B, 6, 6, 2))
+    filt = L.data("filt", DT.dense_vector(3 * 3 * 2 * 4))
+    out = L.mixed_layer(size=4, input=[
+        L.conv_operator(x, filt, filter_size=3, num_filters=4, padding=1)])
+    img_v = RS.randn(B, 6 * 6 * 2).astype(np.float32)
+    filt_v = RS.randn(B, 3 * 3 * 2 * 4).astype(np.float32)
+    v = _run(out, {"img": img_v, "filt": filt_v})
+    assert v.shape == (B, 6, 6, 4)
+    # layout check: the flat filter is the reference's (F, C, k, k) packing
+    from paddle_tpu.ops.conv import conv2d
+    xi = img_v.reshape(B, 6, 6, 2)
+    for bi in range(B):
+        w = filt_v[bi].reshape(4, 2, 3, 3).transpose(2, 3, 1, 0)  # HWIO
+        ref = np.asarray(conv2d(xi[bi:bi + 1], w, padding=1))[0]
+        np.testing.assert_allclose(v[bi], ref, rtol=2e-4, atol=2e-4)
+
+
+def test_scale_sub_region_layer():
+    from paddle_tpu.fluid import layers as FL
+    img = L.data("img", DT.dense_vector(4 * 4 * 2))
+    x = _as4(L.LayerOutput(FL.reshape(img.var, (-1, 4, 4, 2))), (B, 4, 4, 2))
+    idx = L.LayerOutput(fluid.layers.data("idx", shape=(6,), dtype="int32"))
+    out = L.scale_sub_region_layer(x, idx, value=3.0)
+    raw = RS.randn(B, 4 * 4 * 2).astype(np.float32)
+    iv = np.tile(np.array([1, 1, 1, 2, 1, 2], np.int32), (B, 1))  # c0,h0-1,w0-1
+    v = _run(out, {"img": raw, "idx": iv})
+    r = raw.reshape(B, 4, 4, 2)
+    np.testing.assert_allclose(v[:, :2, :2, 0], r[:, :2, :2, 0] * 3.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(v[:, 2:, :, :], r[:, 2:, :, :], rtol=1e-6)
+    np.testing.assert_allclose(v[:, :2, :2, 1], r[:, :2, :2, 1], rtol=1e-6)
+
+
+def test_slice_projection_print_and_beam_ce():
+    x = _dense("x")
+    out = L.mixed_layer(size=5, input=[
+        L.slice_projection(x, [(0, 2), (5, 8)])])
+    v = _run(out, {"x": X})
+    np.testing.assert_allclose(v, np.concatenate([X[:, 0:2], X[:, 5:8]], 1),
+                               rtol=1e-6)
+
+    fluid.reset_default_programs()
+    x = _dense("x")
+    same = L.print_layer(x)               # passthrough + printer metric
+    v = _run(same, {"x": X})
+    np.testing.assert_allclose(v, X, rtol=1e-6)
+
+    fluid.reset_default_programs()
+    scores = L.data("sc", DT.dense_vector(4))
+    gold = L.data("g", DT.integer_value(5))
+    gscore = L.data("gs", DT.dense_vector(1))
+    loss = L.cross_entropy_over_beam(scores, gold, gscore)
+    sc = RS.randn(B, 4).astype(np.float32)
+    g = np.array([0, 4, 2, 1], np.int32)          # 4 = out-of-beam
+    gs = RS.randn(B, 1).astype(np.float32)
+    v = _run(loss, {"sc": sc, "g": g, "gs": gs})
+    assert np.isfinite(v).all()
+    # reference per-sample append-gold semantics: in-beam rows softmax over
+    # K slots only; the out-of-beam row over K+1 with its gold appended
+    def ce(logits, idx):
+        z = logits - logits.max()
+        return -(z[idx] - np.log(np.exp(z).sum()))
+    want = np.mean([ce(sc[0], 0), ce(np.append(sc[1], gs[1]), 4),
+                    ce(sc[2], 2), ce(sc[3], 1)])
+    np.testing.assert_allclose(float(v), want, rtol=1e-4)
