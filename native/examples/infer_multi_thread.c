@@ -1,0 +1,116 @@
+/* Concurrent inference from plain C — the
+ * capi/examples/model_inference/multi_thread analog. N pthreads share ONE
+ * model handle and forward simultaneously; the library serializes through
+ * the embedded interpreter's GIL (XLA releases it during device execution)
+ * so every call must return the same bit-exact result for the same input.
+ *
+ * Build: gcc infer_multi_thread.c -o infer_multi_thread -pthread \
+ *            -L../.. -lpaddle_tpu_capi
+ * Run:   ./infer_multi_thread <model_dir> <n_threads> <iters> <n_rows> <dim>
+ * Prints the reference row values then "OK <n_threads>x<iters>"; exit 0 on
+ * success, 1 on any thread error or cross-thread mismatch.
+ */
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern void* pti_create(const char* model_dir);
+extern int pti_forward(void* h, const void** inputs, const long long* shapes,
+                       const int* ndims, const int* dtypes, int n_inputs,
+                       int fetch_index, float* out_buf, long long out_capacity,
+                       long long* out_shape, int* out_ndim);
+extern void pti_destroy(void* h);
+extern const char* pti_last_error(void);
+
+#define MAX_OUT (1 << 16)
+
+static void* g_handle;
+static float* g_input;
+static long long g_shapes[2];
+static float g_ref[MAX_OUT];
+static int g_ref_elems;
+
+static int do_forward(float* out, long long* out_shape, int* out_ndim) {
+  const void* inputs[1] = {g_input};
+  int ndims[1] = {2};
+  int dtypes[1] = {0};
+  return pti_forward(g_handle, inputs, g_shapes, ndims, dtypes, 1, 0, out,
+                     MAX_OUT, out_shape, out_ndim);
+}
+
+struct worker_arg {
+  int iters;
+  int failed;
+};
+
+static void* worker(void* p) {
+  struct worker_arg* a = (struct worker_arg*)p;
+  float out[MAX_OUT];
+  long long out_shape[8];
+  int out_ndim;
+  for (int i = 0; i < a->iters; i++) {
+    int rc = do_forward(out, out_shape, &out_ndim);
+    if (rc != g_ref_elems ||
+        memcmp(out, g_ref, sizeof(float) * (size_t)g_ref_elems) != 0) {
+      fprintf(stderr, "thread mismatch at iter %d (rc=%d): %s\n", i, rc,
+              rc < 0 ? pti_last_error() : "values differ");
+      a->failed = 1;
+      return NULL;
+    }
+  }
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 6) {
+    fprintf(stderr, "usage: %s <model_dir> <n_threads> <iters> <n_rows> <dim>\n",
+            argv[0]);
+    return 2;
+  }
+  int n_threads = atoi(argv[2]), iters = atoi(argv[3]);
+  int n = atoi(argv[4]), d = atoi(argv[5]);
+
+  g_handle = pti_create(argv[1]);
+  if (!g_handle) {
+    fprintf(stderr, "create failed: %s\n", pti_last_error());
+    return 1;
+  }
+  g_input = malloc(sizeof(float) * n * d);
+  for (int i = 0; i < n * d; i++) g_input[i] = (float)(i % 5) * 0.2f - 0.4f;
+  g_shapes[0] = n;
+  g_shapes[1] = d;
+
+  long long out_shape[8];
+  int out_ndim;
+  g_ref_elems = do_forward(g_ref, out_shape, &out_ndim);
+  if (g_ref_elems < 0) {
+    fprintf(stderr, "reference forward failed: %s\n", pti_last_error());
+    return 1;
+  }
+  long long cols = out_ndim >= 2 ? out_shape[1] : 1;
+  for (int r = 0; r < (out_ndim >= 1 ? out_shape[0] : 1); r++) {
+    for (long long c = 0; c < cols; c++)
+      printf("%s%.6f", c ? " " : "", g_ref[r * cols + c]);
+    printf("\n");
+  }
+
+  pthread_t* tids = malloc(sizeof(pthread_t) * n_threads);
+  struct worker_arg* args = calloc(n_threads, sizeof(struct worker_arg));
+  for (int t = 0; t < n_threads; t++) {
+    args[t].iters = iters;
+    pthread_create(&tids[t], NULL, worker, &args[t]);
+  }
+  int failed = 0;
+  for (int t = 0; t < n_threads; t++) {
+    pthread_join(tids[t], NULL);
+    failed |= args[t].failed;
+  }
+  free(tids);
+  free(args);
+  free(g_input);
+  pti_destroy(g_handle);
+  if (failed) return 1;
+  printf("OK %dx%d\n", n_threads, iters);
+  return 0;
+}

@@ -1,0 +1,79 @@
+/* Sequence (lengths-carrying) inference from plain C — the
+ * capi/examples/model_inference/sequence analog. The exported model takes
+ * int32 token ids padded to [batch, max_len] plus an int32 [batch] lengths
+ * slot (the TPU-native LoD encoding: SURVEY sequence design — padded dense
+ * tensor + true lengths instead of the reference's row offsets).
+ *
+ * Build: gcc infer_sequence.c -o infer_sequence -L../.. -lpaddle_tpu_capi
+ * Run:   ./infer_sequence <model_dir> <batch> <max_len> <vocab>
+ * Prints one line per sequence; exit 0 on success.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* pti_create(const char* model_dir);
+extern int pti_forward(void* h, const void** inputs, const long long* shapes,
+                       const int* ndims, const int* dtypes, int n_inputs,
+                       int fetch_index, float* out_buf, long long out_capacity,
+                       long long* out_shape, int* out_ndim);
+extern void pti_destroy(void* h);
+extern const char* pti_last_error(void);
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    fprintf(stderr, "usage: %s <model_dir> <batch> <max_len> <vocab>\n",
+            argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  int batch = atoi(argv[2]);
+  int max_len = atoi(argv[3]);
+  int vocab = atoi(argv[4]);
+
+  void* h = pti_create(model_dir);
+  if (!h) {
+    fprintf(stderr, "create failed: %s\n", pti_last_error());
+    return 1;
+  }
+
+  /* deterministic ragged batch: sequence b has length max_len - b (>=1),
+   * ids cycle through the vocabulary; padding positions hold 0 and must be
+   * ignored by the model because the lengths slot masks them. */
+  int* ids = calloc((size_t)batch * max_len, sizeof(int));
+  int* lens = malloc(sizeof(int) * batch);
+  for (int b = 0; b < batch; b++) {
+    int len = max_len - b;
+    if (len < 1) len = 1;
+    lens[b] = len;
+    for (int t = 0; t < len; t++)
+      ids[b * max_len + t] = (b * 31 + t * 7) % vocab;
+  }
+
+  const void* inputs[2] = {ids, lens};
+  long long shapes[3] = {batch, max_len, batch}; /* [B,T] then [B] */
+  int ndims[2] = {2, 1};
+  int dtypes[2] = {1, 1}; /* both i32 */
+  long long cap = 1 << 20;
+  float* out = malloc(sizeof(float) * cap);
+  long long out_shape[8];
+  int out_ndim = 0;
+
+  int rc = pti_forward(h, inputs, shapes, ndims, dtypes, 2, 0, out, cap,
+                       out_shape, &out_ndim);
+  if (rc < 0) {
+    fprintf(stderr, "forward failed (%d): %s\n", rc, pti_last_error());
+    return 1;
+  }
+  long long rows_n = out_ndim >= 1 ? out_shape[0] : 1;
+  long long cols = out_ndim >= 2 ? out_shape[1] : 1;
+  for (long long r = 0; r < rows_n; r++) {
+    for (long long c = 0; c < cols; c++)
+      printf("%s%.6f", c ? " " : "", out[r * cols + c]);
+    printf("\n");
+  }
+  free(ids);
+  free(lens);
+  free(out);
+  pti_destroy(h);
+  return 0;
+}

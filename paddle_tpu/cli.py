@@ -64,8 +64,38 @@ def cmd_train(args):
                 with open(os.path.join(d, "params.tar"), "wb") as f:
                     trainer.parameters.to_tar(f)
 
-    trainer.train(cfg["train_reader"], num_passes=args.num_passes,
-                  event_handler=handler, feeding=cfg.get("feeding"))
+    train_reader = cfg["train_reader"]
+    srv = None
+    if getattr(args, "local_master", False):
+        # One-binary bring-up (TrainerMain.cpp:32-49 --start_pserver analog):
+        # self-host the ENTIRE data-dispatch cluster in this process — the
+        # native task master + its TCP service on a background thread, the
+        # trainer as its first consumer. Same code paths as the real
+        # multi-host deployment (chunk dump, get_task RPC, timeout
+        # re-dispatch), zero extra processes: the local dev mode.
+        import os
+        import tempfile
+
+        from .data.chunks import cloud_reader, dump_to_chunks
+        from .runtime.master_service import MasterClient, MasterServer
+
+        chunk_dir = (os.path.join(args.save_dir, "chunks") if args.save_dir
+                     else tempfile.mkdtemp(prefix="paddle_tpu_chunks_"))
+        os.makedirs(chunk_dir, exist_ok=True)
+        paths = dump_to_chunks(train_reader, chunk_dir,
+                               samples_per_chunk=args.samples_per_chunk)
+        srv = MasterServer().start()
+        client = MasterClient(*srv.address)
+        client.set_dataset(paths)
+        print(f"local master: {len(paths)} chunks on "
+              f"{srv.address[0]}:{srv.address[1]}")
+        train_reader = cloud_reader(client, new_pass_at_end=True)
+    try:
+        trainer.train(train_reader, num_passes=args.num_passes,
+                      event_handler=handler, feeding=cfg.get("feeding"))
+    finally:
+        if srv is not None:
+            srv.stop()
     if args.save_dir and "outputs" in cfg:
         from . import fluid
         fluid.io.export_inference_model(
@@ -243,11 +273,26 @@ def cmd_cluster_train(args):
                 code = p.poll()
                 if code is not None:
                     pending.remove(p)
-                    rc = rc or code
-            if rc:                   # a peer failed -> kill the rest now
-                break
-            if _time.time() > deadline:
+                    if code and not rc:
+                        rc = code
+                        print(f"cluster_train: worker {procs.index(p)} "
+                              f"exited rc={code}; tearing the job down "
+                              f"(survivors get SIGTERM, {args.grace:.0f}s "
+                              f"grace). Restart from the latest checkpoint "
+                              f"— see docs/design/distributed.md.",
+                              file=sys.stderr)
+            if not rc and _time.time() > deadline:
                 rc = 124
+                print(f"cluster_train: --timeout {args.timeout:.0f}s "
+                      f"exceeded; tearing the job down.", file=sys.stderr)
+            if rc:     # peer failure or timeout -> graceful teardown
+                for p in pending:
+                    if p.poll() is None:
+                        p.terminate()   # survivors run their teardown hook
+                grace_end = _time.time() + args.grace
+                while (any(p.poll() is None for p in pending)
+                       and _time.time() < grace_end):
+                    _time.sleep(0.1)
                 break
             _time.sleep(0.2)
     finally:
@@ -314,6 +359,13 @@ def main(argv=None) -> int:
     t.add_argument("--num_passes", type=int, default=1)
     t.add_argument("--save_dir", default=None)
     t.add_argument("--log_period", type=int, default=0)
+    t.add_argument("--local_master", action="store_true",
+                   help="self-host the task-master data plane in-process "
+                        "(TrainerMain --start_pserver analog): dump the "
+                        "reader to chunks, serve them over the real RPC "
+                        "plane, train as its first consumer")
+    t.add_argument("--samples_per_chunk", type=int, default=64,
+                   help="reader items per dispatched chunk (--local_master)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test")
@@ -351,13 +403,17 @@ def main(argv=None) -> int:
 
     ct = sub.add_parser("cluster_train")
     ct.add_argument("script", help="training script run by every worker")
-    ct.add_argument("script_args", nargs=argparse.REMAINDER,
-                    help="args passed through verbatim (flags included)")
+    ct.add_argument("script_args", nargs="*",
+                    help="args passed through to the script (put them after "
+                         "a -- separator if they start with a dash)")
     ct.add_argument("--num_workers", type=int, default=2)
     ct.add_argument("--devices_per_worker", type=int, default=0,
                     help="force N virtual CPU devices per worker (testing; "
                          "0 = use the worker's real accelerators)")
     ct.add_argument("--timeout", type=float, default=600.0)
+    ct.add_argument("--grace", type=float, default=10.0,
+                    help="seconds survivors get to run their teardown hook "
+                         "(SIGTERM) before SIGKILL when a peer fails")
     ct.set_defaults(fn=cmd_cluster_train)
 
     v = sub.add_parser("version")

@@ -59,10 +59,17 @@ def chunk_reader(paths: Iterable[str]) -> Reader:
 
 def cloud_reader(master_client, *, pass_end_sentinel: bool = False,
                  poll_interval: float = 0.1,
-                 max_idle_polls: int = 600) -> Reader:
+                 max_idle_polls: int = 600,
+                 new_pass_at_end: bool = False) -> Reader:
     """Fault-tolerant distributed reader (creator.py:91 cloud_reader): pull
     chunk tasks from the master service, stream their samples, report
-    finished/failed. One pass = until the master says the pass is done."""
+    finished/failed. One pass = until the master says the pass is done.
+
+    ``new_pass_at_end`` cycles the master's pass when this reader drains it,
+    so the next ``reader()`` call streams a fresh pass — correct for a
+    single consumer (the --local_master dev mode); multi-consumer jobs
+    coordinate the pass transition externally (e.g. rank 0 only).
+    """
     import time
 
     def reader():
@@ -72,6 +79,8 @@ def cloud_reader(master_client, *, pass_end_sentinel: bool = False,
             if task is None:
                 todo, pending, done, disc, epoch = master_client.stats()
                 if todo == 0 and pending == 0:
+                    if new_pass_at_end:
+                        master_client.new_pass()
                     return                      # pass complete
                 idle += 1
                 if idle > max_idle_polls:

@@ -96,13 +96,20 @@ def parse_idx_labels(path: str) -> np.ndarray:
 
 def mnist_reader(images_path: str, labels_path: str):
     """Reader over real MNIST idx files — same sample schema as the
-    synthetic dataset.mnist (image[784] float, int label)."""
+    synthetic dataset.mnist (image[784] float, int label). Files parse
+    lazily on first iteration, then cache, so multi-pass training decodes
+    the ~55MB idx bodies once."""
+    cache = []
+
     def reader():
-        imgs = parse_idx_images(images_path)
-        labels = parse_idx_labels(labels_path)
-        if len(imgs) != len(labels):
-            raise IOError("mnist: image/label count mismatch "
-                          f"({len(imgs)} vs {len(labels)})")
+        if not cache:
+            imgs = parse_idx_images(images_path)
+            labels = parse_idx_labels(labels_path)
+            if len(imgs) != len(labels):
+                raise IOError("mnist: image/label count mismatch "
+                              f"({len(imgs)} vs {len(labels)})")
+            cache.append((imgs, labels))
+        imgs, labels = cache[0]
         for i in range(len(imgs)):
             yield imgs[i], int(labels[i])
     return reader
@@ -182,12 +189,30 @@ def conll_reader(path: str, word_dict: Optional[Dict[str, int]] = None,
     if tag_dict is None:
         tag_dict = build_dict((t for _, ts in sents for t in ts),
                               specials=())
-    unk = word_dict.get("<unk>", 0)
+    unk = word_dict.get("<unk>")
+
+    def lookup_word(w):
+        wid = word_dict.get(w, unk)
+        if wid is None:
+            raise ValueError(
+                f"conll: word {w!r} not in the supplied word_dict and the "
+                "dict has no '<unk>' entry — add one (build_dict does) or "
+                "pass a dict covering this split")
+        return wid
+
+    def lookup_tag(t):
+        tid = tag_dict.get(t)
+        if tid is None:
+            raise ValueError(
+                f"conll: tag {t!r} not in the supplied tag_dict "
+                f"({len(tag_dict)} tags) — tag sets must cover every split "
+                "(build the dict over train+test or extend it)")
+        return tid
 
     def reader():
         for ws, ts in sents:
-            yield ([word_dict.get(w, unk) for w in ws],
-                   [tag_dict[t] for t in ts])
+            yield ([lookup_word(w) for w in ws],
+                   [lookup_tag(t) for t in ts])
     reader.word_dict = word_dict
     reader.tag_dict = tag_dict
     return reader

@@ -12,13 +12,48 @@ DCN across), and the data plane is the master service
 from __future__ import annotations
 
 import os
-from typing import Optional
+import signal
+import sys
+from typing import Callable, List, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 from .mesh import MeshSpec, make_mesh
+
+# Exit code a worker uses for clean job-teardown (peer failure): distinct
+# from crash codes so the launcher/operator can tell "I was torn down" from
+# "I failed". Mirrors the reference's trainer-as-stateless-task-consumer
+# contract (doc/design/cluster_train/README.md): workers hold no durable
+# state, so teardown is checkpoint-then-exit and recovery is a fresh launch.
+TEARDOWN_EXIT_CODE = 17
+
+_teardown_hooks: List[Callable[[], None]] = []
+
+
+def on_job_teardown(fn: Callable[[], None]) -> None:
+    """Register a callback run when the launcher tears the job down after a
+    peer failure (SIGTERM). Typical use: write a final checkpoint marker so
+    the restart (docs/design/distributed.md runbook) resumes at the last
+    good pass instead of from scratch."""
+    _teardown_hooks.append(fn)
+
+
+def _teardown_handler(signum, frame):  # noqa: ARG001 - signal signature
+    print("paddle_tpu.multihost: job teardown (peer failure or operator "
+          "stop) — running teardown hooks, then exiting. Restart from the "
+          "latest checkpoint: docs/design/distributed.md.", file=sys.stderr)
+    for fn in _teardown_hooks:
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 - teardown must not cascade
+            print(f"paddle_tpu.multihost: teardown hook failed: {e}",
+                  file=sys.stderr)
+    sys.stderr.flush()
+    # _exit, not SystemExit: the main thread may be inside a blocked
+    # collective; raising would be swallowed or deadlock in native code.
+    os._exit(TEARDOWN_EXIT_CODE)
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -44,6 +79,9 @@ def initialize(coordinator_address: Optional[str] = None,
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
+        # the launcher (cli.py cluster_train) tears a failed job down with
+        # SIGTERM-then-SIGKILL; give every worker the clean-exit path
+        signal.signal(signal.SIGTERM, _teardown_handler)
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),

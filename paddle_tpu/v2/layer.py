@@ -1576,10 +1576,30 @@ def priorbox_layer(input: LayerOutput, image: LayerOutput,
         var_parts.append(variances)
     if len(box_parts) == 1:
         return LayerOutput(box_parts[0], outputs={"variances": var_parts[0]})
-    boxes = _emit("concat", {"X": [b.name for b in box_parts]}, {"axis": 0},
+
+    # Cell-major interleave across sizes (PriorBoxLayer.cpp: per cell, ALL
+    # sizes' priors are contiguous) so prior rows line up with conv heads
+    # that emit priors-per-cell; a plain axis-0 concat would be size-major.
+    cells = fh * fw
+    n_ratio = len(tuple(aspect_ratio)) * (2 if flip else 1)
+
+    def per_cell(var, i):
+        p_i = 1 + (1 if i < len(maxs) and maxs[i] is not None else 0) + n_ratio
+        return _emit("reshape", {"X": [var.name]},
+                     {"shape": (cells, p_i, 4)}, out_shape=(cells, p_i, 4))
+
+    boxes3 = _emit("concat",
+                   {"X": [per_cell(b, i).name
+                          for i, b in enumerate(box_parts)]},
+                   {"axis": 1}, out_shape=(cells, -1, 4))
+    vars3 = _emit("concat",
+                  {"X": [per_cell(v, i).name
+                         for i, v in enumerate(var_parts)]},
+                  {"axis": 1}, out_shape=(cells, -1, 4))
+    boxes = _emit("reshape", {"X": [boxes3.name]}, {"shape": (-1, 4)},
                   out_shape=(-1, 4))
-    variances = _emit("concat", {"X": [v.name for v in var_parts]},
-                      {"axis": 0}, out_shape=(-1, 4))
+    variances = _emit("reshape", {"X": [vars3.name]}, {"shape": (-1, 4)},
+                      out_shape=(-1, 4))
     return LayerOutput(boxes, outputs={"variances": variances})
 
 

@@ -1,0 +1,61 @@
+"""Worker script for the host-death test: 2 workers join one DP job and run
+real collective steps; mid-run, rank 1 SIGKILLs itself (simulated machine
+loss). Rank 0 then idles in the input-wait part of its loop; the launcher
+must detect the death, SIGTERM rank 0, whose multihost teardown handler
+writes the `clean-exit-<rank>` marker (standing in for a final checkpoint)
+before exiting with TEARDOWN_EXIT_CODE."""
+
+import os
+import signal
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from paddle_tpu.parallel import multihost
+
+
+def main():
+    info = multihost.initialize()
+    rank = info["process_index"]
+    out_dir = os.environ["DEATH_TEST_DIR"]
+
+    def write_marker():
+        with open(os.path.join(out_dir, f"clean-exit-{rank}"), "w") as f:
+            f.write("checkpointed\n")
+
+    multihost.on_job_teardown(write_marker)
+
+    mesh = multihost.global_mesh(data=info["global_devices"])
+    # a few REAL coupled steps while both workers are alive
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @jax.jit
+    def global_sum(x):
+        return x.sum()
+
+    for step in range(3):
+        local = np.full((info["local_devices"], 4), rank + 1, np.float32)
+        gx = multihost.make_global_array(
+            local, mesh) if info["process_count"] > 1 else jax.device_put(
+                local, NamedSharding(mesh, P("data")))
+        assert float(global_sum(gx)) > 0
+
+    if rank == 1:
+        os.kill(os.getpid(), signal.SIGKILL)   # the machine "loses power"
+
+    # survivor: waiting for the next input chunk (the master-service data
+    # plane); the launcher's SIGTERM must interrupt this cleanly
+    for _ in range(600):
+        time.sleep(0.1)
+    print("survivor was never torn down", flush=True)
+    sys.exit(5)
+
+
+if __name__ == "__main__":
+    main()

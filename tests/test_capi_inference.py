@@ -139,34 +139,40 @@ def test_capi_small_buffer_reports_size(tmp_path):
     lib.pti_destroy(h)
 
 
-def test_c_example_program_standalone(tmp_path):
-    """capi/examples/model_inference/dense analog: a REAL C program compiled
-    with gcc, linked against the capi .so, run as its own process (its own
-    embedded-CPython init — ensure_python's cold path), output compared to
-    the in-process executor."""
-    import subprocess
-
+def _build_and_run_c_example(tmp_path, name, argv, extra_cc=()):
+    """Compile native/examples/<name>.c against the capi .so and run it as
+    its own process (its own embedded-CPython init — ensure_python's cold
+    path). Skips when the toolchain or library is missing."""
     import shutil
+    import subprocess
 
     _load()   # skip if lib not built
     if shutil.which("gcc") is None:
         pytest.skip("no C toolchain")
-    d, _, _ = _export_model(tmp_path)
-    src = os.path.join(REPO, "native", "examples", "infer_dense.c")
-    exe = str(tmp_path / "infer_dense")
+    src = os.path.join(REPO, "native", "examples", name + ".c")
+    exe = str(tmp_path / name)
     lib_dir = os.path.join(REPO, "native")
     cc = subprocess.run(
-        ["gcc", src, "-o", exe, "-L" + lib_dir, "-lpaddle_tpu_capi"],
+        ["gcc", src, "-o", exe, *extra_cc, "-L" + lib_dir,
+         "-lpaddle_tpu_capi"],
         capture_output=True, text=True)
     assert cc.returncode == 0, cc.stderr
-
-    n, dim = 3, 4
     env = dict(os.environ)
     env["LD_LIBRARY_PATH"] = lib_dir + ":" + env.get("LD_LIBRARY_PATH", "")
     env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
-    out = subprocess.run([exe, d, str(n), str(dim)], env=env, cwd=REPO,
-                         capture_output=True, text=True, timeout=300)
+    return subprocess.run([exe, *argv], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+
+
+def test_c_example_program_standalone(tmp_path):
+    """capi/examples/model_inference/dense analog: a REAL C program compiled
+    with gcc, linked against the capi .so, output compared to the in-process
+    executor."""
+    d, _, _ = _export_model(tmp_path)
+    n, dim = 3, 4
+    out = _build_and_run_c_example(tmp_path, "infer_dense",
+                                   [d, str(n), str(dim)])
     assert out.returncode == 0, out.stdout + out.stderr
     rows = [list(map(float, line.split()))
             for line in out.stdout.strip().splitlines()]
@@ -181,3 +187,59 @@ def test_c_example_program_standalone(tmp_path):
     x = (np.arange(n * dim) % 7).astype(np.float32) * 0.1 - 0.3
     ref = InferenceHost(d).run([x.reshape(n, dim)])
     np.testing.assert_allclose(np.asarray(rows), ref, rtol=5e-2, atol=5e-3)
+
+
+def _export_sequence_model(tmp_path, vocab=40, emb=8, max_len=6):
+    """Lengths-carrying text classifier: embedding -> masked average pool
+    (padding ids must NOT leak into the pool) -> fc. The lengths slot is the
+    second feed, as an i32 vector — the TPU-native LoD encoding."""
+    ids = fluid.layers.data("ids", shape=(max_len,), dtype="int32")
+    lens = fluid.layers.data("lens", shape=(), dtype="int32")
+    emb_out = fluid.layers.embedding(ids, size=(vocab, emb))
+    pooled = fluid.layers.sequence_pool(emb_out, lens, pool_type="average")
+    out = fluid.layers.fc(pooled, 3)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "seq_model")
+    fluid.io.export_inference_model(d, ["ids", "lens"], [out], exe)
+    return d
+
+
+def test_c_example_sequence(tmp_path):
+    """capi/examples/model_inference/sequence analog: ragged int32 sequences
+    with a true-lengths slot through the C ABI; results must match the
+    in-process executor on identical inputs (so the padded tail is provably
+    masked)."""
+    batch, max_len, vocab = 3, 6, 40
+    d = _export_sequence_model(tmp_path, vocab=vocab, max_len=max_len)
+    out = _build_and_run_c_example(tmp_path, "infer_sequence",
+                                   [d, str(batch), str(max_len), str(vocab)])
+    assert out.returncode == 0, out.stdout + out.stderr
+    rows = [list(map(float, line.split()))
+            for line in out.stdout.strip().splitlines()]
+    assert len(rows) == batch and len(rows[0]) == 3
+
+    # same deterministic inputs as the C program builds
+    ids = np.zeros((batch, max_len), np.int32)
+    lens = np.zeros((batch,), np.int32)
+    for b in range(batch):
+        n = max(1, max_len - b)
+        lens[b] = n
+        for t in range(n):
+            ids[b, t] = (b * 31 + t * 7) % vocab
+    from paddle_tpu.runtime.capi_host import InferenceHost
+    ref = InferenceHost(d).run([ids, lens])
+    # cross-backend tolerance: the C process runs on the default platform
+    np.testing.assert_allclose(np.asarray(rows), ref, rtol=5e-2, atol=5e-3)
+
+
+def test_c_example_multi_thread(tmp_path):
+    """capi/examples/model_inference/multi_thread analog: a REAL pthread C
+    program — 4 threads x 5 forwards on one shared handle must all bit-match
+    the single-threaded reference forward."""
+    d, _, _ = _export_model(tmp_path)
+    out = _build_and_run_c_example(
+        tmp_path, "infer_multi_thread", [d, "4", "5", "3", "4"],
+        extra_cc=("-pthread",))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.strip().splitlines()[-1] == "OK 4x5"

@@ -68,6 +68,22 @@ def test_cli_train_test_time_dump(config_file, tmp_path):
     assert d["blocks"][0]["ops"]
 
 
+def test_cli_train_local_master(config_file, tmp_path):
+    """One-binary bring-up (TrainerMain.cpp:32-49 --start_pserver analog):
+    one `train --local_master` process self-hosts the task-master RPC plane
+    and trains from it, multi-pass, same artifacts as a plain train."""
+    from paddle_tpu.runtime import native_available
+    if not native_available():
+        pytest.skip("native task master not built")
+    save = str(tmp_path / "out")
+    out = _run("train", "--config", config_file, "--num_passes", "2",
+               "--save_dir", save, "--local_master",
+               "--samples_per_chunk", "2")
+    assert "local master:" in out            # chunks really dispatched
+    assert "pass 1 done" in out              # second pass got data
+    assert os.path.exists(os.path.join(save, "pass-00001", "params.tar"))
+
+
 def test_export_load_inference_model(tmp_path):
     fluid.reset_default_programs()
     fluid.executor._global_scope = fluid.Scope()

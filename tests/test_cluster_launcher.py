@@ -23,3 +23,25 @@ def test_cluster_train_propagates_failure(tmp_path):
     rc = cli_main(["cluster_train", str(bad), "--num_workers", "2",
                    "--devices_per_worker", "1", "--timeout", "60"])
     assert rc != 0
+
+
+def test_cluster_worker_death_reaps_job_cleanly(tmp_path, monkeypatch):
+    """Host-death behavior (doc/design/cluster_train/README.md
+    trainer-as-stateless-task-consumer): SIGKILL one worker mid-run; the
+    launcher must reap the job promptly (well inside --timeout) with a
+    nonzero rc, and the SURVIVOR must exit through the clean teardown path
+    (its on_job_teardown hook ran => checkpoint marker written) — not be
+    SIGKILLed. The dead worker, by construction, never reaches its hook."""
+    import time
+
+    script = os.path.join(REPO, "tests", "cluster_death_script.py")
+    monkeypatch.setenv("DEATH_TEST_DIR", str(tmp_path))
+    t0 = time.time()
+    rc = cli_main(["cluster_train", script, "--num_workers", "2",
+                   "--devices_per_worker", "1", "--timeout", "240",
+                   "--grace", "20"])
+    elapsed = time.time() - t0
+    assert rc != 0                      # the SIGKILLed worker's rc propagates
+    assert elapsed < 120, elapsed       # reaped on death, not on timeout
+    assert (tmp_path / "clean-exit-0").exists()      # survivor's hook ran
+    assert not (tmp_path / "clean-exit-1").exists()  # dead worker's did not

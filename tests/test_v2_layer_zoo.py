@@ -748,6 +748,38 @@ def test_detection_dsl_trio():
     assert np.asarray(sv2).shape == (B, C - 1, 5)
 
 
+def test_priorbox_multi_size_is_cell_major():
+    """priorbox_layer with multiple min_sizes must interleave priors
+    CELL-major (PriorBoxLayer.cpp: per cell, all sizes contiguous), matching
+    a conv head that emits priors-per-cell — not size-major concat."""
+    from paddle_tpu.ops.detection import prior_box as ref_prior_box
+    F, IMG = 3, 24
+    feat = L.data("feat", DT.dense_vector(F * F * 2))
+    img = L.data("img", DT.dense_vector(IMG * IMG * 3))
+    featm = L.identity(feat)
+    featm.var = fluid.layers.reshape(feat.var, (-1, F, F, 2))
+    imgm = L.identity(img)
+    imgm.var = fluid.layers.reshape(img.var, (-1, IMG, IMG, 3))
+    pb = L.priorbox_layer(featm, imgm, aspect_ratio=[2.0],
+                          variance=[0.1, 0.1, 0.2, 0.2],
+                          min_size=[6.0, 12.0], max_size=[12.0, 20.0])
+    exe = Executor()
+    exe.run(fluid.default_startup_program())
+    got, gotv = exe.run(
+        fluid.default_main_program(),
+        feed={"feat": RS.randn(1, F * F * 2).astype(np.float32),
+              "img": RS.randn(1, IMG * IMG * 3).astype(np.float32)},
+        fetch_list=[pb.var.name, pb.outputs["variances"].name])
+    # expected: per cell, size-6's 4 priors then size-12's 4 priors
+    parts = [np.asarray(ref_prior_box((F, F), (IMG, IMG), mn, mx,
+                                      aspect_ratios=(2.0,))[0])
+             for mn, mx in ((6.0, 12.0), (12.0, 20.0))]
+    per_cell = [p.reshape(F * F, -1, 4) for p in parts]
+    want = np.concatenate(per_cell, axis=1).reshape(-1, 4)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+    assert np.asarray(gotv).shape == want.shape
+
+
 def test_conv_projection_and_operator_in_mixed():
     """conv_projection (trainable filter) and conv_operator (dynamic,
     input-supplied filter) as mixed_layer components (ConvProjection.cpp /
