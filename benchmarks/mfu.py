@@ -68,6 +68,13 @@ def attach_mfu(result: dict, flops_per_step: Optional[float],
         result["gflops_per_step"] = round(flops_per_step / 1e9, 2)
         peak = peak_flops_per_sec()
         if peak:
-            result["mfu"] = round(flops_per_step / sec_per_step / peak, 4)
+            mfu = flops_per_step / sec_per_step / peak
+            if mfu > 1.0:
+                # physically impossible: the timing collapsed (window below
+                # the noise floor) — flag it rather than publish nonsense
+                result["mfu"] = None
+                result["timing_suspect"] = round(mfu, 2)
+            else:
+                result["mfu"] = round(mfu, 4)
             result["peak_tflops"] = round(peak / 1e12, 1)
     return result

@@ -102,24 +102,35 @@ def run(iters: int = 20, repeats: int = 2, batch: int = BATCH):
 def run_with_infeed(steps: int = 24, batch: int = BATCH):
     """images/sec INCLUDING host->HBM infeed, via the data/prefetch.py
     DoubleBuffer (the DataProvider.h:249 capability): a worker thread
-    converts numpy batches (bf16 on host — half the transfer bytes, and the
-    model computes in bf16 anyway) and device_puts them while the previous
-    step computes; dispatch is async so transfer and compute overlap.
+    device_puts batches while the previous step computes; dispatch is async
+    so transfer and compute overlap.
 
-    Reports the end-to-end rate and the overlap ratio vs the compute-only
-    number (1.0 == infeed fully hidden). On this rig the host->device link
-    is a remote tunnel, so the ratio is a lower bound on what a local host
-    achieves.
+    The feed is uint8 pixels normalized ON DEVICE (x/255 in bf16) — the
+    production image pipeline's wire format (JPEG decode yields uint8), and
+    4x fewer transfer bytes than f32. Reports the end-to-end rate, the
+    overlap ratio vs the compute-only number (1.0 == infeed fully hidden),
+    and the achieved host->device MB/s. On this rig the host->device link
+    is a remote tunnel (tens of MB/s), so the e2e number is a lower bound
+    on what a local host achieves — the MB/s line makes the link, not the
+    framework, visibly the binding constraint.
     """
     from paddle_tpu.data.prefetch import DoubleBuffer
 
     run_n, step_fn, params, state, b = build(batch)
-    step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def step_u8(params, state, x_u8, y):
+        # on-device normalize: uint8 -> bf16 in [0, 1]
+        x = x_u8.astype(jnp.bfloat16) * jnp.bfloat16(1.0 / 255.0)
+        return step_fn(params, state, x, y)
+
+    step = jax.jit(step_u8, donate_argnums=(0, 1))
 
     rs = np.random.RandomState(1)
-    host_batches = [(rs.rand(batch, IMAGE, IMAGE, 3).astype(np.float32),
+    host_batches = [(rs.randint(0, 256, (batch, IMAGE, IMAGE, 3),
+                                np.uint8),
                      rs.randint(0, CLASSES, (batch,)).astype(np.int32))
                     for _ in range(NBUF)]
+    batch_bytes = host_batches[0][0].nbytes + host_batches[0][1].nbytes
 
     total = steps + 4                       # warmup + pipeline depth; the
                                             # worker exits when exhausted
@@ -130,8 +141,7 @@ def run_with_infeed(steps: int = 24, batch: int = BATCH):
 
     def to_device(hb):
         x, y = hb
-        return (jax.device_put(jnp.asarray(x, jnp.bfloat16)),
-                jax.device_put(jnp.asarray(y)))
+        return jax.device_put(x), jax.device_put(y)
 
     db = iter(DoubleBuffer(gen, depth=2, transform=to_device))
     for _ in range(2):                      # warm: compile + fill pipeline
@@ -157,8 +167,10 @@ def run_with_infeed(steps: int = 24, batch: int = BATCH):
             "vs_baseline": None,
             "compute_only_images_per_sec": round(batch / compute, 2),
             "overlap_ratio": round(compute / e2e, 3),
-            "note": "DoubleBuffer host->HBM feed overlapped with compute; "
-                    "host link is a remote tunnel (deployment lower bound)"}
+            "infeed_mb_per_sec": round(batch_bytes / e2e / 1e6, 1),
+            "note": "DoubleBuffer uint8 host->HBM feed (on-device "
+                    "normalize) overlapped with compute; host link is a "
+                    "remote tunnel (deployment lower bound)"}
 
 
 if __name__ == "__main__":

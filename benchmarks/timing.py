@@ -16,8 +16,16 @@ import time
 
 
 def chained_ms_per_step(run_n, args, iters: int, repeats: int,
-                        short: int = 1) -> float:
-    """ms per step via short/long on-device-loop differencing."""
+                        short: int = 1, min_window_s: float = 0.025,
+                        max_iters: int = 25000) -> float:
+    """ms per step via short/long on-device-loop differencing.
+
+    The long-short window must clear the dispatch/fetch noise floor (several
+    ms of RTT jitter under the remote-tunnel transport) or the difference can
+    collapse to ~0 for sub-ms steps and report nonsense; when the measured
+    window is below ``min_window_s`` the trip count grows (x4) and the row
+    re-measures, so fast models are timed over enough chained steps for the
+    per-step quotient to be trustworthy."""
 
     def timed(n):
         t0 = time.perf_counter()
@@ -26,8 +34,19 @@ def chained_ms_per_step(run_n, args, iters: int, repeats: int,
         float(loss)                     # force completion
         return time.perf_counter() - t0
 
-    timed(short)                        # compile both trip counts
-    timed(short + iters)
-    t_short = min(timed(short) for _ in range(repeats))
-    t_long = min(timed(short + iters) for _ in range(repeats))
-    return max(t_long - t_short, 1e-9) / iters * 1e3
+    # warm compile once: n is a traced scalar, so every trip count reuses
+    # the same executable
+    timed(short)
+    while True:
+        # short and long runs interleave within a round so slow drift in
+        # the dispatch/RTT floor cancels out of the difference; the floor's
+        # own jitter (measured as the short-run spread) sets how big the
+        # window must be before the quotient is trustworthy
+        shorts = [timed(short) for _ in range(max(repeats, 4))]
+        t_short = min(shorts)
+        noise = max(shorts) - t_short
+        t_long = min(timed(short + iters) for _ in range(repeats))
+        window = t_long - t_short
+        if window >= max(min_window_s, 6 * noise) or iters >= max_iters:
+            return max(window, 1e-9) / iters * 1e3
+        iters *= 4

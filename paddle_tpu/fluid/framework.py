@@ -44,10 +44,15 @@ class Variable:
                 f"{', persistable' if self.persistable else ''})")
 
     def to_dict(self):
-        return {"name": self.name, "shape": list(self.shape),
-                "dtype": self.dtype, "persistable": self.persistable,
-                "is_data": self.is_data, "lod_level": self.lod_level,
-                "trainable": self.trainable}
+        d = {"name": self.name, "shape": list(self.shape),
+             "dtype": self.dtype, "persistable": self.persistable,
+             "is_data": self.is_data, "lod_level": self.lod_level,
+             "trainable": self.trainable}
+        # per-parameter attrs (ParamAttr): only present when set
+        for k in ("lr_scale", "l2_rate"):
+            if getattr(self, k, None) is not None:
+                d[k] = getattr(self, k)
+        return d
 
 
 class Operator:
@@ -188,10 +193,14 @@ class Program:
         for bd in d["blocks"]:
             b = Block(p, bd["idx"], bd["parent_idx"])
             for vd in bd["vars"]:
-                b.vars[vd["name"]] = Variable(
+                v = Variable(
                     b, vd["name"], vd["shape"], vd["dtype"],
                     vd["persistable"], vd["is_data"], vd.get("lod_level", 0),
                     vd.get("trainable", True))
+                for k in ("lr_scale", "l2_rate"):
+                    if k in vd:
+                        setattr(v, k, vd[k])
+                b.vars[vd["name"]] = v
             for od in bd["ops"]:
                 b.append_op(od["type"], od["inputs"], od["outputs"], od["attrs"])
             p.blocks.append(b)

@@ -33,16 +33,59 @@ def _block():
 
 def _create_parameter(name_hint: str, shape, dtype="float32",
                       init: Optional[I.Initializer] = None,
-                      trainable: bool = True) -> Variable:
+                      trainable: bool = True, attr=None) -> Variable:
+    """``attr`` carries ParamAttr-style per-parameter settings (the gen-1
+    ParameterAttribute, trainer_config_helpers/attrs.py:52): dict keys
+    ``name`` (exact name; a SECOND creation under the same name returns the
+    existing parameter — the reference's name-based weight sharing between
+    layers and between train/generate sub-models), ``init`` (overrides the
+    layer's default initializer), ``is_static`` (frozen: no grad/update),
+    ``lr_scale`` (per-param learning-rate multiplier) and ``l2_rate``
+    (per-param weight decay) — the latter two consumed by
+    fluid.optimizer.Optimizer.minimize."""
     main = default_main_program()
-    name = main.unique_name(name_hint)
+    attr = dict(attr) if attr else {}
+    exact = attr.get("name")
+    if exact is not None:
+        existing = main.global_block().vars.get(exact)
+        if existing is not None:
+            if tuple(existing.shape) != tuple(shape):
+                raise ValueError(
+                    f"shared parameter {exact!r} shape mismatch: existing "
+                    f"{existing.shape} vs requested {tuple(shape)}")
+            if existing.dtype != np.dtype(dtype).name:
+                raise ValueError(
+                    f"shared parameter {exact!r} dtype mismatch: existing "
+                    f"{existing.dtype} vs requested {dtype}")
+            # behavioral attrs belong to the FIRST creation; a conflicting
+            # re-declaration must fail loudly, not be silently dropped
+            for key, current in (
+                    ("is_static", not existing.trainable),
+                    ("lr_scale", getattr(existing, "lr_scale", None)),
+                    ("l2_rate", getattr(existing, "l2_rate", None))):
+                if key in attr and attr[key] != current:
+                    raise ValueError(
+                        f"shared parameter {exact!r}: conflicting {key!r} "
+                        f"({attr[key]!r} vs the creating layer's "
+                        f"{current!r}); set attrs on the FIRST use only")
+            return existing
+        name = exact
+    else:
+        name = main.unique_name(name_hint)
+    if attr.get("is_static"):
+        trainable = False
     v = main.global_block().create_var(name=name, shape=shape, dtype=dtype,
                                        persistable=True, trainable=trainable)
+    if attr.get("lr_scale") is not None:
+        v.lr_scale = float(attr["lr_scale"])
+    if attr.get("l2_rate") is not None:
+        v.l2_rate = float(attr["l2_rate"])
     sb = default_startup_program().global_block()
     sv = sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True)
     sb.append_op("fill_init", inputs={}, outputs={"Out": [name]},
                  attrs={"shape": tuple(shape), "dtype": dtype,
-                        "init": init or I.gen1_default(), "seed": _next_seed()})
+                        "init": attr.get("init") or init or I.gen1_default(),
+                        "seed": _next_seed()})
     return v
 
 
@@ -54,17 +97,20 @@ def data(name: str, shape: Sequence[int], dtype="float32",
 
 
 def fc(input: Variable, size: int, act: Optional[str] = None,
-       bias_attr: bool = True, param_init=None) -> Variable:
+       bias_attr: bool = True, param_init=None, param_attr=None,
+       bias_param_attr=None) -> Variable:
     # reference fc semantics (num_flatten_dims=1): everything after the batch
     # dim is flattened into the contraction, weight is [prod(rest), size]
     b = _block()
     in_dim = int(np.prod(input.shape[1:]))
-    w = _create_parameter("fc_w", (in_dim, size), input.dtype, param_init)
+    w = _create_parameter("fc_w", (in_dim, size), input.dtype, param_init,
+                          attr=param_attr)
     out = b.create_var(shape=(input.shape[0], size), dtype=input.dtype)
     b.append_op("mul", {"X": [input.name], "Y": [w.name]},
                 {"Out": [out.name]}, {"x_num_col_dims": 1})
     if bias_attr:
-        bias = _create_parameter("fc_b", (size,), input.dtype, I.zeros)
+        bias = _create_parameter("fc_b", (size,), input.dtype, I.zeros,
+                                 attr=bias_param_attr)
         out2 = b.create_var(shape=out.shape, dtype=out.dtype)
         b.append_op("elementwise_add", {"X": [out.name], "Y": [bias.name]},
                     {"Out": [out2.name]})
@@ -74,10 +120,11 @@ def fc(input: Variable, size: int, act: Optional[str] = None,
     return out
 
 
-def embedding(input: Variable, size: Sequence[int], param_init=None) -> Variable:
+def embedding(input: Variable, size: Sequence[int], param_init=None,
+              param_attr=None) -> Variable:
     b = _block()
     w = _create_parameter("embedding_w", tuple(size), "float32",
-                          param_init or I.normal(0.0, 0.01))
+                          param_init or I.normal(0.0, 0.01), attr=param_attr)
     out = b.create_var(shape=input.shape + (size[1],), dtype="float32")
     b.append_op("lookup_table", {"W": [w.name], "Ids": [input.name]},
                 {"Out": [out.name]})

@@ -66,10 +66,41 @@ class Optimizer:
         pg = append_backward(loss, program=program)
         if regularization is not None:
             from .regularizer import append_regularization_ops
-            pg = append_regularization_ops(pg, regularization, program)
+            # a per-param l2_rate (ParamAttr) REPLACES the global default
+            # for that parameter (ParameterAttribute semantics), so exclude
+            # those pairs from the global pass
+            rest = [(p, g) for p, g in pg
+                    if getattr(p, "l2_rate", None) is None]
+            decayed = dict(
+                (p.name, (p, g))
+                for p, g in append_regularization_ops(rest, regularization,
+                                                      program))
+            pg = [(p, g) if getattr(p, "l2_rate", None) is not None
+                  else decayed[p.name] for p, g in pg]
         lr = self._ensure_lr(program)
+        blk = program.global_block()
         for param, grad in pg:
-            self._append_update(program, param, grad, lr)
+            # per-parameter ParamAttr settings (ParameterAttribute
+            # l2_rate/learning_rate, parameter/ParameterOptimizer semantics):
+            # decay folds into the grad; lr scaling produces a scaled lr
+            # variable so the rule is exact for adaptive optimizers too
+            l2 = getattr(param, "l2_rate", None)
+            if l2:
+                decay = blk.create_var(shape=param.shape, dtype=param.dtype)
+                blk.append_op("scale", {"X": [param.name]},
+                              {"Out": [decay.name]}, {"scale": l2})
+                g2 = blk.create_var(shape=grad.shape, dtype=grad.dtype)
+                blk.append_op("elementwise_add",
+                              {"X": [grad.name], "Y": [decay.name]},
+                              {"Out": [g2.name]})
+                grad = g2
+            scale = getattr(param, "lr_scale", None)
+            lr_eff = lr
+            if scale is not None and scale != 1.0:
+                lr_eff = blk.create_var(shape=lr.shape, dtype=lr.dtype)
+                blk.append_op("scale", {"X": [lr.name]},
+                              {"Out": [lr_eff.name]}, {"scale": scale})
+            self._append_update(program, param, grad, lr_eff)
         return pg
 
 

@@ -11,7 +11,7 @@ Program, and ``v2.trainer.SGD`` drives the fluid Executor.
 
 from .. import data as _data
 from ..trainer import event
-from . import data_type, evaluator, layer, networks, optimizer
+from . import attr, data_type, evaluator, layer, networks, optimizer
 from .inference import infer
 from .parameters import Parameters
 from .trainer import SGD
@@ -29,5 +29,5 @@ def init(**kwargs):
 
 
 __all__ = ["init", "layer", "networks", "data_type", "optimizer", "event",
-           "evaluator",
+           "evaluator", "attr",
            "batch", "reader", "SGD", "Parameters", "infer"]

@@ -87,26 +87,50 @@ def _sparse_weighted_sum(ids_var, vals_var, table, size):
     return out
 
 
+def _attr_dict(a):
+    """ParamAttr -> fluid attr dict (None/bool pass through as None)."""
+    if a is None or isinstance(a, bool):
+        return None
+    return a.to_fluid() if hasattr(a, "to_fluid") else dict(a)
+
+
 def fc(input, size: int, act: Optional[str] = None,
-       bias_attr: bool = True, name: Optional[str] = None) -> LayerOutput:
+       bias_attr: bool = True, name: Optional[str] = None,
+       param_attr=None, layer_attr=None) -> LayerOutput:
     """Accepts a single layer or a list (concatenated, like the reference's
     multi-input fc). Sparse inputs (sparse_binary/float_vector data layers)
     take the weighted-row-sum path — the reference's sparse fc
     (quick_start LR config). ``name`` registers the output for memory()
-    binding inside a recurrent_group/beam_search step."""
+    binding inside a recurrent_group/beam_search step. ``param_attr`` is a
+    :class:`paddle.attr.ParamAttr` (name-based sharing, init, is_static,
+    per-param lr/l2); ``bias_attr`` may be bool or a ParamAttr;
+    ``layer_attr`` an ExtraAttr whose drop_rate appends dropout."""
     inputs = list(input) if isinstance(input, (list, tuple)) else [input]
     sparse = [i for i in inputs if i.values is not None]
     dense = [i for i in inputs if i.values is None]
+    # a NAMED ParamAttr names ONE weight matrix; with several weight-bearing
+    # parts (each sparse table + the dense block) a single name would force
+    # accidental sharing/shape clashes, so require one part (the reference
+    # takes a per-input attr list; pass attrs per separate fc there)
+    n_parts = len(sparse) + (1 if dense else 0)
+    if (n_parts > 1 and param_attr is not None
+            and not isinstance(param_attr, bool)
+            and _attr_dict(param_attr) and "name" in _attr_dict(param_attr)):
+        raise ValueError(
+            "fc with multiple weight-bearing inputs cannot take a single "
+            "named param_attr (it would share one matrix across parts with "
+            "different shapes); build per-input fc/mixed projections instead")
     parts = []
     for s in sparse:
         dim = s.input_type.slot.dim
         table = FL._create_parameter("sparse_fc_w", (dim, size), "float32",
-                                     I.xavier())
+                                     I.xavier(), attr=_attr_dict(param_attr))
         parts.append(_sparse_weighted_sum(s.var, s.values, table, size))
     if dense:
         var = (FL.concat([i.var for i in dense], axis=-1)
                if len(dense) > 1 else dense[0].var)
-        parts.append(FL.fc(var, size, act=None, bias_attr=False))
+        parts.append(FL.fc(var, size, act=None, bias_attr=False,
+                           param_attr=_attr_dict(param_attr)))
     b = default_main_program().current_block()
     acc = parts[0]
     if len(parts) > 1:
@@ -115,28 +139,33 @@ def fc(input, size: int, act: Optional[str] = None,
                     {"Out": [summed.name]}, {})
         acc = summed
     if bias_attr:
-        bias = FL._create_parameter("fc_b", (size,), "float32", I.zeros)
+        bias = FL._create_parameter("fc_b", (size,), "float32", I.zeros,
+                                    attr=_attr_dict(bias_attr))
         acc = FL.elementwise_add(acc, bias)
     if act:
         acc = FL.activation(acc, act)
+    if layer_attr is not None and getattr(layer_attr, "drop_rate", None):
+        acc = FL.dropout(acc, layer_attr.drop_rate)
     _register_named(name, acc)
     return LayerOutput(acc)
 
 
-def embedding(input: LayerOutput, size: int) -> LayerOutput:
+def embedding(input: LayerOutput, size: int, param_attr=None) -> LayerOutput:
     t = input.input_type
     if input.values is not None:
         # sparse input -> weighted-sum embedding [B, size] (bag-of-features)
         dim = t.slot.dim
         table = FL._create_parameter("embedding_w", (dim, size), "float32",
-                                     I.normal(0.0, 0.01))
+                                     I.normal(0.0, 0.01),
+                                     attr=_attr_dict(param_attr))
         out = _sparse_weighted_sum(input.var, input.values, table, size)
         return LayerOutput(out)
     if t is None or not t.vocab:
         raise ValueError("embedding needs a data layer typed "
                          "integer_value[_sequence](vocab_size) or a sparse "
                          "vector type")
-    out = FL.embedding(input.var, (t.vocab, size))
+    out = FL.embedding(input.var, (t.vocab, size),
+                       param_attr=_attr_dict(param_attr))
     return LayerOutput(out, input.lengths, input.input_type,
                        sub_lengths=input.sub_lengths)
 
@@ -434,7 +463,15 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
         raise ValueError("beam_search needs exactly one GeneratedInput")
     g = gens[0]
     if g.embedding_param is not None:
-        embed_w = g.embedding_param
+        # a fluid Variable shares directly; a ParamAttr/dict shares by name
+        # with a training-time table (the train-config/gen-config workflow)
+        if hasattr(g.embedding_param, "to_fluid") or isinstance(
+                g.embedding_param, dict):
+            embed_w = FL._create_parameter(
+                "gen_embed_w", (g.vocab_size, g.embedding_size), "float32",
+                I.normal(0.0, 0.01), attr=_attr_dict(g.embedding_param))
+        else:
+            embed_w = g.embedding_param
     else:
         embed_w = FL._create_parameter(
             "gen_embed_w", (g.vocab_size, g.embedding_size), "float32",

@@ -72,7 +72,8 @@ def vgg_16_network(input_image: LayerOutput, num_classes: int = 1000,
 
 def simple_attention(encoded_sequence: LayerOutput,
                      encoded_proj: LayerOutput,
-                     decoder_state: LayerOutput) -> LayerOutput:
+                     decoder_state: LayerOutput,
+                     name: Optional[str] = None) -> LayerOutput:
     """Bahdanau-style attention context (networks.py:654 simple_attention).
 
     For use inside a recurrent_group / beam_search step: ``encoded_sequence``
@@ -81,16 +82,23 @@ def simple_attention(encoded_sequence: LayerOutput,
     [B, H] context vector. The reference expands the decoder state over the
     sequence and runs sequence_softmax over the scores — identical math here,
     as fixed-shape masked ops.
+
+    ``name`` fixes the internal parameter names (``<name>_dp_w``,
+    ``<name>_v``) so a second call — e.g. the generation sub-model reusing a
+    training decoder's attention — shares the SAME weights, the reference's
+    name-based sharing in its networks.py helpers.
     """
     A = encoded_proj.var.shape[-1]
     # project decoder state to attention space: [B, A]
-    dp = FL.fc(decoder_state.var, A, bias_attr=False)
+    dp = FL.fc(decoder_state.var, A, bias_attr=False,
+               param_attr={"name": f"{name}_dp_w"} if name else None)
     dp3 = FL.reshape(dp, (-1, 1, A))
     summed = FL.elementwise_add(encoded_proj.var, dp3)     # broadcast over T
     e = FL.activation(summed, "tanh")
     # per-step score: contract the attention dim with a learned vector
     v = FL._create_parameter("att_v", (A, 1), "float32",
-                             I.uniform(-0.1, 0.1))
+                             I.uniform(-0.1, 0.1),
+                             attr={"name": f"{name}_v"} if name else None)
     scores3 = FL.matmul(e, v)                              # [B, T, 1]
     scores = FL.squeeze(scores3, -1)                       # [B, T]
     weights = FL.sequence_softmax(scores, encoded_sequence.lengths)
