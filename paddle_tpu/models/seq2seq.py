@@ -31,6 +31,10 @@ from ..ops import rnn as R
 class DecoderState(NamedTuple):
     h: jax.Array          # [B, H] GRU hidden
     enc: jax.Array        # [B, S, 2H] encoder states (static per sequence)
+    enc_proj: jax.Array   # [B, S, H] att_enc(enc), hoisted out of the decode
+    #                       loop (XLA does not LICM large ops across scan
+    #                       iterations; the v2 DSL passes the same thing as a
+    #                       StaticInput)
     enc_mask: jax.Array   # [B, S]
 
 
@@ -40,6 +44,7 @@ class AttentionSeq2Seq(nn.Module):
         super().__init__()
         H = hidden
         self.hidden = H
+        self.embed_dim = embed_dim
         self.src_embed = nn.Embedding(src_vocab, embed_dim)
         self.trg_embed = nn.Embedding(trg_vocab, embed_dim)
         # bidirectional GRU encoder
@@ -69,40 +74,67 @@ class AttentionSeq2Seq(nn.Module):
         enc = jnp.concatenate([hf, hb], axis=-1)                 # [B, S, 2H]
         h0 = self.init_fc(params["init_fc"], last_b)
         mask = sequence_mask(src.lengths, src.max_len)
-        return DecoderState(h0, enc, mask)
+        enc_proj = self.att_enc(params["att_enc"], enc)          # hoisted
+        return DecoderState(h0, enc, enc_proj, mask)
 
     # -- one decoder step (shared by train & beam search) -------------------
-    def attend(self, params, h, enc, enc_mask):
+    def attend(self, params, h, enc, enc_proj, enc_mask):
         score = jnp.einsum(
             "bsh,h->bs",
-            jnp.tanh(self.att_enc(params["att_enc"], enc)
-                     + self.att_dec(params["att_dec"], h)[:, None, :]),
+            jnp.tanh(enc_proj + self.att_dec(params["att_dec"], h)[:, None, :]),
             params["att_v"])
         score = jnp.where(enc_mask > 0, score, -1e30)
         alpha = jax.nn.softmax(score, axis=-1)
         return jnp.einsum("bs,bsh->bh", alpha, enc)              # context [B, 2H]
 
-    def decode_step(self, params, state: DecoderState, token_embed):
-        ctx = self.attend(params, state.h, state.enc, state.enc_mask)
-        inp = jnp.concatenate([token_embed, ctx], axis=-1)
-        xw = inp @ params["dec_w"]
+    def cell_step(self, params, state: DecoderState, token_embed,
+                  embed_proj=None):
+        """Advance the decoder GRU one token; no output projection.
+
+        dec_w splits into its embedding and context halves (identical math
+        to concat-then-matmul), so teacher forcing can feed a per-step slice
+        of the WHOLE-sequence embedding projection (one MXU pass) and only
+        the context half stays in the sequential loop.
+        """
+        ctx = self.attend(params, state.h, state.enc, state.enc_proj,
+                          state.enc_mask)
+        e_dim = self.embed_dim
+        if embed_proj is None:
+            embed_proj = token_embed @ params["dec_w"][:e_dim]
+        xw = embed_proj + ctx @ params["dec_w"][e_dim:]
         h = R.gru_cell(xw, state.h, params["dec_u"], params["dec_b"])
-        logits = self.out(params["out"], h)
-        return logits, DecoderState(h, state.enc, state.enc_mask)
+        return DecoderState(h, state.enc, state.enc_proj, state.enc_mask)
+
+    def decode_step(self, params, state: DecoderState, token_embed):
+        new_state = self.cell_step(params, state, token_embed)
+        logits = self.out(params["out"], new_state.h)
+        return logits, new_state
 
     # -- training ----------------------------------------------------------
     def __call__(self, params, src: SeqBatch, trg_in: SeqBatch, **kw):
-        """Teacher-forced logits [B, T, V]."""
+        """Teacher-forced logits [B, T, V].
+
+        TPU mapping: the scan carries ONLY the [B, H] hidden; the embedding
+        input projection for all T steps is one batched matmul before the
+        scan and the vocab output projection is one [B*T, H] x [H, V] matmul
+        after it — the big-matmul FLOPs never serialize through the
+        recurrence.
+        """
         state = self.encode(params, src)
         emb = self.trg_embed(params["trg_embed"], trg_in.data)   # [B, T, E]
+        E = emb.shape[-1]
+        embw = emb @ params["dec_w"][:E]                         # [B, T, 3H]
 
-        def step(h, e_t):
-            logits, new_state = self.decode_step(
-                params, DecoderState(h, state.enc, state.enc_mask), e_t)
-            return new_state.h, logits
+        def step(h, ew_t):
+            s = self.cell_step(
+                params,
+                DecoderState(h, state.enc, state.enc_proj, state.enc_mask),
+                token_embed=None, embed_proj=ew_t)
+            return s.h, s.h
 
-        _, logits = jax.lax.scan(step, state.h, jnp.swapaxes(emb, 0, 1))
-        return jnp.swapaxes(logits, 0, 1)
+        _, hs = jax.lax.scan(step, state.h, jnp.swapaxes(embw, 0, 1))
+        hs = jnp.swapaxes(hs, 0, 1)                              # [B, T, H]
+        return self.out(params["out"], hs)
 
     def loss(self, params, src: SeqBatch, trg_in: SeqBatch, trg_out: SeqBatch):
         logits = self(params, src, trg_in)
