@@ -33,6 +33,11 @@ from .framework import Block, Program, Variable
 from .registry import OpRegistry
 
 
+class _OpTraceError(RuntimeError):
+    """An op failed during Program tracing; the message names the op and
+    the chain leading to it (CustomStackTrace.h:51 crash-stack analog)."""
+
+
 class Scope:
     """Runtime variable store (scope.h analog); persistables live here across
     run() calls. Child scopes see parent vars."""
@@ -82,30 +87,50 @@ class TraceContext:
 
 
 def _trace_ops(ops, env: Dict[str, Any], ctx: TraceContext):
-    """Symbolically run an op list over env (name -> traced array)."""
-    for op in ops:
-        if op.type == "autodiff_grad":
-            _trace_autodiff(op, ops, env, ctx)
-            continue
-        if op.type == "while":
-            _trace_while(op, env, ctx)
-            continue
-        if op.type == "conditional_block":
-            _trace_cond(op, env, ctx)
-            continue
-        if op.type == "static_rnn":
-            _trace_static_rnn(op, env, ctx)
-            continue
-        if op.type == "beam_search_gen":
-            _trace_beam_search_gen(op, env, ctx)
-            continue
-        compute = OpRegistry.get(op.type)
-        ins = {k: [env[n] for n in vs] for k, vs in op.inputs.items()}
-        outs = compute(ins, op.attrs)
-        for k, names in op.outputs.items():
-            vals = outs[k]
-            for n, v in zip(names, vals):
-                env[n] = v
+    """Symbolically run an op list over env (name -> traced array).
+
+    A failing op re-raises with the op's position, type, and io names plus
+    the chain of ops leading up to it — the fluid-level analog of the
+    reference's crash-time layer-name stack (utils/CustomStackTrace.h:51),
+    without which a shape error deep in a traced Program is anonymous.
+    """
+    for idx, op in enumerate(ops):
+        try:
+            if op.type == "autodiff_grad":
+                _trace_autodiff(op, ops, env, ctx)
+                continue
+            if op.type == "while":
+                _trace_while(op, env, ctx)
+                continue
+            if op.type == "conditional_block":
+                _trace_cond(op, env, ctx)
+                continue
+            if op.type == "static_rnn":
+                _trace_static_rnn(op, env, ctx)
+                continue
+            if op.type == "beam_search_gen":
+                _trace_beam_search_gen(op, env, ctx)
+                continue
+            compute = OpRegistry.get(op.type)
+            ins = {k: [env[n] for n in vs] for k, vs in op.inputs.items()}
+            outs = compute(ins, op.attrs)
+            for k, names in op.outputs.items():
+                vals = outs[k]
+                for n, v in zip(names, vals):
+                    env[n] = v
+        except Exception as e:
+            if getattr(e, "_op_ctx", False):
+                raise          # innermost op already carries its context
+            chain = " -> ".join(o.type for o in ops[max(0, idx - 4):idx + 1])
+            msg = (f"op #{idx} {op.type!r} failed while tracing the Program "
+                   f"(inputs={op.inputs}, outputs={op.outputs}): "
+                   f"{type(e).__name__}: {e}\n  op chain: ...{chain}")
+            try:               # keep the original type so callers'
+                new = type(e)(msg)   # except/raises clauses still match
+            except Exception:
+                new = _OpTraceError(msg)
+            new._op_ctx = True
+            raise new from e
     return env
 
 

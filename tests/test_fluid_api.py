@@ -135,3 +135,29 @@ def test_chunk_evaluator_f1():
     exe.run(feed={"tags": t, "labels": t,
                   "lengths": np.array([6], np.int32)}, fetch_list=[])
     assert ev.eval(exe) == pytest.approx(1.0)
+
+
+def test_failing_op_names_itself_in_the_error():
+    """A crash deep in a traced Program must name the op and the chain
+    leading to it (utils/CustomStackTrace.h:51 layer-stack analog), and
+    keep the original exception type."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+
+    fluid.reset_default_programs()
+    x = fluid.layers.data("x", shape=(4,))
+    h = fluid.layers.fc(x, 8, act="relu")
+    y = fluid.layers.data("y", shape=(3,))
+    # concat with incompatible trailing dims fails inside the op compute
+    bad = fluid.layers.concat([h, y], axis=0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(Exception) as ei:
+        exe.run(fluid.default_main_program(),
+                feed={"x": np.zeros((2, 4), np.float32),
+                      "y": np.zeros((2, 3), np.float32)},
+                fetch_list=[bad])
+    msg = str(ei.value)
+    assert "'concat'" in msg and "op chain" in msg
