@@ -1,0 +1,51 @@
+"""LeNet on MNIST — the v1_api_demo/mnist (light_mnist.py) analog.
+
+Run:  python -m paddle_tpu train --config examples/mnist_lenet.py \
+          --num_passes 3 --save_dir /tmp/mnist_out [--local_master]
+
+Data: points at REAL idx files when ``PADDLE_TPU_MNIST_DIR`` holds
+train-images-idx3-ubyte.gz / train-labels-idx1-ubyte.gz (the parser path,
+data/parsers.py — the reference downloads these via dataset/common.py); in
+this offline sandbox it falls back to the synthetic mnist generator, and the
+checked-in 10-sample fixture demonstrates the real-bytes path in
+tests/test_data_parsers.py.
+"""
+
+import os
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.fluid import layers as FL
+from paddle_tpu.v2.layer import LayerOutput
+
+img = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+
+x = LayerOutput(FL.reshape(img.var, (-1, 28, 28, 1)))
+h = paddle.networks.simple_img_conv_pool(x, filter_size=5, num_filters=8,
+                                         pool_size=2)
+h = paddle.networks.simple_img_conv_pool(h, filter_size=5, num_filters=16,
+                                         pool_size=2)
+h = paddle.layer.fc(h, 64, act="relu")
+logits = paddle.layer.fc(h, 10)
+cost = paddle.layer.classification_cost(logits, label)
+
+optimizer = paddle.optimizer.Adam(1e-3)
+feeding = [img, label]
+outputs = [logits]
+
+
+def _readers():
+    d = os.environ.get("PADDLE_TPU_MNIST_DIR")
+    if d and os.path.exists(os.path.join(d, "train-images-idx3-ubyte.gz")):
+        from paddle_tpu.data.parsers import mnist_reader
+        return (mnist_reader(os.path.join(d, "train-images-idx3-ubyte.gz"),
+                             os.path.join(d, "train-labels-idx1-ubyte.gz")),
+                mnist_reader(os.path.join(d, "t10k-images-idx3-ubyte.gz"),
+                             os.path.join(d, "t10k-labels-idx1-ubyte.gz")))
+    from paddle_tpu.data.dataset import mnist
+    return mnist.train(2048), mnist.test(512)
+
+
+_train, _test = _readers()
+train_reader = paddle.batch(_train, 64)
+test_reader = paddle.batch(_test, 64)

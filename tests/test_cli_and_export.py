@@ -152,3 +152,14 @@ def test_export_keeps_lstm_fused_auto(tmp_path):
                   fetch_list=[out.var.name])[0]
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_mnist_lenet_example_config(tmp_path):
+    """examples/mnist_lenet.py (v1_api_demo/mnist analog) trains through
+    the CLI; with PADDLE_TPU_MNIST_DIR unset it uses the synthetic
+    fallback (the real-idx path is covered by test_data_parsers)."""
+    cfg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "mnist_lenet.py")
+    out = _run("train", "--config", cfg, "--num_passes", "1",
+               "--log_period", "16")
+    assert "pass 0 done" in out
