@@ -56,6 +56,7 @@ class Trainer:
                  outputs_fn: Optional[Callable] = None,
                  evaluators=None, output_dir: Optional[str] = None,
                  prefetch: int = 2, log_period: int = 0,
+                 param_stats_period: int = 0,
                  nan_guard: bool = True):
         self.loss_fn = loss_fn
         self.opt = optimizer
@@ -69,6 +70,12 @@ class Trainer:
         self.output_dir = output_dir
         self.prefetch = prefetch
         self.log_period = log_period
+        # --show_parameter_stats_period analog (TrainerInternal.cpp:80-87):
+        # 0 = off; falls back to the global flag when unset
+        if param_stats_period == 0:
+            from ..utils.flags import FLAGS
+            param_stats_period = FLAGS.show_parameter_stats_period
+        self.param_stats_period = param_stats_period
         self.nan_guard = nan_guard
         self.stats = StatSet()
         self.mesh = mesh
@@ -93,6 +100,17 @@ class Trainer:
         self._loss_jit = jax.jit(loss_fn)
 
     # ------------------------------------------------------------------ train
+    def _log_param_stats(self, params):
+        """Per-parameter magnitude dump — the --show_parameter_stats_period
+        observability of TrainerInternal.cpp:80-87,156 (value stats; grads
+        are not retained past the fused update step)."""
+        from ..nn.module import Module
+        for name, value in Module.named_parameters(jax.device_get(params)):
+            a = np.abs(np.asarray(value, np.float32))
+            log.info("param %-40s shape=%-16s absmax=%.4e absmean=%.4e",
+                     name, str(tuple(a.shape)), float(a.max(initial=0.0)),
+                     float(a.mean()) if a.size else 0.0)
+
     def train(self, reader: Callable[[], Iterable], params, *,
               num_passes: int = 1, event_handler: Optional[Callable] = None,
               feeder: Optional[Callable] = None,
@@ -151,6 +169,9 @@ class Trainer:
                         ev_result = self.evaluators.result()
                 if self.log_period and (batch_id + 1) % self.log_period == 0:
                     log.info("pass %d batch %d cost %.6f", pass_id, batch_id, cost_f)
+                if (self.param_stats_period and
+                        (batch_id + 1) % self.param_stats_period == 0):
+                    self._log_param_stats(params)
                 event_handler(EV.EndIteration(pass_id, batch_id, cost_f,
                                               ev_result))
             pass_result = (self.evaluators.result()

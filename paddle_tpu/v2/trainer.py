@@ -80,7 +80,27 @@ class SGD:
                            for e, o in zip(self.extra, outs[1:])}
                 event_handler(EV.EndIteration(pass_id, batch_id,
                                               float(outs[0]), None, metrics))
+                self._maybe_param_stats(batch_id)
             event_handler(EV.EndPass(pass_id))
+
+    def _maybe_param_stats(self, batch_id: int):
+        """--show_parameter_stats_period analog (TrainerInternal.cpp:80-87)
+        over the fluid scope, gated by the global flag
+        (PDTPU_SHOW_PARAMETER_STATS_PERIOD)."""
+        from ..utils.flags import FLAGS
+        from ..utils.logging import get_logger
+        period = FLAGS.show_parameter_stats_period
+        if not period or (batch_id + 1) % period:
+            return
+        log = get_logger("paddle_tpu.v2.trainer")
+        from ..fluid.framework import default_main_program
+        for p in default_main_program().global_block().all_parameters():
+            if not self.exe.scope.has(p.name):
+                continue
+            a = np.abs(np.asarray(self.exe.scope.get(p.name), np.float32))
+            log.info("param %-40s shape=%-16s absmax=%.4e absmean=%.4e",
+                     p.name, str(tuple(a.shape)), float(a.max(initial=0.0)),
+                     float(a.mean()) if a.size else 0.0)
 
     def test(self, reader, feeding: Optional[Sequence[LayerOutput]] = None):
         feeder = _V2Feeder(feeding) if feeding else None

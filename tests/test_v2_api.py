@@ -135,3 +135,38 @@ def test_sparse_input_trains_end_to_end():
     xf = paddle.layer.data("xf", paddle.data_type.sparse_float_vector(DIM))
     emb = paddle.layer.embedding(xf, 8)
     assert emb.var.shape[-1] == 8
+
+
+def test_v2_param_stats_flag(monkeypatch):
+    """PDTPU-flagged per-parameter stats dump through the v2 trainer
+    (--show_parameter_stats_period, TrainerInternal.cpp:80-87)."""
+    import logging
+
+    import numpy as np
+
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.data.dataset import uci_housing
+    from paddle_tpu.utils.flags import FLAGS
+
+    monkeypatch.setattr(FLAGS, "show_parameter_stats_period", 2)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(13))
+    y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+    cost = paddle.layer.square_error_cost(paddle.layer.fc(x, 1), y)
+    t = paddle.SGD(cost, paddle.optimizer.SGD(0.01))
+
+    records = []
+
+    class Grab(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    lg = logging.getLogger("paddle_tpu.v2.trainer")
+    h = Grab(level=logging.INFO)
+    lg.addHandler(h)
+    try:
+        t.train(paddle.batch(uci_housing.train(64), 16), num_passes=1,
+                feeding=[x, y])
+    finally:
+        lg.removeHandler(h)
+    lines = [m for m in records if m.startswith("param ")]
+    assert lines and any("absmax" in ln for ln in lines)
