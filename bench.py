@@ -51,6 +51,19 @@ def bench_mlp_fallback():
             "unit": "ms/batch", "vs_baseline": None}
 
 
+def _attempt(fn, tries: int = 2):
+    """Run a bench with one retry: the remote-tunnel transport occasionally
+    drops a compile RPC mid-flight, which must not cost the round a row."""
+    for t in range(tries):
+        try:
+            return fn()
+        except Exception:
+            traceback.print_exc()
+            if t + 1 < tries:
+                time.sleep(5)
+    return None
+
+
 def main():
     flagship_ok = False
     # secondary metrics first; the flagship (has a published baseline) last so
@@ -58,20 +71,22 @@ def main():
     try:
         from benchmarks.image_suite import ROWS, bench_row
         for model_key, bs, ref_ms in ROWS:
-            try:
-                print(json.dumps(bench_row(model_key, bs, ref_ms)),
-                      flush=True)
-            except Exception:
-                traceback.print_exc()
+            rec = _attempt(lambda: bench_row(model_key, bs, ref_ms))
+            if rec is not None:
+                print(json.dumps(rec), flush=True)
     except Exception:
         traceback.print_exc()
     for name in ("resnet50", "seq2seq_nmt", "fused_rnn", "lstm_textcls"):
         try:
             mod = __import__(f"benchmarks.{name}", fromlist=["run"])
-            print(json.dumps(mod.run()), flush=True)
+            rec = _attempt(mod.run)
+            if rec is not None:
+                print(json.dumps(rec), flush=True)
             if name == "resnet50":
-                print(json.dumps(mod.run_with_infeed()), flush=True)
-            if name == "lstm_textcls":
+                rec2 = _attempt(mod.run_with_infeed)
+                if rec2 is not None:
+                    print(json.dumps(rec2), flush=True)
+            if name == "lstm_textcls" and rec is not None:
                 flagship_ok = True
         except Exception:
             traceback.print_exc()
