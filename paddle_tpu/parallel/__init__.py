@@ -15,6 +15,10 @@ TPU-native SPMD design (SURVEY.md §2.5):
 * NCCL ops (operators/nccl_op.cc:19-148) -> :mod:`collectives` named XLA collectives.
 * (modern capability extension, no 2017 analog) :mod:`ring_attention` — sequence-dim
   sharding with blockwise attention over a ``seq`` mesh axis via ``ppermute``.
+* sparse/embedding parallel (SparseRowMatrix + remote sparse updates, §2.5)
+                                        -> :mod:`tensor_parallel` ShardedEmbedding, and its
+  modern extension :mod:`moe` — expert parallelism (top-k token-choice MoE,
+  experts + tokens sharded over an ``expert`` axis, all_to_all dispatch).
 """
 
 from .mesh import MeshSpec, make_mesh, local_mesh, mesh_axis_size
@@ -27,6 +31,7 @@ from .tensor_parallel import ColumnParallelLinear, RowParallelLinear, ShardedEmb
 from .ring_attention import (ring_attention, blockwise_attention,
                              ring_self_attention, ulysses_attention)
 from .pipeline import PipelineStage, pipeline_spmd
+from .moe import ExpertParallelMoE, init_moe_params, moe_ffn_dense
 from . import multihost
 
 __all__ = [
@@ -42,4 +47,5 @@ __all__ = [
     "ring_attention", "blockwise_attention", "ring_self_attention",
     "ulysses_attention",
     "PipelineStage", "pipeline_spmd", "multihost",
+    "ExpertParallelMoE", "init_moe_params", "moe_ffn_dense",
 ]
