@@ -1,0 +1,80 @@
+/* Sparse-binary-input inference from plain C — the
+ * capi/examples/model_inference/sparse_binary analog. Each row is a
+ * multi-hot feature set passed as a padded int32 index list plus an int32
+ * nnz-count slot; the exported model embeds the active features and
+ * row-sums them (the weighted-row-sum sparse-fc path, quick_start LR
+ * config) — the TPU-native encoding of the reference's sparse_binary_vector
+ * argument.
+ *
+ * Build: gcc infer_sparse_binary.c -o infer_sparse_binary -L../.. \
+ *            -lpaddle_tpu_capi
+ * Run:   ./infer_sparse_binary <model_dir> <batch> <max_nnz> <dim>
+ * Prints one line per row; exit 0 on success.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* pti_create(const char* model_dir);
+extern int pti_forward(void* h, const void** inputs, const long long* shapes,
+                       const int* ndims, const int* dtypes, int n_inputs,
+                       int fetch_index, float* out_buf, long long out_capacity,
+                       long long* out_shape, int* out_ndim);
+extern void pti_destroy(void* h);
+extern const char* pti_last_error(void);
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    fprintf(stderr, "usage: %s <model_dir> <batch> <max_nnz> <dim>\n",
+            argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  int batch = atoi(argv[2]);
+  int max_nnz = atoi(argv[3]);
+  int dim = atoi(argv[4]);
+
+  void* h = pti_create(model_dir);
+  if (!h) {
+    fprintf(stderr, "create failed: %s\n", pti_last_error());
+    return 1;
+  }
+
+  /* deterministic multi-hot rows: row b activates features
+   * (b*13 + j*5) % dim for j < nnz, nnz = max_nnz - (b % max_nnz). */
+  int* ids = calloc((size_t)batch * max_nnz, sizeof(int));
+  int* counts = malloc(sizeof(int) * batch);
+  for (int b = 0; b < batch; b++) {
+    int nnz = max_nnz - (b % max_nnz);
+    counts[b] = nnz;
+    for (int j = 0; j < nnz; j++)
+      ids[b * max_nnz + j] = (b * 13 + j * 5) % dim;
+  }
+
+  const void* inputs[2] = {ids, counts};
+  long long shapes[3] = {batch, max_nnz, batch};
+  int ndims[2] = {2, 1};
+  int dtypes[2] = {1, 1}; /* both i32 */
+  long long cap = 1 << 20;
+  float* out = malloc(sizeof(float) * cap);
+  long long out_shape[8];
+  int out_ndim = 0;
+
+  int rc = pti_forward(h, inputs, shapes, ndims, dtypes, 2, 0, out, cap,
+                       out_shape, &out_ndim);
+  if (rc < 0) {
+    fprintf(stderr, "forward failed (%d): %s\n", rc, pti_last_error());
+    return 1;
+  }
+  long long rows_n = out_ndim >= 1 ? out_shape[0] : 1;
+  long long cols = out_ndim >= 2 ? out_shape[1] : 1;
+  for (long long r = 0; r < rows_n; r++) {
+    for (long long c = 0; c < cols; c++)
+      printf("%s%.6f", c ? " " : "", out[r * cols + c]);
+    printf("\n");
+  }
+  free(ids);
+  free(counts);
+  free(out);
+  pti_destroy(h);
+  return 0;
+}

@@ -233,6 +233,47 @@ def test_c_example_sequence(tmp_path):
     np.testing.assert_allclose(np.asarray(rows), ref, rtol=5e-2, atol=5e-3)
 
 
+def _export_sparse_binary_model(tmp_path, dim=50, emb=6, max_nnz=5):
+    """Multi-hot classifier: active-feature ids + nnz counts -> embedded
+    row SUM (the weighted-row-sum sparse-fc path) -> fc."""
+    ids = fluid.layers.data("ids", shape=(max_nnz,), dtype="int32")
+    counts = fluid.layers.data("counts", shape=(), dtype="int32")
+    emb_out = fluid.layers.embedding(ids, size=(dim, emb))
+    summed = fluid.layers.sequence_pool(emb_out, counts, pool_type="sum")
+    out = fluid.layers.fc(summed, 2)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "sb_model")
+    fluid.io.export_inference_model(d, ["ids", "counts"], [out], exe)
+    return d
+
+
+def test_c_example_sparse_binary(tmp_path):
+    """capi/examples/model_inference/sparse_binary analog: multi-hot rows
+    as padded index lists + counts through the C ABI; results must match
+    the in-process executor (padding indices provably masked)."""
+    batch, max_nnz, dim = 4, 5, 50
+    d = _export_sparse_binary_model(tmp_path, dim=dim, max_nnz=max_nnz)
+    out = _build_and_run_c_example(
+        tmp_path, "infer_sparse_binary",
+        [d, str(batch), str(max_nnz), str(dim)])
+    assert out.returncode == 0, out.stdout + out.stderr
+    rows = [list(map(float, line.split()))
+            for line in out.stdout.strip().splitlines()]
+    assert len(rows) == batch and len(rows[0]) == 2
+
+    ids = np.zeros((batch, max_nnz), np.int32)
+    counts = np.zeros((batch,), np.int32)
+    for b in range(batch):
+        nnz = max_nnz - (b % max_nnz)
+        counts[b] = nnz
+        for j in range(nnz):
+            ids[b, j] = (b * 13 + j * 5) % dim
+    from paddle_tpu.runtime.capi_host import InferenceHost
+    ref = InferenceHost(d).run([ids, counts])
+    np.testing.assert_allclose(np.asarray(rows), ref, rtol=5e-2, atol=5e-3)
+
+
 def test_c_example_multi_thread(tmp_path):
     """capi/examples/model_inference/multi_thread analog: a REAL pthread C
     program — 4 threads x 5 forwards on one shared handle must all bit-match
