@@ -1,0 +1,54 @@
+"""Traffic-flow time-series regression — the v1_api_demo/traffic_prediction
+analog (LSTM over a sliding window of lane-sensor readings, regressing the
+next reading).
+
+Run: python -m paddle_tpu train --config examples/traffic_prediction.py \
+         --num_passes 5 --log_period 8
+
+The demo's corpus is synthesized here (offline sandbox): daily-periodic
+sensor curves plus noise, windowed into (history sequence, next value)
+pairs — the same shape the reference fed from its CSV.
+"""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+WINDOW = 24      # hours of history per sample
+SENSORS = 4      # readings per timestep
+
+seq = paddle.layer.data(
+    "seq", paddle.data_type.dense_vector_sequence(SENSORS))
+nxt = paddle.layer.data("next", paddle.data_type.dense_vector(SENSORS))
+
+lstm = paddle.networks.simple_lstm(seq, 32)
+last = paddle.layer.last_seq(lstm)
+pred = paddle.layer.fc(last, SENSORS)
+cost = paddle.layer.mse_cost(pred, nxt)
+
+optimizer = paddle.optimizer.Adam(5e-3)
+feeding = [seq, nxt]
+outputs = [pred]
+
+
+def _series(n_days=20, seed=0):
+    """Synthetic lane sensors: daily sinusoid + rush-hour bumps + noise."""
+    rs = np.random.RandomState(seed)
+    t = np.arange(n_days * 24)
+    base = np.stack([
+        0.5 + 0.4 * np.sin(2 * np.pi * (t - 6 - 2 * s) / 24.0)
+        + 0.2 * np.exp(-((t % 24 - 8) ** 2) / 4.0)       # morning rush
+        + 0.15 * np.exp(-((t % 24 - 18) ** 2) / 6.0)     # evening rush
+        for s in range(SENSORS)], axis=-1)
+    return (base + rs.randn(*base.shape) * 0.03).astype(np.float32)
+
+
+def _windows(series):
+    def reader():
+        for i in range(len(series) - WINDOW):
+            yield series[i:i + WINDOW], series[i + WINDOW]
+    return reader
+
+
+train_reader = paddle.batch(_windows(_series(20)), 32)
+test_reader = paddle.batch(_windows(_series(4, seed=9)), 32)

@@ -10,6 +10,7 @@ Program, and ``v2.trainer.SGD`` drives the fluid Executor.
 """
 
 from .. import data as _data
+from ..data import dataset
 from ..trainer import event
 from . import attr, data_type, evaluator, layer, networks, optimizer
 from .inference import infer
@@ -29,5 +30,5 @@ def init(**kwargs):
 
 
 __all__ = ["init", "layer", "networks", "data_type", "optimizer", "event",
-           "evaluator", "attr",
+           "evaluator", "attr", "dataset",
            "batch", "reader", "SGD", "Parameters", "infer"]

@@ -163,3 +163,13 @@ def test_mnist_lenet_example_config(tmp_path):
     out = _run("train", "--config", cfg, "--num_passes", "1",
                "--log_period", "16")
     assert "pass 0 done" in out
+
+
+def test_traffic_prediction_example_config(tmp_path):
+    """examples/traffic_prediction.py (v1_api_demo/traffic_prediction
+    analog): LSTM time-series regression trains through the CLI."""
+    cfg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "traffic_prediction.py")
+    out = _run("train", "--config", cfg, "--num_passes", "1",
+               "--log_period", "8")
+    assert "pass 0 done" in out
