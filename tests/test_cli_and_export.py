@@ -173,3 +173,12 @@ def test_traffic_prediction_example_config(tmp_path):
     out = _run("train", "--config", cfg, "--num_passes", "1",
                "--log_period", "8")
     assert "pass 0 done" in out
+
+
+def test_gan_vae_example_smoke():
+    """examples/gan_vae_mnist.py (v1_api_demo/{gan,vae} analog): both
+    demos train mechanically on short budgets."""
+    import importlib
+    mod = importlib.import_module("examples.gan_vae_mnist")
+    mod.train_gan(steps=40)
+    mod.train_vae(steps=150)
