@@ -182,3 +182,12 @@ def test_gan_vae_example_smoke():
     mod = importlib.import_module("examples.gan_vae_mnist")
     mod.train_gan(steps=40)
     mod.train_vae(steps=150)
+
+
+def test_model_zoo_features_example():
+    """examples/model_zoo_features.py (v1_api_demo/model_zoo analog):
+    params-tar round trip into a fresh topology + multi-layer feature
+    fetch; consumer predictions match the publisher."""
+    import importlib
+    mod = importlib.import_module("examples.model_zoo_features")
+    mod.main()
