@@ -92,7 +92,7 @@ def run(iters: int = 20, repeats: int = 2, batch: int = BATCH):
     flops = step_flops(step_fn, params, state, b[0][0], b[1][0])
     # key carries train-mode-BN semantics (r1 measured inference-mode BN)
     return attach_mfu(
-        {"metric": "resnet50_train_images_per_sec_bs64_224_trainbn",
+        {"metric": f"resnet50_train_images_per_sec_bs{batch}_224_trainbn",
          "value": round(ips, 2), "unit": "images/sec",
          "vs_baseline": None,  # no published reference ResNet number
          "note": "train-mode BN with stat updates, 4 distinct rotating batches"},
@@ -162,7 +162,7 @@ def run_with_infeed(steps: int = 24, batch: int = BATCH):
               jnp.asarray(np.stack([hb[1] for hb in host_batches])))
     compute = chained_ms_per_step(run_n, (params, state) + staged, 12,
                                   2) / 1e3
-    return {"metric": "resnet50_train_images_per_sec_bs64_incl_infeed",
+    return {"metric": f"resnet50_train_images_per_sec_bs{batch}_incl_infeed",
             "value": round(batch / e2e, 2), "unit": "images/sec",
             "vs_baseline": None,
             "compute_only_images_per_sec": round(batch / compute, 2),
