@@ -18,8 +18,9 @@ from .feeder import (DataFeeder, DenseSlot, IndexSlot, SeqSlot, SparseSlot,
                      to_lod_batch)
 from .prefetch import DoubleBuffer
 from . import dataset, format, parsers
+from .provider import CacheType, provider
 
-__all__ = ["parsers", "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
+__all__ = ["parsers", "provider", "CacheType", "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
            "xmap_readers", "cache", "batch", "mix",
            "DataFeeder", "DenseSlot", "IndexSlot", "SeqSlot", "SparseSlot",
            "to_lod_batch", "DoubleBuffer", "dataset", "format"]

@@ -12,7 +12,8 @@ Program, and ``v2.trainer.SGD`` drives the fluid Executor.
 from .. import data as _data
 from ..data import dataset
 from ..trainer import event
-from . import attr, data_type, evaluator, layer, networks, optimizer
+from . import attr, data_type, evaluator, layer, networks, optimizer, topology
+from .topology import Topology
 from .inference import infer
 from .parameters import Parameters
 from .trainer import SGD
@@ -30,5 +31,5 @@ def init(**kwargs):
 
 
 __all__ = ["init", "layer", "networks", "data_type", "optimizer", "event",
-           "evaluator", "attr", "dataset",
+           "evaluator", "attr", "dataset", "topology", "Topology",
            "batch", "reader", "SGD", "Parameters", "infer"]

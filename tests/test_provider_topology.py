@@ -1,0 +1,119 @@
+"""@provider decorator (PyDataProvider2.py:55 protocol) and Topology
+(v2/topology.py:27) facades."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.data.provider import CacheType, provider
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    yield
+
+
+def test_provider_decorator_basic():
+    calls = []
+
+    def init_hook(settings, vocab=None):
+        settings.vocab = vocab
+        calls.append("init")
+
+    @provider(input_types=[paddle.data_type.dense_vector(3),
+                           paddle.data_type.integer_value(2)],
+              init_hook=init_hook, vocab=7)
+    def process(settings, src):
+        assert settings.vocab == 7
+        for i in range(4):
+            yield np.full((3,), float(i), np.float32), i % 2
+
+    reader = process("fileA")
+    assert calls == ["init"]                     # once, before rows
+    rows = list(reader())
+    assert len(rows) == 4 and rows[2][1] == 0
+    assert len(reader.settings.input_types) == 2
+
+
+def test_provider_multiple_sources_and_cache():
+    loads = []
+
+    @provider(cache=CacheType.CACHE_PASS_IN_MEM)
+    def process(settings, src):
+        loads.append(src)
+        for i in range(2):
+            yield (src, i)
+
+    reader = process("a", "b")
+    p1 = list(reader())
+    p2 = list(reader())                          # served from the cache
+    assert p1 == p2 == [("a", 0), ("a", 1), ("b", 0), ("b", 1)]
+    assert loads == ["a", "b"]                   # each source read ONCE
+
+
+def test_provider_shuffle_covers_all_rows():
+    @provider(should_shuffle=True)
+    def process(settings, src):
+        yield from range(20)
+
+    rows = list(process()())
+    assert sorted(rows) == list(range(20))
+
+
+def test_provider_feeds_trainer():
+    """The ported-provider workflow end to end: decorated generator ->
+    reader creator -> batch -> SGD.train."""
+    @provider(input_types=[paddle.data_type.dense_vector(4),
+                           paddle.data_type.dense_vector(1)])
+    def process(settings, src):
+        rs = np.random.RandomState(0)
+        for _ in range(64):
+            x = rs.randn(4).astype(np.float32)
+            yield x, np.array([x.sum()], np.float32)
+
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+    cost = paddle.layer.square_error_cost(paddle.layer.fc(x, 1), y)
+    t = paddle.SGD(cost, paddle.optimizer.SGD(0.1))
+    costs = []
+    t.train(paddle.batch(process("train.txt"), 16), num_passes=3,
+            feeding=[x, y],
+            event_handler=lambda e: costs.append(e.cost)
+            if hasattr(e, "cost") else None)
+    assert costs[-1] < costs[0]
+
+
+def test_topology_proto_and_data_type():
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(3))
+    logits = paddle.layer.fc(x, 3)
+    cost = paddle.layer.classification_cost(logits, y)
+    topo = paddle.Topology(cost)
+    d = topo.proto()
+    assert d["blocks"][0]["ops"]
+    names = [n for n, _ in topo.data_type()]
+    assert "x" in names and "y" in names
+    assert topo.get_layer_proto("x")["is_data"]
+    assert topo.get_layer_proto("no_such") is None
+    # round trip through the serialized form
+    clone = fluid.Program.from_dict(__import__("json").loads(topo.serialize()))
+    assert [o.type for o in clone.global_block().ops] == \
+           [o.type for o in topo.program.global_block().ops]
+
+
+def test_topology_serialize_for_inference_prunes_cost():
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(3))
+    logits = paddle.layer.fc(x, 3)
+    cost = paddle.layer.classification_cost(logits, y)
+    topo = paddle.Topology(cost)
+    d = topo.serialize_for_inference([logits])
+    types = [op["type"] for blk in d["blocks"] for op in blk["ops"]]
+    assert "cross_entropy" not in types and "mul" in types
+
+
+def test_topology_rejects_non_layer():
+    with pytest.raises(ValueError, match="LayerOutput"):
+        paddle.Topology(42)
