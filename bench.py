@@ -76,6 +76,15 @@ def main():
                 print(json.dumps(rec), flush=True)
     except Exception:
         traceback.print_exc()
+    try:
+        from benchmarks.lstm_textcls import SUITE_ROWS
+        from benchmarks.lstm_textcls import bench_row as lstm_row
+        for bs, hidden, ref_ms in SUITE_ROWS:
+            rec = _attempt(lambda: lstm_row(bs, hidden, ref_ms))
+            if rec is not None:
+                print(json.dumps(rec), flush=True)
+    except Exception:
+        traceback.print_exc()
     for name in ("resnet50", "seq2seq_nmt", "fused_rnn", "lstm_textcls"):
         try:
             mod = __import__(f"benchmarks.{name}", fromlist=["run"])

@@ -35,7 +35,7 @@ NBUF = 8          # distinct staged batches rotated through the loop
 BASELINE_MS = 83.0
 
 
-def build():
+def build(batch_size: int = BATCH, hidden: int = HIDDEN):
     from paddle_tpu.core import SeqBatch
     from paddle_tpu.models import LSTMTextCls
     from paddle_tpu.optimizer import Adam
@@ -53,7 +53,7 @@ def build():
                               params[f"u{i}"], params[f"b{i}"], forget_bias=1.0)
             return self.fc(params["fc"], S.sequence_last_step(h, batch.lengths))
 
-    model = LastSeqLSTM(VOCAB, embed_dim=EMBED, hidden=HIDDEN, classes=2)
+    model = LastSeqLSTM(VOCAB, embed_dim=EMBED, hidden=hidden, classes=2)
     params = model.init(jax.random.PRNGKey(0))
     opt = Adam(2e-3)
     state = opt.init(params)
@@ -91,10 +91,11 @@ def build():
         return jax.lax.fori_loop(0, n, body, (params, state, loss0))
 
     rs = np.random.RandomState(0)
-    data = jnp.asarray(rs.randint(0, VOCAB, (NBUF, BATCH, SEQ_LEN)), jnp.int32)
-    lengths = jnp.asarray(rs.randint(MIN_LEN, SEQ_LEN + 1, (NBUF, BATCH)),
+    data = jnp.asarray(rs.randint(0, VOCAB, (NBUF, batch_size, SEQ_LEN)),
+                       jnp.int32)
+    lengths = jnp.asarray(rs.randint(MIN_LEN, SEQ_LEN + 1, (NBUF, batch_size)),
                           jnp.int32)
-    labels = jnp.asarray(rs.randint(0, 2, (NBUF, BATCH)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 2, (NBUF, batch_size)), jnp.int32)
     return run_n, step_fn, params, state, (data, lengths, labels)
 
 
@@ -121,6 +122,41 @@ def run(iters: int = 100, repeats: int = 3):
         flops, ms / 1e3)
 
 
+# every published LSTM row of benchmark/README.md:115-134 beyond the
+# flagship (bs, hidden) -> K40m ms/batch
+SUITE_ROWS = [
+    (64, 512, 184.0), (64, 1280, 641.0),
+    (128, 256, 110.0), (128, 512, 261.0), (128, 1280, 1007.0),
+    (256, 256, 170.0), (256, 512, 414.0), (256, 1280, 1655.0),
+]
+
+
+def bench_row(batch_size: int, hidden: int, ref_ms: float,
+              iters: int = 60, repeats: int = 2) -> dict:
+    from benchmarks.mfu import attach_mfu, step_flops
+    from benchmarks.timing import chained_ms_per_step
+
+    run_n, step_fn, params, state, b = build(batch_size, hidden)
+    ms = chained_ms_per_step(run_n, (params, state) + b, iters, repeats,
+                             short=2)
+    flops = step_flops(step_fn, params, state, b[0][0], b[1][0], b[2][0])
+    return attach_mfu(
+        {"metric": f"lstm_textcls_train_ms_per_batch_bs{batch_size}"
+                   f"_h{hidden}_len30-100",
+         "value": round(ms, 3), "unit": "ms/batch",
+         "vs_baseline": round(ref_ms / ms, 3),
+         "note": f"K40m {ref_ms} ms (benchmark/README.md:115-134); varied "
+                 "lengths 30..100, bf16 compute vs the K40m's f32"},
+        flops, ms / 1e3)
+
+
+def run_suite(rows=None):
+    for batch_size, hidden, ref_ms in (rows or SUITE_ROWS):
+        yield bench_row(batch_size, hidden, ref_ms)
+
+
 if __name__ == "__main__":
     import json
+    for rec in run_suite():
+        print(json.dumps(rec), flush=True)
     print(json.dumps(run()))
