@@ -85,7 +85,8 @@ def main():
                 print(json.dumps(rec), flush=True)
     except Exception:
         traceback.print_exc()
-    for name in ("resnet50", "seq2seq_nmt", "fused_rnn", "lstm_textcls"):
+    for name in ("transformer_lm", "resnet50", "seq2seq_nmt", "fused_rnn",
+                 "lstm_textcls"):
         try:
             mod = __import__(f"benchmarks.{name}", fromlist=["run"])
             rec = _attempt(mod.run)

@@ -7,6 +7,7 @@ from .image import (AlexNet, GoogleNet, LeNet, ResNet, SmallNet,
                     VGG, resnet50)
 from .mlp import MnistMLP
 from .seq2seq import AttentionSeq2Seq
+from .transformer import TransformerBlock, TransformerLM
 from .tagger import BiLSTMCRFTagger, LinearCRFTagger
 from .text_cls import BiLSTMTextCls, ConvTextCls, LSTMTextCls
 
@@ -14,4 +15,5 @@ __all__ = [
     "AlexNet", "GoogleNet", "MnistMLP", "LeNet", "SmallNet", "VGG", "ResNet", "resnet50",
            "LSTMTextCls", "BiLSTMTextCls", "ConvTextCls",
            "AttentionSeq2Seq", "LinearCRFTagger", "BiLSTMCRFTagger",
-           "Word2Vec", "Recommender", "DeepFM", "GAN", "VAE"]
+           "Word2Vec", "Recommender", "DeepFM", "GAN", "VAE",
+           "TransformerLM", "TransformerBlock"]

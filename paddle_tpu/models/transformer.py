@@ -1,0 +1,128 @@
+"""Decoder-only Transformer language model — the flash-attention kernels'
+model-level consumer.
+
+No 2017 analog in the reference (its deepest sequence model is the
+attention seq2seq, SURVEY §3.4); this is the modern-extension model family
+the repo's Pallas flash attention (ops/pallas_kernels.py — fwd + dq/dkv
+backward, no [T, T] matrix in HBM) and ring attention were built for.
+TPU-first choices: pre-LN blocks (stable in bf16), one fused qkv matmul per
+block, attention as [B, T, H, Dh] through the flash kernel (causal),
+whole-model bf16 compute with f32 master params handled by callers, and a
+``seq_mesh`` option that runs the same blocks with ring attention over a
+``seq`` axis for long-context sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.initializer import normal, zeros
+from ..ops import pallas_kernels as pk
+
+
+class TransformerBlock(nn.Module):
+    def __init__(self, d_model: int, n_heads: int, d_ff: int,
+                 init_std: float = 0.02):
+        super().__init__()
+        assert d_model % n_heads == 0
+        self.n_heads = n_heads
+        self.d_head = d_model // n_heads
+        self.ln1 = nn.LayerNorm(d_model)
+        self.qkv = nn.Linear(d_model, 3 * d_model,
+                             w_init=normal(0.0, init_std))
+        self.proj = nn.Linear(d_model, d_model, w_init=normal(0.0, init_std))
+        self.ln2 = nn.LayerNorm(d_model)
+        self.mlp_in = nn.Linear(d_model, d_ff, act="gelu",
+                                w_init=normal(0.0, init_std))
+        self.mlp_out = nn.Linear(d_ff, d_model, w_init=normal(0.0, init_std))
+
+    def attend(self, q, k, v, *, seq_axis: Optional[str] = None):
+        if seq_axis is not None:
+            from ..parallel.ring_attention import ring_attention
+            return ring_attention(q, k, v, seq_axis, True)
+        return pk.flash_attention(q, k, v, causal=True)
+
+    def __call__(self, params, x, *, seq_axis: Optional[str] = None, **kw):
+        B, T, D = x.shape
+        h = self.ln1(params["ln1"], x)
+        qkv = self.qkv(params["qkv"], h)                 # [B, T, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, self.n_heads, self.d_head)
+        o = self.attend(q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                        seq_axis=seq_axis)
+        x = x + self.proj(params["proj"], o.reshape(B, T, D).astype(x.dtype))
+        h = self.ln2(params["ln2"], x)
+        return x + self.mlp_out(params["mlp_out"],
+                                self.mlp_in(params["mlp_in"], h))
+
+
+class TransformerLM(nn.Module):
+    """GPT-style LM: token + learned position embeddings, N pre-LN blocks,
+    final LN, head tied to the token embedding (weight sharing)."""
+
+    def __init__(self, vocab: int, d_model: int = 512, n_heads: int = 8,
+                 n_layers: int = 6, d_ff: Optional[int] = None,
+                 max_len: int = 1024, tie_head: bool = True):
+        super().__init__()
+        d_ff = d_ff or 4 * d_model
+        self.vocab, self.max_len, self.tie_head = vocab, max_len, tie_head
+        self.embed = nn.Embedding(vocab, d_model, w_init=normal(0.0, 0.02))
+        self.param("pos_embed", (max_len, d_model), normal(0.0, 0.01))
+        self.blocks = [TransformerBlock(d_model, n_heads, d_ff)
+                       for _ in range(n_layers)]
+        self.ln_f = nn.LayerNorm(d_model)
+        if not tie_head:
+            self.head = nn.Linear(d_model, vocab, bias=False,
+                                  w_init=normal(0.0, 0.02))
+
+    def __call__(self, params, ids, *, positions=None,
+                 seq_axis: Optional[str] = None, **kw):
+        """ids [B, T] -> logits [B, T, V].
+
+        ``positions`` ([T] or [B, T]) overrides the default 0..T-1 — needed
+        under sequence sharding, where each shard's local block starts at a
+        non-zero global position.
+        """
+        B, T = ids.shape
+        x = self.embed(params["embed"], ids)
+        pos = (params["pos_embed"][:T] if positions is None
+               else params["pos_embed"][positions])
+        x = x + pos.astype(x.dtype)
+        for i in range(len(self.blocks)):
+            x = self.blocks[i](params[f"blocks_{i}"], x, seq_axis=seq_axis)
+        x = self.ln_f(params["ln_f"], x)
+        if self.tie_head:
+            return x @ params["embed"]["w"].T.astype(x.dtype)
+        return self.head(params["head"], x)
+
+    def loss(self, params, ids, lengths=None, *,
+             seq_axis: Optional[str] = None):
+        """Next-token CE over positions < length-1 (true-token masking)."""
+        logits = self(params, ids[:, :-1], seq_axis=seq_axis)
+        targets = ids[:, 1:]
+        # lse - gold == -log_softmax[gold], without materializing the full
+        # [B, T, V] log-prob tensor in f32 (the reductions fuse instead)
+        l32 = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(l32, axis=-1)
+        gold = jnp.take_along_axis(l32, targets[..., None], -1)[..., 0]
+        nll = lse - gold
+        if lengths is None:
+            return nll.mean()
+        T = targets.shape[1]
+        mask = (jnp.arange(T)[None, :] < (lengths - 1)[:, None]
+                ).astype(nll.dtype)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def generate_greedy(self, params, prompt, steps: int):
+        """Greedy continuation: prompt [B, T0] -> [B, T0+steps] (full
+        re-forward per step: correctness reference, not the serving path)."""
+        ids = prompt
+        for _ in range(steps):
+            logits = self(params, ids[:, -self.max_len:])
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        return ids
