@@ -314,9 +314,20 @@ def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 # public API
 # ---------------------------------------------------------------------------
 
+def _default_blocks(block_q: Optional[int],
+                    block_k: Optional[int]) -> Tuple[int, int]:
+    """Measured on v5e (GPT-2-small shapes, fwd+bwd): 128x128 tiles spend
+    ~5x the kernel's time on per-program overhead; 512/1024 sits within 10%
+    of the best sweep point while keeping the dq/dkv working sets well
+    inside the 16MB VMEM budget. _blocks() still clamps to the actual
+    sequence lengths, so short sequences are unaffected."""
+    return block_q or 512, block_k or 1024
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused attention. q/k/v: [B, T, H, D] -> [B, T, H, D].
 
@@ -327,6 +338,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     D = q.shape[-1]
     scale_v = scale if scale is not None else D ** -0.5
+    block_q, block_k = _default_blocks(block_q, block_k)
     if interpret is None:
         interpret = not _on_tpu()
     return _flash(q, k, v, causal, scale_v, block_q, block_k, bool(interpret))
@@ -335,7 +347,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
                              causal: bool = False,
                              scale: Optional[float] = None,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
                              interpret: Optional[bool] = None):
     """Forward-only attention returning ``(o, lse)`` with lse: [B, T, H] f32.
 
@@ -346,6 +359,7 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     D = q.shape[-1]
     scale_v = scale if scale is not None else D ** -0.5
+    block_q, block_k = _default_blocks(block_q, block_k)
     if interpret is None:
         interpret = not _on_tpu()
     return _fa_fwd_call(q, k, v, causal, scale_v, block_q, block_k,
@@ -353,8 +367,10 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 
 def flash_block_grads(q, k, v, o, lse, do, *, causal: bool = False,
-                      scale: Optional[float] = None, block_q: int = 128,
-                      block_k: int = 128, interpret: Optional[bool] = None,
+                      scale: Optional[float] = None,
+                      block_q: Optional[int] = None,
+                      block_k: Optional[int] = None,
+                      interpret: Optional[bool] = None,
                       delta=None):
     """Raw (dq, dk, dv) for one attention block given saved (o, lse).
 
@@ -365,6 +381,7 @@ def flash_block_grads(q, k, v, o, lse, do, *, causal: bool = False,
     """
     D = q.shape[-1]
     scale_v = scale if scale is not None else D ** -0.5
+    block_q, block_k = _default_blocks(block_q, block_k)
     if interpret is None:
         interpret = not _on_tpu()
     return _fa_bwd_call(q, k, v, o, lse, do, causal, scale_v, block_q,
