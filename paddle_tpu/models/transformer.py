@@ -66,10 +66,15 @@ class TransformerLM(nn.Module):
 
     def __init__(self, vocab: int, d_model: int = 512, n_heads: int = 8,
                  n_layers: int = 6, d_ff: Optional[int] = None,
-                 max_len: int = 1024, tie_head: bool = True):
+                 max_len: int = 1024, tie_head: bool = True,
+                 remat: bool = False):
         super().__init__()
         d_ff = d_ff or 4 * d_model
         self.vocab, self.max_len, self.tie_head = vocab, max_len, tie_head
+        # jax.checkpoint per block: activations rematerialize in the
+        # backward instead of living across the whole depth — the
+        # FLOPs-for-HBM trade long-context training needs
+        self.remat = remat
         self.embed = nn.Embedding(vocab, d_model, w_init=normal(0.0, 0.02))
         self.param("pos_embed", (max_len, d_model), normal(0.0, 0.01))
         self.blocks = [TransformerBlock(d_model, n_heads, d_ff)
@@ -93,7 +98,13 @@ class TransformerLM(nn.Module):
                else params["pos_embed"][positions])
         x = x + pos.astype(x.dtype)
         for i in range(len(self.blocks)):
-            x = self.blocks[i](params[f"blocks_{i}"], x, seq_axis=seq_axis)
+            blk = self.blocks[i]
+            if self.remat:
+                x = jax.checkpoint(
+                    lambda p, x, blk=blk: blk(p, x, seq_axis=seq_axis))(
+                        params[f"blocks_{i}"], x)
+            else:
+                x = blk(params[f"blocks_{i}"], x, seq_axis=seq_axis)
         x = self.ln_f(params["ln_f"], x)
         if self.tie_head:
             return x @ params["embed"]["w"].T.astype(x.dtype)

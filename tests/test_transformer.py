@@ -135,3 +135,20 @@ def test_seq_parallel_matches_single_device():
         out_specs=P(None, "seq"), check_vma=False))
     got = np.asarray(sharded(params, ids, positions))
     np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_remat_matches_no_remat():
+    """remat=True (jax.checkpoint per block) must not change values or
+    gradients — only the backward's memory/FLOP trade."""
+    m1, params = _model()
+    m2 = TransformerLM(V, d_model=D, n_heads=H, n_layers=L, max_len=64,
+                       remat=True)
+    ids = jax.random.randint(jax.random.PRNGKey(6), (B, T), 0, V)
+    l1, g1 = jax.value_and_grad(m1.loss)(params, ids)
+    l2, g2 = jax.value_and_grad(m2.loss)(params, ids)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    flat2 = jax.tree_util.tree_leaves(g2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
