@@ -30,6 +30,9 @@ def build():
 
 def main():
     # --- phase 1: train and publish the "zoo" artifact (params tar) -------
+    fluid.reset_default_programs()     # standalone-script hygiene: build
+    #                                    into a fresh Program regardless of
+    #                                    what the importing process did
     img, label, feat, logits, cost = build()
     trainer = paddle.SGD(cost, paddle.optimizer.Adam(1e-3))
     trainer.train(paddle.batch(paddle.dataset.mnist.train(1024), 64),
