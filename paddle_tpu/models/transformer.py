@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
-from ..nn.initializer import normal, zeros
+from ..nn.initializer import normal
 from ..ops import pallas_kernels as pk
 
 
@@ -110,23 +110,51 @@ class TransformerLM(nn.Module):
             return x @ params["embed"]["w"].T.astype(x.dtype)
         return self.head(params["head"], x)
 
-    def loss(self, params, ids, lengths=None, *,
-             seq_axis: Optional[str] = None):
-        """Next-token CE over positions < length-1 (true-token masking)."""
-        logits = self(params, ids[:, :-1], seq_axis=seq_axis)
-        targets = ids[:, 1:]
+    def shifted_loss(self, params, ids_in, targets, *, positions=None,
+                     mask=None, seq_axis: Optional[str] = None):
+        """CE over ALREADY-shifted (inputs, targets) pairs.
+
+        This is the sequence-parallel entry point: shift GLOBALLY first
+        (ids[:, :-1] / ids[:, 1:]), then shard ids_in/targets/positions/mask
+        over the seq axis — per-shard shifting inside shard_map would drop
+        each shard's last token and misalign every boundary. ``mask`` (same
+        shape as targets) weights positions; the mask SUM is psum'd over
+        ``seq_axis`` so the mean is global.
+        """
+        logits = self(params, ids_in, positions=positions, seq_axis=seq_axis)
         # lse - gold == -log_softmax[gold], without materializing the full
         # [B, T, V] log-prob tensor in f32 (the reductions fuse instead)
         l32 = logits.astype(jnp.float32)
         lse = jax.nn.logsumexp(l32, axis=-1)
         gold = jnp.take_along_axis(l32, targets[..., None], -1)[..., 0]
         nll = lse - gold
+        if mask is None:
+            mask = jnp.ones_like(nll)
+        mask = mask.astype(nll.dtype)
+        num = jnp.sum(nll * mask)
+        den = jnp.sum(mask)
+        if seq_axis is not None:
+            num = jax.lax.psum(num, seq_axis)
+            den = jax.lax.psum(den, seq_axis)
+        return num / jnp.maximum(den, 1.0)
+
+    def loss(self, params, ids, lengths=None, *,
+             seq_axis: Optional[str] = None):
+        """Next-token CE over positions < length-1 (true-token masking)."""
+        if seq_axis is not None:
+            raise ValueError(
+                "loss() shifts ids internally, which is wrong per-shard "
+                "under sequence sharding (each shard would drop its last "
+                "token and misalign targets at shard boundaries); shift "
+                "globally and use shifted_loss(ids[:, :-1], ids[:, 1:], "
+                "positions=..., seq_axis=...) instead")
+        targets = ids[:, 1:]
         if lengths is None:
-            return nll.mean()
-        T = targets.shape[1]
-        mask = (jnp.arange(T)[None, :] < (lengths - 1)[:, None]
-                ).astype(nll.dtype)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            mask = None
+        else:
+            T = targets.shape[1]
+            mask = (jnp.arange(T)[None, :] < (lengths - 1)[:, None])
+        return self.shifted_loss(params, ids[:, :-1], targets, mask=mask)
 
     def generate_greedy(self, params, prompt, steps: int):
         """Greedy continuation: prompt [B, T0] -> [B, T0+steps] (full

@@ -199,3 +199,38 @@ def test_tensor_parallel_via_sharding_rules():
                    key=str)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=1e-5, err_msg=str(ka))
+
+
+def test_seq_parallel_shifted_loss_matches_unsharded():
+    """The seq-parallel training objective: globally-shifted inputs/targets
+    sharded over the seq axis through shifted_loss == the unsharded loss
+    exactly; loss(seq_axis=...) is refused (per-shard shifting is wrong)."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import parallel as pp
+
+    n = 8
+    if len(jax.devices()) < n:
+        pytest.skip("needs 8 virtual devices")
+    T_long = 33                     # odd so the shifted length is 32 = 8*4
+    model, params = _model(max_len=T_long)
+    ids = jax.random.randint(jax.random.PRNGKey(8), (2, T_long), 0, V)
+    want = float(model.loss(params, ids))
+
+    ids_in, targets = ids[:, :-1], ids[:, 1:]
+    positions = jnp.broadcast_to(jnp.arange(T_long - 1), ids_in.shape)
+    mesh = pp.make_mesh(seq=n)
+
+    def f(params, ids_in, targets, positions):
+        return model.shifted_loss(params, ids_in, targets,
+                                  positions=positions, seq_axis="seq")
+
+    sharded = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(), P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(), check_vma=False))
+    got = float(sharded(params, ids_in, targets, positions))
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    with pytest.raises(ValueError, match="shift"):
+        model.loss(params, ids, seq_axis="seq")
