@@ -18,6 +18,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import nn
 from ..nn.initializer import normal
@@ -46,18 +47,28 @@ class TransformerBlock(nn.Module):
             return ring_attention(q, k, v, seq_axis, True)
         return pk.flash_attention(q, k, v, causal=True)
 
-    def __call__(self, params, x, *, seq_axis: Optional[str] = None, **kw):
-        B, T, D = x.shape
-        h = self.ln1(params["ln1"], x)
-        qkv = self.qkv(params["qkv"], h)                 # [B, T, 3D]
+    def heads(self, params, x):
+        """q, k, v as [B, T, H, Dh] from one fused qkv matmul."""
+        B, T, _ = x.shape
+        qkv = self.qkv(params["qkv"], self.ln1(params["ln1"], x))
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, T, self.n_heads, self.d_head)
-        o = self.attend(q.reshape(shape), k.reshape(shape), v.reshape(shape),
-                        seq_axis=seq_axis)
+        return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+
+    def finish(self, params, x, o):
+        """Residual + projection + MLP after attention output ``o``."""
+        B, T, D = x.shape
         x = x + self.proj(params["proj"], o.reshape(B, T, D).astype(x.dtype))
         h = self.ln2(params["ln2"], x)
         return x + self.mlp_out(params["mlp_out"],
                                 self.mlp_in(params["mlp_in"], h))
+
+    def __call__(self, params, x, *, seq_axis: Optional[str] = None,
+                 return_kv: bool = False, **kw):
+        q, k, v = self.heads(params, x)
+        o = self.attend(q, k, v, seq_axis=seq_axis)
+        out = self.finish(params, x, o)
+        return (out, (k, v)) if return_kv else out
 
 
 class TransformerLM(nn.Module):
@@ -158,10 +169,82 @@ class TransformerLM(nn.Module):
 
     def generate_greedy(self, params, prompt, steps: int):
         """Greedy continuation: prompt [B, T0] -> [B, T0+steps] (full
-        re-forward per step: correctness reference, not the serving path)."""
+        re-forward per step: correctness reference, not the serving path —
+        see :meth:`generate_cached`)."""
         ids = prompt
         for _ in range(steps):
             logits = self(params, ids[:, -self.max_len:])
             nxt = jnp.argmax(logits[:, -1], axis=-1)
             ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
         return ids
+
+    # -- incremental decoding (the serving path) ---------------------------
+    def prefill(self, params, prompt):
+        """Run the prompt once, materializing per-layer KV caches padded to
+        max_len. Returns (cell, last_logits [B, V]); cell carries the caches
+        and the per-sample write position."""
+        B, T0 = prompt.shape
+        x = self.embed(params["embed"], prompt)
+        x = x + params["pos_embed"][:T0].astype(x.dtype)
+        cell = {"pos": jnp.full((B,), T0, jnp.int32)}
+        pad = self.max_len - T0
+        for i in range(len(self.blocks)):
+            blk = self.blocks[i]
+            q, k, v = blk.heads(params[f"blocks_{i}"], x)
+            o = blk.attend(q, k, v)
+            x = blk.finish(params[f"blocks_{i}"], x, o)
+            cell[f"k{i}"] = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            cell[f"v{i}"] = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        x = self.ln_f(params["ln_f"], x)
+        logits = (x @ params["embed"]["w"].T.astype(x.dtype)
+                  if self.tie_head else self.head(params["head"], x))
+        return cell, logits[:, -1]
+
+    def decode_step(self, params, cell, tokens):
+        """One incremental step: tokens [B] -> (logits [B, V], new cell).
+        Attention reads the KV cache (masked to written positions) instead
+        of re-running the prefix — O(T) per token instead of O(T^2)."""
+        pos = cell["pos"]                                  # [B]
+        x = self.embed(params["embed"], tokens[:, None])   # [B, 1, D]
+        x = x + params["pos_embed"][pos][:, None, :].astype(x.dtype)
+        new_cell = {"pos": pos + 1}
+        upd = jax.vmap(
+            lambda c, kv, p: jax.lax.dynamic_update_slice(
+                c, kv[None], (p, 0, 0)))
+        for i in range(len(self.blocks)):
+            blk = self.blocks[i]
+            q, k, v = blk.heads(params[f"blocks_{i}"], x)  # [B, 1, H, Dh]
+            kc = upd(cell[f"k{i}"], k[:, 0], pos)
+            vc = upd(cell[f"v{i}"], v[:, 0], pos)
+            new_cell[f"k{i}"], new_cell[f"v{i}"] = kc, vc
+            s = jnp.einsum("bhd,bshd->bhs", q[:, 0].astype(jnp.float32),
+                           kc.astype(jnp.float32)) / np.sqrt(blk.d_head)
+            valid = (jnp.arange(self.max_len)[None, :]
+                     <= pos[:, None])[:, None, :]
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhs,bshd->bhd", p,
+                           vc.astype(jnp.float32))[:, None]
+            x = blk.finish(params[f"blocks_{i}"], x, o)
+        x = self.ln_f(params["ln_f"], x)
+        logits = (x @ params["embed"]["w"].T.astype(x.dtype)
+                  if self.tie_head else self.head(params["head"], x))
+        return logits[:, 0], new_cell
+
+    def generate_cached(self, params, prompt, steps: int):
+        """Greedy continuation through the KV cache: one jitted scan, no
+        prefix re-forward. Matches generate_greedy token-for-token."""
+        cell, last_logits = self.prefill(params, prompt)
+        first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
+
+        def body(carry, _):
+            cell, cur = carry
+            logits, cell = self.decode_step(params, cell, cur)
+            nxt = jnp.argmax(logits, axis=-1).astype(cur.dtype)
+            return (cell, nxt), cur
+
+        # each iteration emits its INPUT token: cur_0 = first (from the
+        # prompt's logits), cur_j = argmax of step j-1 — exactly the
+        # `steps` generated tokens
+        _, toks = jax.lax.scan(body, (cell, first), None, length=steps)
+        return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)

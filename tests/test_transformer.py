@@ -234,3 +234,23 @@ def test_seq_parallel_shifted_loss_matches_unsharded():
 
     with pytest.raises(ValueError, match="shift"):
         model.loss(params, ids, seq_axis="seq")
+
+
+def test_cached_decode_matches_full_reforward():
+    """KV-cache incremental decode (the serving path) must match the full
+    re-forward greedy token-for-token, tied and untied heads."""
+    for tie in (True, False):
+        model, params = _model(max_len=32, tie_head=tie)
+        prompt = jax.random.randint(jax.random.PRNGKey(9), (3, 5), 0, V)
+        want = np.asarray(model.generate_greedy(params, prompt, steps=12))
+        got = np.asarray(model.generate_cached(params, prompt, steps=12))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_prefill_logits_match_forward():
+    model, params = _model(max_len=32)
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (2, 7), 0, V)
+    _, last = model.prefill(params, prompt)
+    full = model(params, prompt)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, -1]),
+                               rtol=1e-5, atol=1e-5)
