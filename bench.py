@@ -10,6 +10,10 @@ are faster than the reference by that factor.
 Methodology notes live in each benchmarks/*.py docstring (varied lengths,
 train-mode BN with stat updates, distinct rotating device-staged batches,
 on-device-loop differencing timing).
+
+Default run = one representative row per family (fits the driver's timeout;
+round 3's full sweep hit rc=124). ``python bench.py --full`` runs every
+published reference row — use that when refreshing BASELINE.md.
 """
 
 from __future__ import annotations
@@ -64,13 +68,27 @@ def _attempt(fn, tries: int = 2):
     return None
 
 
-def main():
+# Representative rows per family for the default (driver-budget) run,
+# selected FROM the published tables so the reference numbers have one
+# source of truth. The full sweep (11 image rows, 9 LSTM rows) lives behind
+# --full and is what refreshes BASELINE.md; the default run must finish well
+# inside the driver's timeout (round 3 learned the hard way: rc=124).
+QUICK_IMAGE_KEYS = {("alexnet", 256), ("googlenet", 128)}
+QUICK_LSTM_KEYS = {(128, 512)}
+
+
+def _quick(rows, keys):
+    return [r for r in rows if (r[0], r[1]) in keys]
+
+
+def main(full: bool = False):
     flagship_ok = False
     # secondary metrics first; the flagship (has a published baseline) last so
     # it is the line the driver's tail-parser records
     try:
         from benchmarks.image_suite import ROWS, bench_row
-        for model_key, bs, ref_ms in ROWS:
+        for model_key, bs, ref_ms in (
+                ROWS if full else _quick(ROWS, QUICK_IMAGE_KEYS)):
             rec = _attempt(lambda: bench_row(model_key, bs, ref_ms))
             if rec is not None:
                 print(json.dumps(rec), flush=True)
@@ -79,20 +97,23 @@ def main():
     try:
         from benchmarks.lstm_textcls import SUITE_ROWS
         from benchmarks.lstm_textcls import bench_row as lstm_row
-        for bs, hidden, ref_ms in SUITE_ROWS:
+        for bs, hidden, ref_ms in (
+                SUITE_ROWS if full else _quick(SUITE_ROWS, QUICK_LSTM_KEYS)):
             rec = _attempt(lambda: lstm_row(bs, hidden, ref_ms))
             if rec is not None:
                 print(json.dumps(rec), flush=True)
     except Exception:
         traceback.print_exc()
-    for name in ("transformer_lm", "resnet50", "seq2seq_nmt", "fused_rnn",
-                 "lstm_textcls"):
+    names = ("transformer_lm", "resnet50", "seq2seq_nmt", "fused_rnn",
+             "lstm_textcls") if full else (
+        "transformer_lm", "resnet50", "seq2seq_nmt", "lstm_textcls")
+    for name in names:
         try:
             mod = __import__(f"benchmarks.{name}", fromlist=["run"])
             rec = _attempt(mod.run)
             if rec is not None:
                 print(json.dumps(rec), flush=True)
-            if name == "resnet50":
+            if name == "resnet50" and full:
                 rec2 = _attempt(mod.run_with_infeed)
                 if rec2 is not None:
                     print(json.dumps(rec2), flush=True)
@@ -107,4 +128,5 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    main(full="--full" in sys.argv)

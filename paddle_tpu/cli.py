@@ -228,16 +228,9 @@ def cmd_checkgrad(args):
     return 0 if ok else 1
 
 
-def cmd_cluster_train(args):
-    """Local cluster launcher — the scripts/cluster_train/paddle.py (ssh) and
-    cluster_train_v2 fabric/openmpi analog, process-model edition.
-
-    Spawns ``--num_workers`` worker processes that join one jax.distributed
-    job (coordinator on localhost; PADDLE_TPU_* env carries the membership
-    that etcd/MPI carried for the reference) and each execute the training
-    SCRIPT. The script calls ``paddle_tpu.parallel.multihost.initialize()``
-    to join, then trains over the global mesh. A failing worker tears the
-    job down (failure detection; rc propagated)."""
+def _cluster_attempt(args, attempt: int) -> int:
+    """One full-job launch: spawn all workers on a fresh coordinator port,
+    poll, tear down on any failure. Returns the job rc."""
     import os
     import socket
     import subprocess
@@ -253,6 +246,7 @@ def cmd_cluster_train(args):
         env["PADDLE_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
         env["PADDLE_TPU_NUM_PROCESSES"] = str(args.num_workers)
         env["PADDLE_TPU_PROCESS_ID"] = str(i)
+        env["PADDLE_TPU_RESTART_COUNT"] = str(attempt)
         if args.devices_per_worker:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 f" --xla_force_host_platform_device_count="
@@ -278,9 +272,7 @@ def cmd_cluster_train(args):
                         print(f"cluster_train: worker {procs.index(p)} "
                               f"exited rc={code}; tearing the job down "
                               f"(survivors get SIGTERM, {args.grace:.0f}s "
-                              f"grace). Restart from the latest checkpoint "
-                              f"— see docs/design/distributed.md.",
-                              file=sys.stderr)
+                              f"grace).", file=sys.stderr)
             if not rc and _time.time() > deadline:
                 rc = 124
                 print(f"cluster_train: --timeout {args.timeout:.0f}s "
@@ -299,6 +291,45 @@ def cmd_cluster_train(args):
         for p in procs:           # a dead/hung peer must not strand the rest
             if p.poll() is None:
                 p.kill()
+    return rc
+
+
+def cmd_cluster_train(args):
+    """Local cluster launcher — the scripts/cluster_train/paddle.py (ssh) and
+    cluster_train_v2 fabric/openmpi analog, process-model edition.
+
+    Spawns ``--num_workers`` worker processes that join one jax.distributed
+    job (coordinator on localhost; PADDLE_TPU_* env carries the membership
+    that etcd/MPI carried for the reference) and each execute the training
+    SCRIPT. The script calls ``paddle_tpu.parallel.multihost.initialize()``
+    to join, then trains over the global mesh. A failing worker tears the
+    job down (failure detection; rc propagated).
+
+    ``--restart-on-failure N``: elastic recovery (the reference's
+    trainers-are-stateless-consumers design, go/master/service.go:311-321 +
+    doc/design/cluster_train/README.md). A synchronous SPMD job cannot
+    continue minus one collective participant, so recovery is job-grained:
+    tear down, then relaunch ALL workers on a fresh coordinator, up to N
+    times. Scripts resume from their latest pass checkpoint (the trainer's
+    pass-%05d discipline); a ``--local_master`` data plane requeues the dead
+    consumer's pending task chunks by lease timeout automatically
+    (native/task_master.cc), so no sample is lost or double-trained across
+    the restart. ``PADDLE_TPU_RESTART_COUNT`` tells the script which
+    attempt it is on. Timeouts are per-attempt."""
+    restarts = max(0, getattr(args, "restart_on_failure", 0) or 0)
+    for attempt in range(restarts + 1):
+        rc = _cluster_attempt(args, attempt)
+        if rc == 0:
+            return 0
+        if attempt < restarts:
+            print(f"cluster_train: attempt {attempt} failed rc={rc}; "
+                  f"relaunching from the latest checkpoint "
+                  f"({restarts - attempt} restart(s) left).", file=sys.stderr)
+        else:
+            print("cluster_train: restart budget exhausted."
+                  if restarts else
+                  "cluster_train: failed (pass --restart-on-failure N for "
+                  "elastic recovery).", file=sys.stderr)
     return rc
 
 
@@ -414,6 +445,11 @@ def main(argv=None) -> int:
     ct.add_argument("--grace", type=float, default=10.0,
                     help="seconds survivors get to run their teardown hook "
                          "(SIGTERM) before SIGKILL when a peer fails")
+    ct.add_argument("--restart-on-failure", type=int, default=0,
+                    metavar="N", dest="restart_on_failure",
+                    help="elastic recovery: relaunch the whole job (fresh "
+                         "coordinator, scripts resume from their latest "
+                         "checkpoint) up to N times after a worker failure")
     ct.set_defaults(fn=cmd_cluster_train)
 
     v = sub.add_parser("version")

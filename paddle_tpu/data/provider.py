@@ -66,7 +66,11 @@ def provider(input_types: Optional[Sequence] = None,
                                 rows.append(row)
                             else:
                                 yield row
-                    if cache == CacheType.CACHE_PASS_IN_MEM:
+                    # fill only while still empty: two generators started
+                    # against an empty cache (a partially-consumed pass
+                    # resumed alongside a full one) must not both extend,
+                    # duplicating every row in subsequent passes
+                    if cache == CacheType.CACHE_PASS_IN_MEM and not cached:
                         cached.extend(rows)
                 if cache == CacheType.CACHE_PASS_IN_MEM or should_shuffle:
                     if should_shuffle:

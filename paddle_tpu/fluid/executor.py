@@ -123,12 +123,19 @@ def _trace_ops(ops, env: Dict[str, Any], ctx: TraceContext):
                 raise          # innermost op already carries its context
             chain = " -> ".join(o.type for o in ops[max(0, idx - 4):idx + 1])
             msg = (f"op #{idx} {op.type!r} failed while tracing the Program "
-                   f"(inputs={op.inputs}, outputs={op.outputs}): "
-                   f"{type(e).__name__}: {e}\n  op chain: ...{chain}")
-            try:               # keep the original type so callers'
-                new = type(e)(msg)   # except/raises clauses still match
+                   f"(inputs={op.inputs}, outputs={op.outputs})\n"
+                   f"  op chain: ...{chain}")
+            if hasattr(e, "add_note"):
+                # annotate the ORIGINAL exception: re-constructing via
+                # type(e)(msg) would drop structured args (OSError.errno,
+                # KeyError's key) that callers match on
+                e.add_note(msg)
+                e._op_ctx = True
+                raise
+            try:               # pre-3.11 fallback: keep the type so callers'
+                new = type(e)(f"{msg}: {type(e).__name__}: {e}")
             except Exception:
-                new = _OpTraceError(msg)
+                new = _OpTraceError(f"{msg}: {type(e).__name__}: {e}")
             new._op_ctx = True
             raise new from e
     return env

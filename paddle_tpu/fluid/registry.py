@@ -1452,9 +1452,11 @@ def _ce_over_beam(ins, attrs):
     logp = jax.nn.log_softmax(logits, axis=-1)
     safe_idx = jnp.clip(gold_idx, 0, logits.shape[-1] - 1)
     picked = jnp.take_along_axis(logp, safe_idx[:, None], axis=-1)[:, 0]
-    # a gold index outside the logits (out-of-beam sentinel K without a
-    # GoldScore input to back it) must not silently train against the last
-    # beam slot: surface it as +inf loss, which the trainer's NaN/inf guard
+    # a gold index outside the logits — the out-of-beam sentinel K without a
+    # GoldScore input to back it, or a negative index (which clip would
+    # silently send to beam slot 0) — must not train against an arbitrary
+    # slot: surface it as +inf loss, which the trainer's NaN/inf guard
     # reports loudly
-    picked = jnp.where(gold_idx > safe_idx, -jnp.inf, picked)
+    picked = jnp.where((gold_idx > safe_idx) | (gold_idx < 0),
+                       -jnp.inf, picked)
     return {"Out": [-picked]}

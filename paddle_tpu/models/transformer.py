@@ -234,6 +234,14 @@ class TransformerLM(nn.Module):
     def generate_cached(self, params, prompt, steps: int):
         """Greedy continuation through the KV cache: one jitted scan, no
         prefix re-forward. Matches generate_greedy token-for-token."""
+        if prompt.shape[1] + steps > self.max_len:
+            # past max_len JAX's clamped indexing would silently corrupt the
+            # pos_embed lookup and cache writes (generate_greedy slides its
+            # window instead) — fail loudly rather than diverge silently
+            raise ValueError(
+                f"prompt_len ({prompt.shape[1]}) + steps ({steps}) exceeds "
+                f"max_len ({self.max_len}); use generate_greedy for "
+                "sliding-window generation past the trained context")
         cell, last_logits = self.prefill(params, prompt)
         first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
 

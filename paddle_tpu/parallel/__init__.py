@@ -30,7 +30,7 @@ from .data_parallel import DataParallel, Zero1DataParallel, Zero1State
 from .tensor_parallel import ColumnParallelLinear, RowParallelLinear, ShardedEmbedding
 from .ring_attention import (ring_attention, blockwise_attention,
                              ring_self_attention, ulysses_attention)
-from .pipeline import PipelineStage, pipeline_spmd
+from .pipeline import PipelineStage, pipeline_1f1b, pipeline_spmd
 from .moe import ExpertParallelMoE, init_moe_params, moe_ffn_dense
 from . import multihost
 
@@ -46,6 +46,6 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "ShardedEmbedding",
     "ring_attention", "blockwise_attention", "ring_self_attention",
     "ulysses_attention",
-    "PipelineStage", "pipeline_spmd", "multihost",
+    "PipelineStage", "pipeline_spmd", "pipeline_1f1b", "multihost",
     "ExpertParallelMoE", "init_moe_params", "moe_ffn_dense",
 ]

@@ -100,3 +100,114 @@ def pipeline_spmd(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
     xspec = P()
     return jax.jit(jax.shard_map(local, mesh=mesh, in_specs=(pspec, xspec),
                                  out_specs=xspec))
+
+
+def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
+                  n_microbatches: int, axis: str = "pipe"):
+    """1F1B (PipeDream-flush) training schedule over the ``pipe`` axis.
+
+    Builds ``step(stacked_params, x, y) -> (loss, stacked_grads)``.
+
+    GPipe (``jax.grad`` through :func:`pipeline_spmd`) runs all M forwards
+    then all M backwards, so every stage stashes M microbatch activations.
+    1F1B interleaves: stage s's timetable is forwards at ticks ``s + 2m`` and
+    backwards at ``2S - s - 1 + 2m`` (parities never collide), so at most
+    ``S - s`` microbatches are in flight per stage and the input stash is a
+    circular buffer of S slots — the memory bound is min(S, M) activations
+    instead of M. The bubble fraction is the same (S-1)/(M+S-1) for both
+    schedules (each does M+S-1 forward slots and M+S-1 backward slots);
+    1F1B's win is memory, which is what lets M grow to amortize the bubble.
+    Backward recomputes the stage forward from the stashed INPUT (standard
+    rematerialization), so the stash holds inputs, not full residuals.
+
+    Reference analog: ParallelNeuralNetwork.h:23-34 streams batches through
+    per-device fwd/bwd task queues — 1F1B is that interleave, made explicit
+    as a static SPMD timetable instead of threads.
+
+    stage_fn(stage_params, mb) -> mb' (same shape); loss_fn(out_mb, y_mb) ->
+    scalar mean loss for the microbatch. Returned loss/grads are averaged
+    over microbatches; grads keep the stacked [n_stages, ...] leading axis.
+    """
+    n_stages = mesh.shape[axis]
+
+    def local(params, x, y):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        S, M = n_stages, n_microbatches
+        s = lax.axis_index(axis)
+        mbx = x.reshape(M, x.shape[0] // M, *x.shape[1:])
+        mby = y.reshape(M, y.shape[0] // M, *y.shape[1:])
+        mb_shape = mbx[0]
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [((i + 1) % S, i) for i in range(S)]
+
+        def bwd_of(saved_inp, cot, y_mb, is_last):
+            """Recompute-vjp one stage. The last stage seeds from the loss."""
+            def last_branch(p, inp):
+                lv, vjp = jax.vjp(
+                    lambda pp, xx: loss_fn(stage_fn(pp, xx), y_mb), p, inp)
+                dp, dx = vjp(jnp.ones_like(lv))
+                return lv.astype(jnp.float32), dp, dx
+
+            def mid_branch(p, inp):
+                _, vjp = jax.vjp(stage_fn, p, inp)
+                dp, dx = vjp(cot)
+                return jnp.float32(0), dp, dx
+
+            return lax.cond(is_last, last_branch, mid_branch,
+                            params, saved_inp)
+
+        def tick(t, carry):
+            fwd_msg, bwd_msg, stash, dparams, loss_acc = carry
+            # static timetable, evaluated per device from its axis index
+            tf = t - s
+            do_fwd = (tf >= 0) & (tf % 2 == 0) & (tf // 2 < M)
+            m_f = jnp.clip(tf // 2, 0, M - 1)
+            tb = t - (2 * S - s - 1)
+            do_bwd = (tb >= 0) & (tb % 2 == 0) & (tb // 2 < M)
+            m_b = jnp.clip(tb // 2, 0, M - 1)
+
+            inp = jnp.where(s == 0, mbx[m_f], fwd_msg)
+            saved = lax.dynamic_index_in_dim(stash, m_b % S, 0,
+                                             keepdims=False)
+
+            def do_backward(_):
+                lv, dp, dx = bwd_of(saved, bwd_msg, mby[m_b], s == S - 1)
+                return jnp.zeros_like(mb_shape), dx, dp, lv
+
+            def do_forward(_):
+                out = stage_fn(params, inp)
+                zp = jax.tree_util.tree_map(jnp.zeros_like, params)
+                return out, jnp.zeros_like(mb_shape), zp, jnp.float32(0)
+
+            send_f, send_b, dp, lv = lax.cond(do_bwd, do_backward,
+                                              do_forward, None)
+            # mask edges: idle ticks run the forward branch on garbage input
+            send_f = jnp.where(do_fwd, send_f, 0).astype(mb_shape.dtype)
+            stash = lax.cond(
+                do_fwd,
+                lambda st: lax.dynamic_update_index_in_dim(
+                    st, inp, m_f % S, 0),
+                lambda st: st, stash)
+            dparams = jax.tree_util.tree_map(jnp.add, dparams, dp)
+            loss_acc = loss_acc + lv
+            fwd_msg = lax.ppermute(send_f, axis, fwd_perm)
+            bwd_msg = lax.ppermute(send_b, axis, bwd_perm)
+            return fwd_msg, bwd_msg, stash, dparams, loss_acc
+
+        zero_mb = lax.pcast(jnp.zeros_like(mb_shape), axis, to="varying")
+        stash0 = lax.pcast(
+            jnp.zeros((S,) + mb_shape.shape, mb_shape.dtype), axis,
+            to="varying")
+        dp0 = lax.pcast(jax.tree_util.tree_map(jnp.zeros_like, params),
+                        axis, to="varying")
+        carry = (zero_mb, zero_mb, stash0, dp0, jnp.float32(0))
+        total = 2 * (M + S - 1)
+        _, _, _, dparams, loss_acc = lax.fori_loop(0, total, tick, carry)
+        loss = lax.psum(loss_acc, axis) / M
+        dparams = jax.tree_util.tree_map(lambda g: (g / M)[None], dparams)
+        return loss, dparams
+
+    pspec = P(axis)
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(pspec, P(), P()),
+        out_specs=(P(), pspec), check_vma=False))

@@ -399,6 +399,17 @@ def ring_self_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
     n = mesh.shape[seq_axis]
     T = q.shape[1]
     if zigzag and T % (2 * n):
+        # the contiguous causal layout computes-and-discards roughly half the
+        # ring's K/V blocks (device i skips blocks from devices > i), so the
+        # fallback costs ~2x the balanced zigzag FLOPs — never take it
+        # silently
+        import warnings
+        warnings.warn(
+            f"ring_self_attention: T={T} is not divisible by 2*n_shards"
+            f"={2 * n}; falling back to the CONTIGUOUS causal layout, which "
+            "wastes ~half the attention FLOPs vs zigzag. Pad the sequence "
+            f"to a multiple of {2 * n} to keep the load-balanced layout.",
+            stacklevel=2)
         zigzag = False                       # shape can't chunk: fall back
     if zigzag:
         order = zigzag_order(T, n)

@@ -17,7 +17,9 @@ from .recordio import RecordReader, RecordWriter
 from .arena import HostArena
 from .optimizer import HostOptimizer
 from .lease import FileLease, LeaseKeeper
+from .coord import CoordServer, NetworkFencedStore, NetworkLease
 
 __all__ = ["load_library", "native_available", "TaskMaster",
            "FileLease", "LeaseKeeper",
+           "CoordServer", "NetworkLease", "NetworkFencedStore",
            "RecordReader", "RecordWriter", "HostArena", "HostOptimizer"]

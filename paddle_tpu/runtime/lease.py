@@ -264,8 +264,19 @@ class LeaseKeeper:
         return self
 
     def _run(self):
+        last_ok = time.time()
         while not self._stop.wait(self.interval):
-            if not self.lease.renew():
+            try:
+                renewed = self.lease.renew()
+            except (OSError, ConnectionError):
+                # transient store outage (NFS blip, coord-server restart):
+                # keep trying while our TTL could still be running; once the
+                # lease must have expired server-side, it is LOST
+                renewed = time.time() - last_ok < self.lease.ttl
+            else:
+                if renewed:
+                    last_ok = time.time()
+            if not renewed:
                 if self.on_lost is not None:
                     self.on_lost()
                 return

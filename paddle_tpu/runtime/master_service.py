@@ -68,21 +68,43 @@ class MasterServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  timeout_s: float = 60.0, failure_max: int = 3,
                  snapshot_path: Optional[str] = None,
+                 snapshot_store=None,
                  tick_interval: float = 1.0, lease=None):
         self.master = TaskMaster(timeout_s=timeout_s, failure_max=failure_max)
-        if snapshot_path:
+        if snapshot_store is not None and snapshot_path:
+            raise ValueError("pass snapshot_path (shared/local file) OR "
+                             "snapshot_store (network blob), not both")
+        if snapshot_store is not None:
+            # network snapshot home (coord.NetworkFencedStore): a successor
+            # on ANY host fetches before serving — no shared filesystem
+            import os
+            import tempfile
+            fd, tmp = tempfile.mkstemp(prefix="mastersnap.")
+            os.close(fd)
+            try:
+                if snapshot_store.fetch_to(tmp):
+                    self.master.restore(tmp)
+            finally:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            self._fence = snapshot_store
+        elif snapshot_path:
             import os
             if os.path.exists(snapshot_path):
                 # corruption (CRC/parse failure) must surface loudly — only a
                 # genuinely absent snapshot means "fresh start"
                 self.master.restore(snapshot_path)
+            from .lease import FencedFile
+            self._fence = FencedFile(snapshot_path)
+        else:
+            self._fence = None
         self.snapshot_path = snapshot_path
         self._tick_interval = tick_interval
         self.lease = lease
         self._keeper = None
         self.fence_token = None   # set from the lease at start()
-        from .lease import FencedFile
-        self._fence = FencedFile(snapshot_path) if snapshot_path else None
         self._deposed = False
         self._fence_checked_at = float("-inf")
         self.lease_lost = threading.Event()
@@ -131,12 +153,14 @@ class MasterServer:
                     not self._fence.claim(self.fence_token):
                 self._server.server_close()
                 self.lease.release()   # don't wedge standby takeover
+                fence_loc = getattr(self._fence, "fence_path",
+                                    getattr(self._fence, "key", "?"))
                 raise RuntimeError(
                     "snapshot fence already claimed by a newer master "
                     f"(our token {self.fence_token} < recorded "
-                    f"{self._fence._recorded()}); if the lease epoch file "
-                    f"was lost, remove {self._fence.fence_path} or seed "
-                    f"{self.lease.path}.epoch past the recorded value")
+                    f"{self._fence._recorded()}); if the lease epoch state "
+                    f"was lost, clear the fence record at {fence_loc} or "
+                    "seed the lease epoch past the recorded value")
             self._keeper = LeaseKeeper(self.lease, on_lost=self._on_lease_lost)
             self._keeper.start()
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -178,7 +202,7 @@ class MasterServer:
         """Fenced snapshot write: refused (False) once a newer master has
         claimed the snapshot — a deposed master that wakes after its TTL
         cannot clobber the new generation's state."""
-        if not self.snapshot_path:
+        if self._fence is None:
             return False
         try:
             ok = self._fence.write(
@@ -192,7 +216,7 @@ class MasterServer:
     def _housekeeping(self):
         while not self._stop.wait(self._tick_interval):
             self.master.tick()
-            if self.snapshot_path and not self.try_snapshot() \
+            if self._fence is not None and not self.try_snapshot() \
                     and self._fenced_out():
                 # a newer master owns the snapshot: we are deposed
                 self._on_lease_lost()
@@ -214,11 +238,18 @@ class MasterServer:
         if now - self._fence_checked_at < window:
             return False
         self._fence_checked_at = now
-        deposed = (self._fence is not None and
-                   self._fence._recorded() > self.fence_token)
-        if not deposed and self.lease is not None:
-            cur = self.lease.current_token()
-            deposed = cur is not None and cur > self.fence_token
+        # a transient coord-server outage must not crash housekeeping or a
+        # handler thread: reads fail OPEN (not deposed — writes still fail
+        # CLOSED via try_snapshot, so a deposed master can't publish while
+        # the question is unanswerable) and the next window re-asks
+        try:
+            deposed = (self._fence is not None and
+                       self._fence._recorded() > self.fence_token)
+            if not deposed and self.lease is not None:
+                cur = self.lease.current_token()
+                deposed = cur is not None and cur > self.fence_token
+        except (OSError, ConnectionError):
+            return False
         if deposed:
             self._deposed = True
         return deposed

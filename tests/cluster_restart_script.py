@@ -1,0 +1,113 @@
+"""Worker script for the elastic-restart test: 2 workers train a
+deterministic DP model for 3 passes, checkpointing params after each pass
+(the trainer's pass-%05d discipline, boiled down). On attempt 0, rank 1
+SIGKILLs itself after the pass-1 checkpoint lands (machine loss mid-job);
+the launcher's --restart-on-failure relaunches both workers, which resume
+from the latest checkpoint and finish. Rank 0 writes final.npz, which the
+test compares against an uninterrupted run — the elastic restart must be
+math-invisible."""
+
+import os
+import signal
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import nn, parallel as pp
+from paddle_tpu.optimizer import SGD
+from paddle_tpu.parallel import multihost
+
+PASSES = 3
+STEPS_PER_PASS = 2
+
+
+def build():
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def __call__(self, params, x, **kw):
+            return self.fc(params["fc"], x)
+
+    model = Net()
+
+    def loss(params, x, y):
+        logp = jax.nn.log_softmax(model(params, x))
+        return -jnp.take_along_axis(logp, y[:, None], 1).mean()
+
+    return model, loss
+
+
+def pass_batches(pass_idx):
+    """Deterministic per-pass data: same on every attempt."""
+    rs = np.random.RandomState(100 + pass_idx)
+    GB = 16
+    for _ in range(STEPS_PER_PASS):
+        yield (rs.randn(GB, 4).astype(np.float32),
+               rs.randint(0, 2, GB).astype(np.int32))
+
+
+def latest_checkpoint(ckpt_dir):
+    done = sorted(f for f in os.listdir(ckpt_dir)
+                  if f.startswith("pass-") and f.endswith(".npz"))
+    return os.path.join(ckpt_dir, done[-1]) if done else None
+
+
+def main():
+    info = multihost.initialize()
+    rank = info["process_index"]
+    attempt = int(os.environ.get("PADDLE_TPU_RESTART_COUNT", "0"))
+    ckpt_dir = os.environ["RESTART_TEST_DIR"]
+    mesh = multihost.global_mesh(data=info["global_devices"])
+
+    model, loss = build()
+    host_params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    start_pass = 0
+    ck = latest_checkpoint(ckpt_dir)
+    if ck is not None:
+        data = np.load(ck)
+        host_params = {"fc": {"w": data["w"], "b": data["b"]}}
+        start_pass = int(data["pass_idx"]) + 1
+
+    params = multihost.replicate_from_host(mesh, host_params)
+    dp = pp.DataParallel(loss, SGD(0.1), mesh=mesh)
+    opt_state = multihost.replicate_from_host(
+        mesh, jax.device_get(dp.opt.init(host_params)))
+
+    for pass_idx in range(start_pass, PASSES):
+        for X, Y in pass_batches(pass_idx):
+            sl = multihost.process_batch_slice(len(X))
+            bx, by = multihost.make_global_batch(mesh, (X[sl], Y[sl]))
+            params, opt_state, l = dp.step(params, opt_state, bx, by)
+        if rank == 0:
+            hp = jax.device_get(params)
+            tmp = os.path.join(ckpt_dir, f".pass-{pass_idx:05d}.tmp.npz")
+            np.savez(tmp, w=hp["fc"]["w"], b=hp["fc"]["b"],
+                     pass_idx=pass_idx)
+            os.replace(tmp, os.path.join(ckpt_dir,
+                                         f"pass-{pass_idx:05d}.npz"))
+        if attempt == 0 and pass_idx == 1 and rank == 1:
+            # wait until rank 0's pass-1 checkpoint is durable, then die
+            target = os.path.join(ckpt_dir, "pass-00001.npz")
+            deadline = time.time() + 60
+            while not os.path.exists(target) and time.time() < deadline:
+                time.sleep(0.05)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    if rank == 0:
+        hp = jax.device_get(params)
+        np.savez(os.path.join(ckpt_dir, "final.npz"),
+                 w=hp["fc"]["w"], b=hp["fc"]["b"])
+    print(f"worker {rank} attempt {attempt} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

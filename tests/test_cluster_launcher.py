@@ -25,6 +25,44 @@ def test_cluster_train_propagates_failure(tmp_path):
     assert rc != 0
 
 
+def test_cluster_restart_on_failure_resumes_and_matches(tmp_path, monkeypatch):
+    """Elastic recovery (go/master/service.go:311-321 trainers-as-stateless-
+    consumers): rank 1 SIGKILLs itself mid-job on attempt 0; with
+    --restart-on-failure the launcher relaunches the whole job on a fresh
+    coordinator, workers resume from the latest pass checkpoint, training
+    completes (rc 0), and the final params are numerically IDENTICAL to an
+    uninterrupted run's."""
+    import subprocess
+
+    import numpy as np
+
+    script = os.path.join(REPO, "tests", "cluster_restart_script.py")
+    kill_dir = tmp_path / "killed"
+    kill_dir.mkdir()
+    monkeypatch.setenv("RESTART_TEST_DIR", str(kill_dir))
+    rc = cli_main(["cluster_train", script, "--num_workers", "2",
+                   "--devices_per_worker", "1", "--timeout", "240",
+                   "--grace", "20", "--restart-on-failure", "2"])
+    assert rc == 0
+    assert (kill_dir / "final.npz").exists()
+
+    # uninterrupted reference run (single worker process, same global math)
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    env = dict(os.environ, RESTART_TEST_DIR=str(ref_dir),
+               PADDLE_TPU_RESTART_COUNT="1")   # never self-kill
+    for k in ("PADDLE_TPU_COORDINATOR", "PADDLE_TPU_NUM_PROCESSES",
+              "PADDLE_TPU_PROCESS_ID"):
+        env.pop(k, None)
+    subprocess.run([sys.executable, script], env=env, check=True,
+                   timeout=240)
+    got = np.load(kill_dir / "final.npz")
+    ref = np.load(ref_dir / "final.npz")
+    # 2-process Gloo reduction order vs single-process: f32 noise only
+    np.testing.assert_allclose(got["w"], ref["w"], rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(got["b"], ref["b"], rtol=1e-5, atol=1e-7)
+
+
 def test_cluster_worker_death_reaps_job_cleanly(tmp_path, monkeypatch):
     """Host-death behavior (doc/design/cluster_train/README.md
     trainer-as-stateless-task-consumer): SIGKILL one worker mid-run; the

@@ -159,5 +159,7 @@ def test_failing_op_names_itself_in_the_error():
                 feed={"x": np.zeros((2, 4), np.float32),
                       "y": np.zeros((2, 3), np.float32)},
                 fetch_list=[bad])
-    msg = str(ei.value)
+    # context arrives via add_note (3.11+) so the original exception object —
+    # and its structured args — survives; notes are not part of str()
+    msg = str(ei.value) + "\n".join(getattr(ei.value, "__notes__", []))
     assert "'concat'" in msg and "op chain" in msg

@@ -211,6 +211,23 @@ def test_ring_attention_exact(causal):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_zigzag_fallback_warns_and_stays_exact():
+    """T not divisible by 2*n forces the contiguous causal layout; the
+    fallback must be loud (it wastes ~half the FLOPs) and still correct."""
+    mesh = pp.make_mesh(seq=8)
+    rng = jax.random.PRNGKey(9)
+    kq, kk, kv = jax.random.split(rng, 3)
+    B, T, H, D = 1, 40, 2, 8          # 40 % 16 != 0 but 40 % 8 == 0
+    q = jax.random.normal(kq, (B, T, H, D))
+    k = jax.random.normal(kk, (B, T, H, D))
+    v = jax.random.normal(kv, (B, T, H, D))
+    with pytest.warns(UserWarning, match="CONTIGUOUS causal layout"):
+        out = pp.ring_self_attention(mesh, q, k, v, causal=True)
+    ref = _full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(jax.device_get(out), jax.device_get(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_blockwise_attention_matches_full(causal):
     rng = jax.random.PRNGKey(4)
@@ -275,6 +292,54 @@ def test_pipeline_trains():
         params2 = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg, params, g)
         l1 = loss(params2)
     assert float(l1) < float(l0)
+
+
+@pytest.mark.parametrize("n_micro", [4, 6])
+def test_pipeline_1f1b_matches_sequential_grads(n_micro):
+    """1F1B loss and per-stage grads equal the unpipelined computation.
+
+    Also the schedule-accounting claim: the timetable interleaves so at most
+    n_stages microbatch inputs are ever stashed (the buffer IS n_stages
+    slots), vs GPipe's all-M stash."""
+    S = 4
+    mesh = pp.make_mesh(pipe=S)
+    stage = pp.PipelineStage(lambda: Linear(8, 8, act=jnp.tanh), n_stages=S)
+    params = stage.init(jax.random.PRNGKey(11))
+    B = 8 * n_micro // 4
+    x = jax.random.normal(jax.random.PRNGKey(12), (B, 8))
+    y = jax.random.normal(jax.random.PRNGKey(13), (B, 8))
+
+    def stage_fn(p, mb):
+        return jnp.tanh(mb @ p["w"] + p["b"])
+
+    def loss_fn(out, y_mb):
+        return jnp.mean((out - y_mb) ** 2)
+
+    def seq_loss(params):
+        # mean of per-microbatch losses == 1F1B's accumulation
+        mbx = x.reshape(n_micro, B // n_micro, 8)
+        mby = y.reshape(n_micro, B // n_micro, 8)
+        total = 0.0
+        for m in range(n_micro):
+            h = mbx[m]
+            for si in range(S):
+                h = stage_fn(jax.tree_util.tree_map(lambda p: p[si], params),
+                             h)
+            total = total + loss_fn(h, mby[m])
+        return total / n_micro
+
+    ref_loss = seq_loss(params)
+    ref_grads = jax.grad(seq_loss)(params)
+
+    step = pp.pipeline_1f1b(stage_fn, loss_fn, mesh, n_microbatches=n_micro)
+    with mesh:
+        loss, grads = step(params, x, y)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda g, r: np.testing.assert_allclose(
+            jax.device_get(g), jax.device_get(r), rtol=1e-4, atol=1e-5),
+        grads, ref_grads)
 
 
 @pytest.mark.parametrize("causal", [False, True])
