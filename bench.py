@@ -1,30 +1,74 @@
 """Flagship benchmarks — prints one JSON line per metric.
 
-All three BASELINE.md headline configs run on the default jax device (the
-real TPU chip under the driver): ResNet-50 images/sec, seq2seq NMT tokens/sec,
-and — LAST, as the flagship line with a published reference number — LSTM
-text-classification ms/batch vs the K40m baseline (BASELINE.md: 83 ms/batch
-@ bs=64, hidden=256 — benchmark/README.md:115-119). vs_baseline > 1 means we
-are faster than the reference by that factor.
+Secondary metrics first; LAST is always the flagship LSTM text-classification
+row (BASELINE.md: 83 ms/batch @ bs=64, hidden=256 — benchmark/README.md:115-119),
+the line the driver's tail-parser records. vs_baseline > 1 means we are
+faster than the reference by that factor.
 
 Methodology notes live in each benchmarks/*.py docstring (varied lengths,
 train-mode BN with stat updates, distinct rotating device-staged batches,
 on-device-loop differencing timing).
 
-Default run = one representative row per family (fits the driver's timeout;
-round 3's full sweep hit rc=124). ``python bench.py --full`` runs every
-published reference row — use that when refreshing BASELINE.md.
+**Every row runs in its own WATCHDOG SUBPROCESS with a timeout + one retry.**
+The remote-tunnel transport can hang a compile RPC indefinitely (round 3's
+rc=124 was one such hang, observed again in round 4: a bench process blocked
+25+ minutes with ~0 CPU); an in-process retry loop cannot recover from a
+blocked C call, but killing the row's subprocess frees the chip for the next
+row, so one bad RPC costs a row instead of the round.
+
+Default run = one representative row per family (fits the driver's budget).
+``python bench.py --full`` runs every published reference row — use that
+when refreshing BASELINE.md.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-import traceback
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+ROW_TIMEOUT = 420.0        # compile (~40-90 s) + measure, with slack
+BIG_TIMEOUT = 900.0        # rows with heavy host-side setup (20 GB table)
+
+
+def _row(expr: str, timeout: float = ROW_TIMEOUT, tries: int = 2) -> bool:
+    """Run one bench row in a watchdog subprocess; print its JSON line(s).
+
+    Returns True if at least one metric line was printed."""
+    code = (f"import sys, json\nsys.path.insert(0, {ROOT!r})\n"
+            f"_r = {expr}\n"
+            "for _d in (_r if isinstance(_r, list) else [_r]):\n"
+            "    print(json.dumps(_d), flush=True)\n")
+    for attempt in range(tries):
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout, cwd=ROOT)
+        except subprocess.TimeoutExpired:
+            print(f"bench: row {expr!r} timed out after {timeout:.0f}s "
+                  f"(attempt {attempt + 1}/{tries}) — killed its process, "
+                  "chip freed", file=sys.stderr, flush=True)
+            continue
+        ok = False
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                print(line, flush=True)
+                ok = True
+        if ok:
+            return True
+        tail = "\n".join(r.stderr.splitlines()[-5:])
+        print(f"bench: row {expr!r} failed rc={r.returncode} "
+              f"(attempt {attempt + 1}/{tries}):\n{tail}",
+              file=sys.stderr, flush=True)
+        time.sleep(3)
+    return False
 
 
 def bench_mlp_fallback():
-    """Emergency fallback if every real bench fails."""
+    """Emergency fallback if the flagship row fails twice."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models import MnistMLP
@@ -55,78 +99,48 @@ def bench_mlp_fallback():
             "unit": "ms/batch", "vs_baseline": None}
 
 
-def _attempt(fn, tries: int = 2):
-    """Run a bench with one retry: the remote-tunnel transport occasionally
-    drops a compile RPC mid-flight, which must not cost the round a row."""
-    for t in range(tries):
-        try:
-            return fn()
-        except Exception:
-            traceback.print_exc()
-            if t + 1 < tries:
-                time.sleep(5)
-    return None
-
-
-# Representative rows per family for the default (driver-budget) run,
-# selected FROM the published tables so the reference numbers have one
-# source of truth. The full sweep (11 image rows, 9 LSTM rows) lives behind
-# --full and is what refreshes BASELINE.md; the default run must finish well
-# inside the driver's timeout (round 3 learned the hard way: rc=124).
+# Representative rows per family for the default (driver-budget) run; the
+# reference numbers live in the benchmarks' own tables (single source of
+# truth — the keys here only SELECT rows).
 QUICK_IMAGE_KEYS = {("alexnet", 256), ("googlenet", 128)}
 QUICK_LSTM_KEYS = {(128, 512)}
 
 
-def _quick(rows, keys):
-    return [r for r in rows if (r[0], r[1]) in keys]
-
-
 def main(full: bool = False):
-    flagship_ok = False
-    # secondary metrics first; the flagship (has a published baseline) last so
-    # it is the line the driver's tail-parser records
-    try:
-        from benchmarks.image_suite import ROWS, bench_row
-        for model_key, bs, ref_ms in (
-                ROWS if full else _quick(ROWS, QUICK_IMAGE_KEYS)):
-            rec = _attempt(lambda: bench_row(model_key, bs, ref_ms))
-            if rec is not None:
-                print(json.dumps(rec), flush=True)
-    except Exception:
-        traceback.print_exc()
-    try:
-        from benchmarks.lstm_textcls import SUITE_ROWS
-        from benchmarks.lstm_textcls import bench_row as lstm_row
-        for bs, hidden, ref_ms in (
-                SUITE_ROWS if full else _quick(SUITE_ROWS, QUICK_LSTM_KEYS)):
-            rec = _attempt(lambda: lstm_row(bs, hidden, ref_ms))
-            if rec is not None:
-                print(json.dumps(rec), flush=True)
-    except Exception:
-        traceback.print_exc()
-    names = ("transformer_lm", "resnet50", "seq2seq_nmt", "fused_rnn",
-             "lstm_textcls") if full else (
-        "transformer_lm", "resnet50", "seq2seq_nmt", "lstm_textcls")
-    for name in names:
-        try:
-            mod = __import__(f"benchmarks.{name}", fromlist=["run"])
-            rec = _attempt(mod.run)
-            if rec is not None:
-                print(json.dumps(rec), flush=True)
-            if name == "resnet50" and full:
-                rec2 = _attempt(mod.run_with_infeed)
-                if rec2 is not None:
-                    print(json.dumps(rec2), flush=True)
-            if name == "lstm_textcls" and rec is not None:
-                flagship_ok = True
-        except Exception:
-            traceback.print_exc()
+    from benchmarks.image_suite import ROWS as IMAGE_ROWS
+    from benchmarks.lstm_textcls import SUITE_ROWS as LSTM_ROWS
+
+    image = [r for r in IMAGE_ROWS
+             if full or (r[0], r[1]) in QUICK_IMAGE_KEYS]
+    lstm = [r for r in LSTM_ROWS if full or (r[0], r[1]) in QUICK_LSTM_KEYS]
+
+    for model_key, bs, ref in image:
+        _row(f"__import__('benchmarks.image_suite', fromlist=['x'])"
+             f".bench_row({model_key!r}, {bs}, {ref})")
+    for bs, hidden, ref in lstm:
+        _row(f"__import__('benchmarks.lstm_textcls', fromlist=['x'])"
+             f".bench_row({bs}, {hidden}, {ref})")
+
+    mods = ["transformer_lm", "resnet50", "seq2seq_nmt", "transformer_nmt",
+            "serving_decode"]
+    if full:
+        mods.append("fused_rnn")
+    for name in mods:
+        _row(f"__import__('benchmarks.{name}', fromlist=['x']).run()")
+    if full:
+        _row("__import__('benchmarks.resnet50', fromlist=['x'])"
+             ".run_with_infeed()")
+    _row("__import__('benchmarks.host_embedding', fromlist=['x']).run()",
+         timeout=BIG_TIMEOUT)
+
+    # the flagship — LAST, so the driver's tail-parse records it
+    flagship_ok = _row(
+        "__import__('benchmarks.lstm_textcls', fromlist=['x']).run()")
     if not flagship_ok:
         # guarantee the LAST line is flagship-or-fallback, never a secondary
-        # metric masquerading as the flagship in the driver's tail-parse
+        # metric masquerading as the flagship
         print(json.dumps(bench_mlp_fallback()), flush=True)
 
 
 if __name__ == "__main__":
-    import sys
     main(full="--full" in sys.argv)

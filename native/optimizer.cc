@@ -133,6 +133,7 @@ int pto_update_rows(void* h, const int* rows, const float* grad,
   const double lr = cur_lr(o);
   float* p = o->param.data();
   for (size_t r = 0; r < n_rows; r++) {
+    if (rows[r] < 0) return -1;  // unsigned wrap would bypass the range test
     size_t base = (size_t)rows[r] * width;
     if (base + width > o->n) return -1;
     const float* g = grad + r * width;
@@ -153,6 +154,24 @@ const float* pto_get_param(void* h, uint64_t* n) {
   auto* o = static_cast<Opt*>(h);
   *n = o->n;
   return o->param.data();
+}
+
+// Row gather from the [num_rows, width] param view — the touched-row
+// prefetch read of the host-offloaded embedding path (the pserver's
+// getParameterSparse role, ParameterServer2.h:510).
+int pto_get_rows(void* h, const int* rows, float* out, uint64_t n_rows,
+                 uint64_t width) {
+  auto* o = static_cast<Opt*>(h);
+  const float* p = o->param.data();
+  for (size_t r = 0; r < n_rows; r++) {
+    // negative check first: (size_t)(-1) * width wraps so that base + width
+    // == 0 passes the range test and reads before the buffer
+    if (rows[r] < 0) return -1;
+    size_t base = (size_t)rows[r] * width;
+    if (base + width > o->n) return -1;
+    std::memcpy(out + r * width, p + base, width * sizeof(float));
+  }
+  return 0;
 }
 
 // State serialization (serialization.h / OptimizerConfig.proto analog):

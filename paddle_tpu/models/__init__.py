@@ -8,6 +8,7 @@ from .image import (AlexNet, GoogleNet, LeNet, ResNet, SmallNet,
 from .mlp import MnistMLP
 from .seq2seq import AttentionSeq2Seq
 from .transformer import TransformerBlock, TransformerLM
+from .transformer_nmt import CrossAttentionBlock, TransformerSeq2Seq
 from .tagger import BiLSTMCRFTagger, LinearCRFTagger
 from .text_cls import BiLSTMTextCls, ConvTextCls, LSTMTextCls
 
@@ -16,4 +17,5 @@ __all__ = [
            "LSTMTextCls", "BiLSTMTextCls", "ConvTextCls",
            "AttentionSeq2Seq", "LinearCRFTagger", "BiLSTMCRFTagger",
            "Word2Vec", "Recommender", "DeepFM", "GAN", "VAE",
-           "TransformerLM", "TransformerBlock"]
+           "TransformerLM", "TransformerBlock",
+           "TransformerSeq2Seq", "CrossAttentionBlock"]

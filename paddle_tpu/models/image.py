@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
+from ..ops import conv as conv_ops
 from ..ops import loss as L
 from ..ops import pool as P
 
@@ -78,6 +79,51 @@ class _ConvBN(nn.Module):
 
     def __call__(self, params, x, train=False, mutable=None, **kw):
         h = self.conv(params["conv"], x)
+        h = self.bn(params["bn"], h, train=train, mutable=mutable)
+        return self.act(h) if self.act else h
+
+
+class _S2DStem(nn.Module):
+    """The 7x7/s2 ImageNet stem computed via an EXACT space-to-depth rewrite.
+
+    A direct 7x7 conv over 3 input channels feeds the MXU a contraction
+    depth of 3 — measured at ~9 TF/s (4.6% of v5e peak), the single worst
+    op in the ResNet-50 step (docs/design/conv_mfu.md). Rewriting the same
+    convolution over a 2x2 space-to-depth input view makes it a 4x4/s1
+    conv with contraction depth 4*4*12=192: identical math (the kernel is
+    the SAME [7,7,cin,cout] parameter, zero-padded to 8x8 and regrouped at
+    trace time, so init/checkpoints/TP rules are unchanged), MXU-shaped
+    execution. Equivalence is tested to f32 noise
+    (tests/test_models.py::test_resnet_s2d_stem_matches_direct_conv).
+    """
+
+    def __init__(self, cin, cout, act=None):
+        super().__init__()
+        # same module layout as the direct stem: params land in
+        # ["conv"]["w"] / ["bn"], checkpoint-compatible either way
+        self.conv = nn.Conv2D(cin, cout, 7, stride=2, padding=3, bias=False)
+        self.bn = nn.BatchNorm(cout)
+        self.act = act
+
+    def __call__(self, params, x, train=False, mutable=None, **kw):
+        B, H, W, C = x.shape
+        if H % 2 or W % 2:
+            h = self.conv(params["conv"], x)     # odd sizes: direct conv
+        else:
+            w7 = params["conv"]["w"]
+            cout = w7.shape[-1]
+            # out[h,w] = sum_{i,j<7} x[2h+i-3, 2w+j-3] K[i,j]; with a
+            # leading zero pad (i'=i+1 in 0..7) and i'=2a+p this is a 4x4
+            # valid conv over the 2x2 space-to-depth grid of x padded by 4
+            xp = jnp.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)))
+            hc, wc = (H + 8) // 2, (W + 8) // 2
+            x2 = xp.reshape(B, hc, 2, wc, 2, C).transpose(
+                0, 1, 3, 2, 4, 5).reshape(B, hc, wc, 4 * C)
+            w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+            w2 = w8.reshape(4, 2, 4, 2, C, cout).transpose(
+                0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * C, cout)
+            h = conv_ops.conv2d(x2, w2, stride=1, padding=0)
+            h = h[:, :H // 2, :W // 2]
         h = self.bn(params["bn"], h, train=train, mutable=mutable)
         return self.act(h) if self.act else h
 
@@ -173,14 +219,22 @@ class ResNet(nn.Module):
     """
 
     def __init__(self, depth: int = 50, classes: int = 1000, in_ch: int = 3,
-                 width_mult: float = 1.0, small_input: bool = False):
+                 width_mult: float = 1.0, small_input: bool = False,
+                 s2d_stem: bool = True):
         super().__init__()
         block, counts, expansion = _RESNET_CFG[depth]
         w = lambda ch: max(8, int(ch * width_mult))
         self.small_input = small_input
-        self.stem = (_ConvBN(in_ch, w(64), 3, stride=1, padding=1, act=jax.nn.relu)
-                     if small_input else
-                     _ConvBN(in_ch, w(64), 7, stride=2, padding=3, act=jax.nn.relu))
+        if small_input:
+            self.stem = _ConvBN(in_ch, w(64), 3, stride=1, padding=1,
+                                act=jax.nn.relu)
+        elif s2d_stem:
+            # exact space-to-depth execution of the same 7x7/s2 conv (MXU
+            # contraction 192 instead of 3 — docs/design/conv_mfu.md)
+            self.stem = _S2DStem(in_ch, w(64), act=jax.nn.relu)
+        else:
+            self.stem = _ConvBN(in_ch, w(64), 7, stride=2, padding=3,
+                                act=jax.nn.relu)
         c = w(64)
         self.layer_names: List[str] = []
         for li, (planes, n) in enumerate(zip([64, 128, 256, 512], counts)):

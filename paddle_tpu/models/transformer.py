@@ -27,11 +27,12 @@ from ..ops import pallas_kernels as pk
 
 class TransformerBlock(nn.Module):
     def __init__(self, d_model: int, n_heads: int, d_ff: int,
-                 init_std: float = 0.02):
+                 init_std: float = 0.02, causal: bool = True):
         super().__init__()
         assert d_model % n_heads == 0
         self.n_heads = n_heads
         self.d_head = d_model // n_heads
+        self.causal = causal
         self.ln1 = nn.LayerNorm(d_model)
         self.qkv = nn.Linear(d_model, 3 * d_model,
                              w_init=normal(0.0, init_std))
@@ -41,11 +42,18 @@ class TransformerBlock(nn.Module):
                                 w_init=normal(0.0, init_std))
         self.mlp_out = nn.Linear(d_ff, d_model, w_init=normal(0.0, init_std))
 
-    def attend(self, q, k, v, *, seq_axis: Optional[str] = None):
+    def attend(self, q, k, v, *, seq_axis: Optional[str] = None,
+               kv_lens=None):
         if seq_axis is not None:
+            if kv_lens is not None:
+                raise NotImplementedError(
+                    "per-sample kv_lens masking is not plumbed through ring "
+                    "attention; pad variable-length batches before sequence "
+                    "sharding or run without seq_axis")
             from ..parallel.ring_attention import ring_attention
-            return ring_attention(q, k, v, seq_axis, True)
-        return pk.flash_attention(q, k, v, causal=True)
+            return ring_attention(q, k, v, seq_axis, self.causal)
+        return pk.flash_attention(q, k, v, causal=self.causal,
+                                  kv_lens=kv_lens)
 
     def heads(self, params, x):
         """q, k, v as [B, T, H, Dh] from one fused qkv matmul."""
@@ -64,9 +72,9 @@ class TransformerBlock(nn.Module):
                                 self.mlp_in(params["mlp_in"], h))
 
     def __call__(self, params, x, *, seq_axis: Optional[str] = None,
-                 return_kv: bool = False, **kw):
+                 return_kv: bool = False, kv_lens=None, **kw):
         q, k, v = self.heads(params, x)
-        o = self.attend(q, k, v, seq_axis=seq_axis)
+        o = self.attend(q, k, v, seq_axis=seq_axis, kv_lens=kv_lens)
         out = self.finish(params, x, o)
         return (out, (k, v)) if return_kv else out
 
@@ -200,11 +208,20 @@ class TransformerLM(nn.Module):
                   if self.tie_head else self.head(params["head"], x))
         return cell, logits[:, -1]
 
-    def decode_step(self, params, cell, tokens):
+    def decode_step(self, params, cell, tokens, *,
+                    cache_len: Optional[int] = None):
         """One incremental step: tokens [B] -> (logits [B, V], new cell).
         Attention reads the KV cache (masked to written positions) instead
-        of re-running the prefix — O(T) per token instead of O(T^2)."""
+        of re-running the prefix — O(T) per token instead of O(T^2).
+
+        ``cache_len`` (static) bounds the cache READ to its first that-many
+        entries: the cache is stored padded to max_len, but a step whose
+        positions are all < cache_len only streams cache_len rows from HBM
+        instead of max_len — the bucketed serving path (callers guarantee
+        pos < cache_len; generate_cached's bucketing does)."""
         pos = cell["pos"]                                  # [B]
+        L = self.max_len if cache_len is None else min(cache_len,
+                                                       self.max_len)
         x = self.embed(params["embed"], tokens[:, None])   # [B, 1, D]
         x = x + params["pos_embed"][pos][:, None, :].astype(x.dtype)
         new_cell = {"pos": pos + 1}
@@ -218,22 +235,32 @@ class TransformerLM(nn.Module):
             vc = upd(cell[f"v{i}"], v[:, 0], pos)
             new_cell[f"k{i}"], new_cell[f"v{i}"] = kc, vc
             s = jnp.einsum("bhd,bshd->bhs", q[:, 0].astype(jnp.float32),
-                           kc.astype(jnp.float32)) / np.sqrt(blk.d_head)
-            valid = (jnp.arange(self.max_len)[None, :]
+                           kc[:, :L].astype(jnp.float32)) / np.sqrt(blk.d_head)
+            valid = (jnp.arange(L)[None, :]
                      <= pos[:, None])[:, None, :]
             s = jnp.where(valid, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhs,bshd->bhd", p,
-                           vc.astype(jnp.float32))[:, None]
+                           vc[:, :L].astype(jnp.float32))[:, None]
             x = blk.finish(params[f"blocks_{i}"], x, o)
         x = self.ln_f(params["ln_f"], x)
         logits = (x @ params["embed"]["w"].T.astype(x.dtype)
                   if self.tie_head else self.head(params["head"], x))
         return logits[:, 0], new_cell
 
-    def generate_cached(self, params, prompt, steps: int):
-        """Greedy continuation through the KV cache: one jitted scan, no
-        prefix re-forward. Matches generate_greedy token-for-token."""
+    def generate_cached(self, params, prompt, steps: int,
+                        bucket: Optional[int] = None):
+        """Greedy continuation through the KV cache: jitted scans, no
+        prefix re-forward. Matches generate_greedy token-for-token.
+
+        ``bucket``: bucketed cache reads — the decode is split into
+        segments whose attention reads only the next bucket-multiple of the
+        current position instead of the full max_len-padded cache. A
+        200-token decode at max_len 1024 with bucket 256 streams ~256-row
+        cache slices, not 1024 — the serving-path HBM saving
+        (benchmarks/serving_decode.py prints the bytes). One scan compiles
+        per touched bucket; token stream is identical to the unbucketed
+        path."""
         if prompt.shape[1] + steps > self.max_len:
             # past max_len JAX's clamped indexing would silently corrupt the
             # pos_embed lookup and cache writes (generate_greedy slides its
@@ -245,14 +272,35 @@ class TransformerLM(nn.Module):
         cell, last_logits = self.prefill(params, prompt)
         first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
 
-        def body(carry, _):
-            cell, cur = carry
-            logits, cell = self.decode_step(params, cell, cur)
-            nxt = jnp.argmax(logits, axis=-1).astype(cur.dtype)
-            return (cell, nxt), cur
+        def make_body(cache_len):
+            def body(carry, _):
+                cell, cur = carry
+                logits, cell = self.decode_step(params, cell, cur,
+                                                cache_len=cache_len)
+                nxt = jnp.argmax(logits, axis=-1).astype(cur.dtype)
+                return (cell, nxt), cur
+            return body
 
         # each iteration emits its INPUT token: cur_0 = first (from the
         # prompt's logits), cur_j = argmax of step j-1 — exactly the
         # `steps` generated tokens
-        _, toks = jax.lax.scan(body, (cell, first), None, length=steps)
-        return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
+        if bucket is None:
+            _, toks = jax.lax.scan(make_body(None), (cell, first), None,
+                                   length=steps)
+            toks = jnp.moveaxis(toks, 0, 1)
+        else:
+            pos = prompt.shape[1]          # max position before each segment
+            done, chunks, carry = 0, [], (cell, first)
+            while done < steps:
+                # positions this segment reads are < pos+1 .. so the read
+                # bound is the next bucket multiple that covers them
+                cache_len = min(-(-(pos + 1) // bucket) * bucket,
+                                self.max_len)
+                seg = min(steps - done, cache_len - pos)
+                carry, toks = jax.lax.scan(make_body(cache_len), carry,
+                                           None, length=seg)
+                chunks.append(jnp.moveaxis(toks, 0, 1))
+                done += seg
+                pos += seg
+            toks = jnp.concatenate(chunks, axis=1)
+        return jnp.concatenate([prompt, toks], axis=1)

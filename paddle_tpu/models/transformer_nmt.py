@@ -1,0 +1,167 @@
+"""Transformer encoder-decoder NMT — the flash-attention seq2seq.
+
+The reference's NMT is the additive-attention GRU seq2seq
+(trainer_config_helpers/networks.py simple_attention:654ff), kept for
+parity in :class:`~paddle_tpu.models.seq2seq.AttentionSeq2Seq`. That
+architecture's attention query is the recurrent state, so its FLOPs are
+trapped inside a sequential scan and no batched attention kernel can apply
+(measured roofline: docs/design/nmt_roofline.md). This model is the
+TPU-first NMT configuration: a standard pre-LN transformer encoder-decoder
+whose every attention — bidirectional encoder self-attention, causal
+decoder self-attention, and decoder->encoder cross-attention — goes
+through ``flash_attention`` (ops/pallas_kernels.py) with per-sample
+source-length masking (``kv_lens``), so variable-length batches never pay
+for padded keys in the softmax. At NMT-short lengths that call auto-routes
+to its fused dense path (the kernels' per-program overhead beats their HBM
+saving below ~256 — measured 1.56x end-to-end, docs/design/nmt_roofline.md);
+long-document NMT gets the Pallas kernels with the same masks.
+
+Teacher-forced training is one fully-parallel pass (no scan at all): every
+decoder position attends at once — this is what lifts NMT from the GRU
+model's recurrence-bound ~15% MFU toward the transformer LM's regime.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.lod import SeqBatch
+from ..nn.initializer import normal
+from ..ops import pallas_kernels as pk
+from .transformer import TransformerBlock
+
+
+class CrossAttentionBlock(nn.Module):
+    """Decoder block: causal self-attention, encoder cross-attention, FFN —
+    all pre-LN, attention through the flash kernel."""
+
+    def __init__(self, d_model: int, n_heads: int, d_ff: int,
+                 init_std: float = 0.02):
+        super().__init__()
+        assert d_model % n_heads == 0
+        self.n_heads, self.d_head = n_heads, d_model // n_heads
+        self.ln1 = nn.LayerNorm(d_model)
+        self.qkv = nn.Linear(d_model, 3 * d_model,
+                             w_init=normal(0.0, init_std))
+        self.self_proj = nn.Linear(d_model, d_model,
+                                   w_init=normal(0.0, init_std))
+        self.ln_x = nn.LayerNorm(d_model)
+        self.q_x = nn.Linear(d_model, d_model, w_init=normal(0.0, init_std))
+        self.kv_x = nn.Linear(d_model, 2 * d_model,
+                              w_init=normal(0.0, init_std))
+        self.x_proj = nn.Linear(d_model, d_model,
+                                w_init=normal(0.0, init_std))
+        self.ln2 = nn.LayerNorm(d_model)
+        self.mlp_in = nn.Linear(d_model, d_ff, act="gelu",
+                                w_init=normal(0.0, init_std))
+        self.mlp_out = nn.Linear(d_ff, d_model, w_init=normal(0.0, init_std))
+
+    def _split(self, t, n):
+        B, T, _ = t.shape
+        parts = jnp.split(t, n, axis=-1)
+        return [p.reshape(B, T, self.n_heads, self.d_head) for p in parts]
+
+    def __call__(self, params, x, memory, src_lens=None, **kw):
+        B, T, D = x.shape
+        # causal self-attention (keys past a sample's own length only meet
+        # queries past it, which the loss masks — no kv_lens needed)
+        q, k, v = self._split(self.qkv(params["qkv"],
+                                       self.ln1(params["ln1"], x)), 3)
+        o = pk.flash_attention(q, k, v, causal=True)
+        x = x + self.self_proj(params["self_proj"],
+                               o.reshape(B, T, D).astype(x.dtype))
+        # cross-attention over the encoder memory, source padding masked
+        # inside the kernel
+        qx = self._split(self.q_x(params["q_x"],
+                                  self.ln_x(params["ln_x"], x)), 1)[0]
+        kx, vx = self._split(self.kv_x(params["kv_x"], memory), 2)
+        ox = pk.flash_attention(qx, kx, vx, causal=False, kv_lens=src_lens)
+        x = x + self.x_proj(params["x_proj"],
+                            ox.reshape(B, T, D).astype(x.dtype))
+        h = self.ln2(params["ln2"], x)
+        return x + self.mlp_out(params["mlp_out"],
+                                self.mlp_in(params["mlp_in"], h))
+
+
+class TransformerSeq2Seq(nn.Module):
+    """Encoder-decoder NMT, every attention on the flash kernel."""
+
+    def __init__(self, src_vocab: int, trg_vocab: int, d_model: int = 512,
+                 n_heads: int = 8, n_enc: int = 6, n_dec: int = 6,
+                 d_ff: Optional[int] = None, max_len: int = 512):
+        super().__init__()
+        d_ff = d_ff or 4 * d_model
+        self.max_len = max_len
+        self.src_embed = nn.Embedding(src_vocab, d_model,
+                                      w_init=normal(0.0, 0.02))
+        self.trg_embed = nn.Embedding(trg_vocab, d_model,
+                                      w_init=normal(0.0, 0.02))
+        self.param("src_pos", (max_len, d_model), normal(0.0, 0.01))
+        self.param("trg_pos", (max_len, d_model), normal(0.0, 0.01))
+        self.enc_blocks = [TransformerBlock(d_model, n_heads, d_ff,
+                                            causal=False)
+                           for _ in range(n_enc)]
+        self.dec_blocks = [CrossAttentionBlock(d_model, n_heads, d_ff)
+                           for _ in range(n_dec)]
+        self.ln_enc = nn.LayerNorm(d_model)
+        self.ln_f = nn.LayerNorm(d_model)
+        # head tied to the target embedding (weight sharing)
+
+    def encode(self, params, src: SeqBatch):
+        B, S = src.data.shape
+        x = self.src_embed(params["src_embed"], src.data)
+        x = x + params["src_pos"][:S].astype(x.dtype)
+        for i in range(len(self.enc_blocks)):
+            x = self.enc_blocks[i](params[f"enc_blocks_{i}"], x,
+                                   kv_lens=src.lengths)
+        return self.ln_enc(params["ln_enc"], x)
+
+    def __call__(self, params, src: SeqBatch, trg_in: SeqBatch, **kw):
+        """Teacher-forced logits [B, T, V] — one parallel pass, no scan."""
+        memory = self.encode(params, src)
+        B, T = trg_in.data.shape
+        x = self.trg_embed(params["trg_embed"], trg_in.data)
+        x = x + params["trg_pos"][:T].astype(x.dtype)
+        for i in range(len(self.dec_blocks)):
+            x = self.dec_blocks[i](params[f"dec_blocks_{i}"], x, memory,
+                                   src_lens=src.lengths)
+        x = self.ln_f(params["ln_f"], x)
+        return x @ params["trg_embed"]["w"].T.astype(x.dtype)
+
+    def loss(self, params, src: SeqBatch, trg_in: SeqBatch,
+             trg_out: SeqBatch):
+        logits = self(params, src, trg_in)
+        l32 = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(l32, axis=-1)
+        gold = jnp.take_along_axis(l32, trg_out.data[..., None], -1)[..., 0]
+        nll = lse - gold
+        mask = trg_out.mask().astype(nll.dtype)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def greedy_generate(self, params, src: SeqBatch, *, max_len: int = 32,
+                        bos_id: int = 0, eos_id: int = 1):
+        """Greedy decode by re-forwarding the growing target prefix (the
+        correctness path; serving would add a KV cache as TransformerLM's
+        generate_cached does)."""
+        memory = self.encode(params, src)
+        B = src.batch_size
+        ids = jnp.full((B, 1), bos_id, jnp.int32)
+        done = jnp.zeros((B,), bool)
+        for _ in range(max_len):
+            T = ids.shape[1]
+            x = self.trg_embed(params["trg_embed"], ids)
+            x = x + params["trg_pos"][:T].astype(x.dtype)
+            for i in range(len(self.dec_blocks)):
+                x = self.dec_blocks[i](params[f"dec_blocks_{i}"], x, memory,
+                                       src_lens=src.lengths)
+            x = self.ln_f(params["ln_f"], x)
+            logits = x[:, -1] @ params["trg_embed"]["w"].T.astype(x.dtype)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        return ids[:, 1:]

@@ -26,15 +26,40 @@ def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
     ``momentum`` as in BatchNormBaseLayer.cpp)."""
     red = axis_mask if axis_mask is not None else tuple(range(x.ndim - 1))
     if train:
-        mean = jnp.mean(x, axis=red)
-        var = jnp.var(x, axis=red)
-        new_mean = momentum * running_mean + (1.0 - momentum) * mean
-        new_var = momentum * running_var + (1.0 - momentum) * var
+        # ONE pass over x: shifted sum and sum-of-squares reduce together
+        # (XLA fuses them into a single HBM read) with f32 accumulation even
+        # for bf16 activations — jnp.mean+jnp.var was 2-3 bf16 passes and
+        # measured ~40% of a ResNet-50 forward on v5e
+        # (docs/design/conv_mfu.md). Shifting by the RUNNING mean keeps the
+        # E[d^2]-E[d]^2 form numerically safe: the cancellation term
+        # (mean-shift)^2 is ~0 once the running stats track the batch, so
+        # the raw-moment formula's catastrophic f32 cancellation at
+        # |mean| >> std cannot occur
+        xf = x.astype(jnp.float32)
+        n = 1
+        for a in red:
+            n *= x.shape[a]
+        shift = jax.lax.stop_gradient(running_mean.astype(jnp.float32))
+        d = xf - shift
+        s1 = jnp.sum(d, axis=red)
+        s2 = jnp.sum(d * d, axis=red)
+        dm = s1 / n
+        mean = shift + dm
+        var = jnp.maximum(s2 / n - dm * dm, 0.0)
+        new_mean = (momentum * running_mean.astype(jnp.float32)
+                    + (1.0 - momentum) * mean).astype(running_mean.dtype)
+        new_var = (momentum * running_var.astype(jnp.float32)
+                   + (1.0 - momentum) * var).astype(running_var.dtype)
     else:
-        mean, var = running_mean, running_var
+        mean = running_mean.astype(jnp.float32)
+        var = running_var.astype(jnp.float32)
         new_mean, new_var = running_mean, running_var
     inv = jax.lax.rsqrt(var + eps)
-    y = (x - mean) * inv * gamma + beta
+    # scale-shift form: y = x*a + b is one FMA that fuses into the producing
+    # conv's epilogue, and keeps y in x's dtype (no f32 upcast of the tensor)
+    a = gamma.astype(jnp.float32) * inv
+    b = beta.astype(jnp.float32) - mean * a
+    y = x * a.astype(x.dtype) + b.astype(x.dtype)
     return y, new_mean, new_var
 
 

@@ -44,18 +44,22 @@ def _on_tpu() -> bool:
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                   scale: float, causal: bool, seq_len: int, true_len: int):
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, *,
+                   block_k: int, scale: float, causal: bool, seq_len: int,
+                   true_len: int):
     """One (batch*head, q-block) program: stream KV tiles, online softmax.
 
     q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, block_q, D];
     lse_ref: [1, block_q, 1] (f32 logsumexp residual for the backward pass;
     kept 3D with a trailing unit dim so the block obeys TPU tiling rules).
+    len_ref: [1, 1, 1] int32 — THIS sample's true kv length (variable-length
+    / LoD masking: keys at or past it never enter the softmax).
     """
     _, block_q, d = q_ref.shape
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    kv_len = jnp.minimum(len_ref[0, 0, 0], true_len)
 
     n_k = seq_len // block_k
 
@@ -67,7 +71,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                                 preferred_element_type=jnp.float32)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        valid = k_pos < true_len            # mask padded keys
+        valid = k_pos < kv_len              # mask padded + over-length keys
         if causal:
             valid = valid & (q_pos >= k_pos)
         s = jnp.where(valid, s, _NEG)
@@ -93,9 +97,9 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                      *, block_k: int, scale: float, causal: bool,
-                      seq_len: int, true_len: int):
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      len_ref, dq_ref, *, block_k: int, scale: float,
+                      causal: bool, seq_len: int, true_len: int):
     """dq for one (batch*head, q-block): recompute p tiles from saved lse.
 
     dS = P * (dO·Vᵀ − delta);   dQ = scale · dS·K.
@@ -107,6 +111,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     lse = lse_ref[0]                                # [block_q, 1]
     delta = delta_ref[0]                            # [block_q, 1]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    kv_len = jnp.minimum(len_ref[0, 0, 0], true_len)
 
     n_k = seq_len // block_k
 
@@ -117,7 +122,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        valid = k_pos < true_len
+        valid = k_pos < kv_len
         if causal:
             valid = valid & (q_pos >= k_pos)
         s = jnp.where(valid, s, _NEG)
@@ -134,7 +139,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, *, block_q: int, scale: float,
+                       len_ref, dk_ref, dv_ref, *, block_q: int, scale: float,
                        causal: bool, seq_len: int, true_len: int):
     """dk/dv for one (batch*head, kv-block): stream Q tiles.
 
@@ -147,7 +152,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
-    valid_k = k_pos < true_len
+    valid_k = k_pos < jnp.minimum(len_ref[0, 0, 0], true_len)
 
     n_q = seq_len // block_q
 
@@ -221,14 +226,31 @@ def _row_to_bh(x, Tp):
     return x[..., None]
 
 
-def _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
+def _lens_to_bh(kv_lens, B, H, S):
+    """Per-sample kv lengths -> [B*H, 1, 1] int32 (full length when None).
+
+    3D with two trailing unit dims: a block whose last two dims EQUAL the
+    array dims satisfies the TPU tiling rule, where a (1, 1) block over a
+    [B*H, 1] array does not (Mosaic requires the second-to-last block dim
+    to divide 8 or equal the array dim)."""
+    if kv_lens is None:
+        lens = jnp.full((B,), S, jnp.int32)
+    else:
+        lens = jnp.clip(kv_lens.astype(jnp.int32), 0, S)
+    return jnp.repeat(lens, H)[:, None, None]
+
+
+def _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret,
+                 kv_lens=None):
     """Returns (o [B,T,H,D], lse [B,T,H] f32). k/v may be shorter or longer
     than q (S != T) for cross-attention-shaped blocks; ``causal`` assumes
-    S == T."""
+    S == T. ``kv_lens`` [B] masks each sample's keys past its true length
+    (variable-length batches / cross-attention over padded sources)."""
     B, T, H, D = q.shape
     S = k.shape[1]
     blk_q, blk_k, Tp, Sp = _blocks(T, S, block_q, block_k)
     qb, kb, vb = _to_bh(q, Tp), _to_bh(k, Sp), _to_bh(v, Sp)
+    lensb = _lens_to_bh(kv_lens, B, H, S)
     kernel = functools.partial(_fa_fwd_kernel, block_k=blk_k, scale=scale,
                                causal=causal, seq_len=Sp, true_len=S)
     grid = (B * H, Tp // blk_q)
@@ -239,6 +261,7 @@ def _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, Sp, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, Sp, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda bh, qi: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0)),
@@ -249,14 +272,14 @@ def _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((B * H, Tp, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qb, kb, vb)
+    )(qb, kb, vb, lensb)
     o = _from_bh(out, B, T, H, D)
     lse = jnp.moveaxis(lse[:, :T, 0].reshape(B, H, T), 1, 2)
     return o, lse
 
 
 def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                 interpret, delta=None):
+                 interpret, delta=None, kv_lens=None):
     """Returns (dq, dk, dv); dq follows q's [B,T,H,D], dk/dv follow k/v's
     [B,S,H,D] (S != T for the zigzag half-block steps)."""
     B, T, H, D = q.shape
@@ -268,6 +291,7 @@ def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     qb, dob = _to_bh(q, Tp), _to_bh(do, Tp)
     kb, vb = _to_bh(k, Sp), _to_bh(v, Sp)
     lseb, deltab = _row_to_bh(lse, Tp), _row_to_bh(delta, Tp)
+    lensb = _lens_to_bh(kv_lens, B, H, S)
 
     q_spec = pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0))
     q_full_spec = pl.BlockSpec((1, Tp, D), lambda bh, i: (bh, 0, 0))
@@ -275,6 +299,7 @@ def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     row_q_spec = pl.BlockSpec((1, blk_q, 1), lambda bh, qi: (bh, qi, 0))
     row_full_spec = pl.BlockSpec((1, Tp, 1), lambda bh, i: (bh, 0, 0))
     k_spec = pl.BlockSpec((1, blk_k, D), lambda bh, ki: (bh, ki, 0))
+    len_spec = pl.BlockSpec((1, 1, 1), lambda bh, i: (bh, 0, 0))
 
     # dq: grid over q blocks, stream kv tiles (loop bound Sp, mask keys >= S)
     dq_kernel = functools.partial(_fa_bwd_dq_kernel, block_k=blk_k,
@@ -284,11 +309,11 @@ def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         dq_kernel,
         grid=(B * H, Tp // blk_q),
         in_specs=[q_spec, kv_full_spec, kv_full_spec, q_spec, row_q_spec,
-                  row_q_spec],
+                  row_q_spec, len_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
         interpret=interpret,
-    )(qb, kb, vb, dob, lseb, deltab)
+    )(qb, kb, vb, dob, lseb, deltab, lensb)
 
     # dk/dv: grid over kv blocks, stream q tiles (loop bound Tp; padded q
     # rows have zero do/delta so they contribute nothing); mask keys >= S
@@ -299,12 +324,12 @@ def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         dkv_kernel,
         grid=(B * H, Sp // blk_k),
         in_specs=[q_full_spec, k_spec, k_spec, q_full_spec, row_full_spec,
-                  row_full_spec],
+                  row_full_spec, len_spec],
         out_specs=[k_spec, k_spec],
         out_shape=[jax.ShapeDtypeStruct((B * H, Sp, D), k.dtype),
                    jax.ShapeDtypeStruct((B * H, Sp, D), v.dtype)],
         interpret=interpret,
-    )(qb, kb, vb, dob, lseb, deltab)
+    )(qb, kb, vb, dob, lseb, deltab, lensb)
 
     return (_from_bh(dq, B, T, H, D), _from_bh(dk, B, S, H, D),
             _from_bh(dv, B, S, H, D))
@@ -324,24 +349,64 @@ def _default_blocks(block_q: Optional[int],
     return block_q or 512, block_k or 1024
 
 
+# below this sequence length the Pallas kernels' per-program overhead beats
+# their HBM saving on this chip (128-tile flash measured 5x slower than
+# 512/1024 tiles; at S<=256 the whole [T,S] score tile fits comfortably in
+# VMEM through XLA fusion anyway) — a masked dense einsum is faster
+SHORT_SEQ_DENSE = 256
+
+
+def _dense_attention(q, k, v, causal, scale, kv_lens):
+    """Masked dense attention for short sequences — same semantics as the
+    flash kernels (causal + per-sample kv_lens), ordinary autodiff."""
+    T, S = q.shape[1], k.shape[1]
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if kv_lens is not None:
+        ok = (jnp.arange(S)[None, :]
+              < jnp.clip(kv_lens, 0, S)[:, None])[:, None, None, :]
+        s = jnp.where(ok, s, _NEG)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, scale: Optional[float] = None,
+                    kv_lens: Optional[jax.Array] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Fused attention. q/k/v: [B, T, H, D] -> [B, T, H, D].
+    """Fused attention. q: [B, T, H, D], k/v: [B, S, H, D] -> [B, T, H, D]
+    (S != T = cross attention).
 
     T is padded to a block multiple internally; padded keys are masked in the
-    kernel. Fully differentiable: the VJP runs dedicated Pallas dq and dk/dv
-    kernels that recompute probability tiles in VMEM from the saved logsumexp
-    — no [T, T] matrix in HBM in either direction.
+    kernel. ``kv_lens`` [B] int additionally masks each sample's keys at or
+    past its true length — the variable-length (LoD) batch and padded-source
+    cross-attention path; grads for masked keys are exactly zero. Fully
+    differentiable: the VJP runs dedicated Pallas dq and dk/dv kernels that
+    recompute probability tiles in VMEM from the saved logsumexp — no [T, S]
+    matrix in HBM in either direction.
+
+    Short sequences (max(T, S) < SHORT_SEQ_DENSE, no explicit blocks given)
+    auto-route to a masked dense einsum: below that point the kernels'
+    per-program overhead exceeds their HBM saving (measured — the NMT
+    len-64 shapes; docs/design/nmt_roofline.md), and XLA's fusion keeps the
+    small score tensor out of HBM anyway.
     """
     D = q.shape[-1]
     scale_v = scale if scale is not None else D ** -0.5
+    if (block_q is None and block_k is None
+            and max(q.shape[1], k.shape[1]) < SHORT_SEQ_DENSE):
+        return _dense_attention(q, k, v, causal, scale_v, kv_lens)
     block_q, block_k = _default_blocks(block_q, block_k)
     if interpret is None:
         interpret = not _on_tpu()
-    return _flash(q, k, v, causal, scale_v, block_q, block_k, bool(interpret))
+    return _flash(q, k, v, kv_lens, causal, scale_v, block_q, block_k,
+                  bool(interpret))
 
 
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -388,31 +453,24 @@ def flash_block_grads(q, k, v, o, lse, do, *, causal: bool = False,
                         block_k, bool(interpret), delta=delta)
 
 
-def _attention_reference(q, k, v, causal, scale):
-    s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
-    if causal:
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhts,bshd->bthd", p, v)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, _ = _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
+    o, _ = _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret,
+                        kv_lens=kv_lens)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, lse = _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
+    o, lse = _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret,
+                          kv_lens=kv_lens)
+    return o, (q, k, v, kv_lens, o, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
-    return _fa_bwd_call(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-                        interpret)
+    q, k, v, kv_lens, o, lse = res
+    dq, dk, dv = _fa_bwd_call(q, k, v, o, lse, g, causal, scale, block_q,
+                              block_k, interpret, kv_lens=kv_lens)
+    return dq, dk, dv, None                  # int lens: no cotangent
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -9,9 +9,10 @@ pserver getParameterSparse).
 
 TPU-native design (SURVEY §7): embedding tables live sharded on HBM; the "sparse
 gradient" is (ids, grad_rows) pairs and the optimizer applies a row-gathered update
-with scatter-add HLO — no pserver. For tables larger than HBM the host-offload
-variant keeps the table in host memory and streams touched rows (left for the
-multi-host milestone).
+with scatter-add HLO — no pserver. For tables larger than HBM,
+:mod:`paddle_tpu.runtime.host_embedding` keeps the master table in host memory
+(native HostOptimizer storage) and streams only each batch's touched rows to the
+device, with an exactness-preserving overlapped prefetcher.
 """
 
 from __future__ import annotations

@@ -18,8 +18,11 @@ from .arena import HostArena
 from .optimizer import HostOptimizer
 from .lease import FileLease, LeaseKeeper
 from .coord import CoordServer, NetworkFencedStore, NetworkLease
+from .host_embedding import (HostEmbedBatch, HostEmbeddingTable,
+                             HostEmbedPrefetcher)
 
 __all__ = ["load_library", "native_available", "TaskMaster",
            "FileLease", "LeaseKeeper",
            "CoordServer", "NetworkLease", "NetworkFencedStore",
+           "HostEmbeddingTable", "HostEmbedBatch", "HostEmbedPrefetcher",
            "RecordReader", "RecordWriter", "HostArena", "HostOptimizer"]

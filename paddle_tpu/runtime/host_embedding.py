@@ -1,0 +1,206 @@
+"""Host-offloaded embedding tables: >HBM tables streamed by touched rows.
+
+The reference's sparse-remote path ships only the rows a batch touches
+between trainer and pserver so the table never has to fit in device memory
+(trainer/RemoteParameterUpdater.h:265 SparseRemoteParameterUpdater,
+pserver/ParameterServer2.h:510 getParameterSparse,
+pserver/SparseParameterDistribution.cpp splits the vocab across pservers).
+TPU-native mapping: the master table lives in HOST RAM inside a
+:class:`~paddle_tpu.runtime.optimizer.HostOptimizer` (native f32 storage
+with sparse row updates, native/optimizer.cc), and each step:
+
+1. **prefetch** — ``np.unique(ids)`` -> ``pto_get_rows`` gathers the C
+   touched rows -> one small [capacity, D] device array (padded to a
+   static capacity so the jitted step never re-traces);
+2. **device step** — the lookup is ``rows[inverse]``, a dense gather the
+   model differentiates; the grad w.r.t. ``rows`` IS the merged
+   SelectedRows gradient (duplicate ids already summed by autodiff);
+3. **apply** — ``pto_update_rows`` updates only the touched rows on host.
+
+:class:`HostEmbedPrefetcher` overlaps step 1 for batch i+1 with the device
+compute of batch i WITHOUT the pserver path's staleness: the speculative
+gather happens concurrently, and after batch i's update lands, the (usually
+small) intersection of batch i's touched rows with batch i+1's prefetch is
+re-gathered and patched — every step reads exactly the post-update table,
+so the offloaded path is bit-equivalent to an on-HBM table (verified in
+tests/test_host_embedding.py).
+
+Multi-host: split the vocab range across hosts (each host owns
+``vocab/num_hosts`` rows in its own HostOptimizer) and route each unique id
+to its owner — the SparseParameterDistribution layout; the per-host
+machinery below is unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .optimizer import HostOptimizer
+
+
+@dataclass
+class HostEmbedBatch:
+    """One batch's streamed slice of the table."""
+
+    rows: "jax.Array"        # [capacity, D] on device (f32 or bf16)
+    inverse: "jax.Array"     # ids.shape, int32 — indices into rows
+    unique: np.ndarray       # [capacity] host ids (padded with 0)
+    count: int               # number of REAL unique ids (<= capacity)
+
+
+class HostEmbeddingTable:
+    """A vocab x dim table resident in host memory, streamed by touched rows.
+
+    ``capacity`` is the static per-batch unique-row budget (pad target); it
+    bounds the device working set at ``capacity * dim`` regardless of vocab
+    size. ``compute_dtype`` controls the streamed copy (bf16 halves H2D
+    bytes; the host master and updates stay f32).
+    """
+
+    def __init__(self, vocab_size: int, dim: int, *, optimizer: str = "sgd",
+                 lr: float = 0.01, capacity: int = 4096,
+                 compute_dtype=None, init: Optional[np.ndarray] = None,
+                 seed: int = 0, **opt_kw):
+        self.vocab_size, self.dim, self.capacity = vocab_size, dim, capacity
+        if init is None:
+            rs = np.random.RandomState(seed)
+            init = (rs.standard_normal((vocab_size, dim)) * 0.01).astype(
+                np.float32)
+        self.opt = HostOptimizer(optimizer, init, lr=lr, **opt_kw)
+        # np.dtype resolves jnp.bfloat16 via ml_dtypes; f32 = exact master
+        self.compute_dtype = np.dtype(compute_dtype if compute_dtype
+                                      is not None else np.float32)
+
+    # -- step protocol ------------------------------------------------------
+    def prefetch(self, ids: np.ndarray) -> HostEmbedBatch:
+        """Gather the batch's touched rows to the device (padded)."""
+        import jax
+
+        unique, inverse = np.unique(np.asarray(ids), return_inverse=True)
+        if unique.size > self.capacity:
+            raise ValueError(
+                f"batch touches {unique.size} unique rows > capacity "
+                f"{self.capacity}; raise capacity (device working set is "
+                f"capacity*dim)")
+        padded = np.zeros(self.capacity, np.int32)
+        padded[:unique.size] = unique
+        rows = self.opt.get_rows(padded, self.dim)
+        return HostEmbedBatch(
+            rows=jax.device_put(rows.astype(self.compute_dtype)),
+            inverse=jax.device_put(
+                inverse.reshape(np.shape(ids)).astype(np.int32)),
+            unique=padded, count=int(unique.size))
+
+    @staticmethod
+    def lookup(rows, inverse):
+        """Device-side lookup — differentiable; grad wrt ``rows`` is the
+        merged SelectedRows gradient."""
+        import jax.numpy as jnp
+        return jnp.take(rows, inverse, axis=0)
+
+    def apply_grad(self, batch: HostEmbedBatch, grad_rows) -> None:
+        """Apply the [capacity, D] device grad to the host master rows.
+        Padded tail rows receive exactly-zero grads from autodiff (no
+        inverse index maps to them) but are sliced off anyway so adagrad
+        accumulators never see even a zero step for untouched rows."""
+        import jax
+        g = np.asarray(jax.device_get(grad_rows), np.float32)
+        self.opt.update_rows(batch.unique[:batch.count], g[:batch.count])
+
+    # -- inspection / checkpoint -------------------------------------------
+    def rows_host(self, ids: np.ndarray) -> np.ndarray:
+        return self.opt.get_rows(np.asarray(ids, np.int32), self.dim)
+
+    def serialize(self) -> bytes:
+        return self.opt.serialize()
+
+    def deserialize(self, blob: bytes) -> None:
+        self.opt.deserialize(blob)
+
+
+class HostEmbedPrefetcher:
+    """Exactness-preserving overlap of host gather/H2D with device compute.
+
+    Usage::
+
+        pf = HostEmbedPrefetcher(table, ids_iterator)
+        for _ in range(steps):
+            batch = pf.next()              # rows already on device
+            grads, aux = device_step(batch.rows, batch.inverse, ...)
+            pf.commit(batch, grads)        # update + patch next prefetch
+
+    ``next()`` kicks off the gather for the FOLLOWING batch on a worker
+    thread, so it runs while the devices compute. ``commit`` applies the
+    sparse update, then re-gathers and patches the rows of the pending
+    prefetch that this update touched (intersection fix-up) — the pending
+    batch becomes exactly post-update, with only the intersection paying a
+    second (tiny) H2D.
+    """
+
+    def __init__(self, table: HostEmbeddingTable, ids_iter: Iterator):
+        self.table = table
+        self._ids_iter = iter(ids_iter)
+        self._pending: Optional[Tuple[HostEmbedBatch, threading.Event]] = None
+        self._kick()
+
+    def _kick(self):
+        try:
+            ids = next(self._ids_iter)
+        except StopIteration:
+            self._pending = None
+            return
+        done = threading.Event()
+        holder = [None, None]                     # batch, exception
+
+        def work():
+            try:
+                holder[0] = self.table.prefetch(ids)
+            except BaseException as e:            # surfaced in next()
+                holder[1] = e
+            done.set()
+
+        threading.Thread(target=work, daemon=True).start()
+        self._pending = (holder, done)
+
+    def next(self) -> Optional[HostEmbedBatch]:
+        if self._pending is None:
+            return None
+        holder, done = self._pending
+        done.wait()
+        if holder[1] is not None:
+            raise holder[1]
+        batch = holder[0]
+        self._kick()                              # overlap the NEXT gather
+        return batch
+
+    def commit(self, batch: HostEmbedBatch, grad_rows) -> None:
+        pend = self._pending
+        if pend is not None:
+            # the speculative gather must FINISH before the update mutates
+            # the table: pto_update_rows and pto_get_rows both release the
+            # GIL, so overlapping them on shared rows would be a C-level
+            # data race. The gather's overlap window was the device compute
+            # that already happened, so this wait is ~free.
+            pend[1].wait()
+        self.table.apply_grad(batch, grad_rows)
+        if pend is None:
+            return
+        holder, done = pend
+        if holder[1] is not None:                 # gather failed:
+            return                                # next() will raise it
+        nxt: HostEmbedBatch = holder[0]
+        # fix-up: rows the just-applied update touched that the pending
+        # prefetch had already (speculatively) read
+        touched = np.intersect1d(batch.unique[:batch.count],
+                                 nxt.unique[:nxt.count])
+        if touched.size:
+            import jax
+            pos = np.searchsorted(nxt.unique[:nxt.count], touched)
+            fresh = self.table.opt.get_rows(touched, self.table.dim)
+            dt = nxt.rows.dtype
+            nxt.rows = nxt.rows.at[jax.device_put(pos.astype(np.int32))].set(
+                jax.device_put(fresh.astype(dt)))

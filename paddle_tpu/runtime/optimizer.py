@@ -34,6 +34,9 @@ def _configure(lib):
                                     c.POINTER(c.c_float), c.c_uint64, c.c_uint64]
     lib.pto_get_param.restype = c.POINTER(c.c_float)
     lib.pto_get_param.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+    lib.pto_get_rows.restype = c.c_int
+    lib.pto_get_rows.argtypes = [c.c_void_p, c.POINTER(c.c_int),
+                                 c.POINTER(c.c_float), c.c_uint64, c.c_uint64]
     lib.pto_state_size.restype = c.c_uint64
     lib.pto_state_size.argtypes = [c.c_void_p]
     lib.pto_serialize.restype = c.c_int
@@ -88,6 +91,19 @@ class HostOptimizer:
         if rc != 0:
             raise RuntimeError(f"sparse update failed ({rc}): "
                                f"{self.opt_type} may not support row updates")
+
+    def get_rows(self, rows: np.ndarray, width: int) -> np.ndarray:
+        """Gather rows of the param viewed as [num_rows, width] — the
+        touched-row prefetch read (pserver getParameterSparse role)."""
+        rows = np.ascontiguousarray(rows, np.int32)
+        out = np.empty((rows.size, width), np.float32)
+        rc = self._lib.pto_get_rows(
+            self._h, rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows.size, width)
+        if rc != 0:
+            raise IndexError("row gather out of range")
+        return out
 
     @property
     def param(self) -> np.ndarray:

@@ -247,6 +247,23 @@ def test_cached_decode_matches_full_reforward():
         np.testing.assert_array_equal(got, want)
 
 
+def test_bucketed_cached_decode_matches_unbucketed():
+    """Bucketed cache reads (the serving HBM saving) must produce the
+    identical token stream, including across bucket boundaries and with the
+    overflow guard intact."""
+    import pytest
+
+    model, params = _model(max_len=32)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (2, 5), 0, V)
+    want = np.asarray(model.generate_cached(params, prompt, steps=20))
+    for bucket in (8, 16, 32):   # 5+20=25 crosses several 8-boundaries
+        got = np.asarray(model.generate_cached(params, prompt, steps=20,
+                                               bucket=bucket))
+        np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError, match="max_len"):
+        model.generate_cached(params, prompt, steps=30, bucket=8)
+
+
 def test_prefill_logits_match_forward():
     model, params = _model(max_len=32)
     prompt = jax.random.randint(jax.random.PRNGKey(10), (2, 7), 0, V)
