@@ -16,6 +16,10 @@ rc=124 was one such hang, observed again in round 4: a bench process blocked
 blocked C call, but killing the row's subprocess frees the chip for the next
 row, so one bad RPC costs a row instead of the round.
 
+**The flagship row is measured FIRST but printed LAST** via an atexit +
+SIGTERM hook: if the driver's timeout reaps the run mid-suite, the final
+printed line is still the flagship (only SIGKILL can break the contract).
+
 Default run = one representative row per family (fits the driver's budget).
 ``python bench.py --full`` runs every published reference row — use that
 when refreshing BASELINE.md.
@@ -23,8 +27,10 @@ when refreshing BASELINE.md.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -32,39 +38,60 @@ import time
 ROOT = os.path.dirname(os.path.abspath(__file__))
 ROW_TIMEOUT = 420.0        # compile (~40-90 s) + measure, with slack
 BIG_TIMEOUT = 900.0        # rows with heavy host-side setup (20 GB table)
+# Global wall budget for the SECONDARY rows: the flagship is measured first
+# and guaranteed; once the budget is gone the remaining secondaries are
+# skipped (loudly) and the run exits 0 — rc=0 + flagship-last hold even
+# when the tunnel runs 2-3x slower than usual (observed round 4 evenings).
+# The full-suite refresh (--full) can raise it via env.
+BUDGET_S = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "1500"))
 
 
-def _row(expr: str, timeout: float = ROW_TIMEOUT, tries: int = 2) -> bool:
-    """Run one bench row in a watchdog subprocess; print its JSON line(s).
+# the live watchdog child, visible to the SIGTERM handler: on a driver
+# kill the in-flight row's subprocess MUST die too, or it keeps the chip
+# open after bench.py reports a clean run and the next round blocks on it
+_current_child = None
 
-    Returns True if at least one metric line was printed."""
+
+def _capture_row(expr: str, timeout: float = ROW_TIMEOUT,
+                 tries: int = 2) -> list:
+    """Run one bench row in a watchdog subprocess; return its JSON lines."""
+    global _current_child
     code = (f"import sys, json\nsys.path.insert(0, {ROOT!r})\n"
             f"_r = {expr}\n"
             "for _d in (_r if isinstance(_r, list) else [_r]):\n"
             "    print(json.dumps(_d), flush=True)\n")
     for attempt in range(tries):
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True, cwd=ROOT)
+        _current_child = p
         try:
-            r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True,
-                               timeout=timeout, cwd=ROOT)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            _current_child = None
             print(f"bench: row {expr!r} timed out after {timeout:.0f}s "
                   f"(attempt {attempt + 1}/{tries}) — killed its process, "
                   "chip freed", file=sys.stderr, flush=True)
             continue
-        ok = False
-        for line in r.stdout.splitlines():
-            if line.startswith("{"):
-                print(line, flush=True)
-                ok = True
-        if ok:
-            return True
-        tail = "\n".join(r.stderr.splitlines()[-5:])
-        print(f"bench: row {expr!r} failed rc={r.returncode} "
+        _current_child = None
+        lines = [l for l in out.splitlines() if l.startswith("{")]
+        if lines:
+            return lines
+        tail = "\n".join(err.splitlines()[-5:])
+        print(f"bench: row {expr!r} failed rc={p.returncode} "
               f"(attempt {attempt + 1}/{tries}):\n{tail}",
               file=sys.stderr, flush=True)
         time.sleep(3)
-    return False
+    return []
+
+
+def _row(expr: str, timeout: float = ROW_TIMEOUT, tries: int = 2) -> bool:
+    lines = _capture_row(expr, timeout, tries)
+    for line in lines:
+        print(line, flush=True)
+    return bool(lines)
 
 
 def bench_mlp_fallback():
@@ -107,39 +134,109 @@ QUICK_LSTM_KEYS = {(128, 512)}
 
 
 def main(full: bool = False):
+    t0 = time.monotonic()      # the budget covers the WHOLE run
     from benchmarks.image_suite import ROWS as IMAGE_ROWS
+    from benchmarks.lstm_textcls import FLAGSHIP_METRIC
     from benchmarks.lstm_textcls import SUITE_ROWS as LSTM_ROWS
 
+    # ---- the last-line contract is armed BEFORE any chip work: on ANY
+    # exit (normal, SIGTERM/SIGINT from the driver's timeout, unhandled
+    # exception) the last stdout line is the flagship row. The handler
+    # uses raw os.write — a signal landing mid-print of a secondary row
+    # would make print() raise CPython's reentrant-buffered-IO guard and
+    # lose the line — and marks itself done only AFTER the write, so the
+    # atexit copy retries if the handler ever failed. If the kill lands
+    # before the flagship measurement finishes, an honest null-value row
+    # is emitted (never a fabricated number). Only SIGKILL can break this.
+    flagship = []          # JSON lines, filled once measured
+    _done = []
+
+    def _emit_flagship():
+        if _done:
+            return
+        lines = flagship or [json.dumps(
+            {"metric": FLAGSHIP_METRIC,
+             "value": None, "unit": "ms/batch", "vs_baseline": None,
+             "note": "killed before the flagship measurement completed"})]
+        # leading \n: stdout may hold a partially-printed secondary row
+        os.write(1, ("\n" + "\n".join(lines) + "\n").encode())
+        _done.append(True)
+
+    atexit.register(_emit_flagship)
+
+    def _on_term(signum, frame):
+        child = _current_child
+        if child is not None:
+            try:
+                child.kill()     # free the chip before reporting success
+            except OSError:
+                pass
+        _emit_flagship()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    # ---- flagship FIRST (it is the cheapest row), printed LAST via the
+    # hook above — the round-3 rc=124 failure mode (a wrong row in the
+    # driver's tail-parse) cannot recur short of SIGKILL.
+    flagship += _capture_row(
+        "__import__('benchmarks.lstm_textcls', fromlist=['x']).run()")
+    if not flagship:
+        # the fallback runs under the same subprocess watchdog — an
+        # in-process hung compile RPC here would block the whole suite
+        flagship += _capture_row(
+            "__import__('bench').bench_mlp_fallback()", tries=1)
+    if not flagship:
+        print("bench: flagship AND fallback failed — the null row will be "
+              "the last line", file=sys.stderr, flush=True)
+
+    # ---- secondary metrics, printed as they complete, within the budget
     image = [r for r in IMAGE_ROWS
              if full or (r[0], r[1]) in QUICK_IMAGE_KEYS]
     lstm = [r for r in LSTM_ROWS if full or (r[0], r[1]) in QUICK_LSTM_KEYS]
 
+    rows = []
     for model_key, bs, ref in image:
-        _row(f"__import__('benchmarks.image_suite', fromlist=['x'])"
-             f".bench_row({model_key!r}, {bs}, {ref})")
+        rows.append((f"__import__('benchmarks.image_suite', fromlist=['x'])"
+                     f".bench_row({model_key!r}, {bs}, {ref})", ROW_TIMEOUT))
     for bs, hidden, ref in lstm:
-        _row(f"__import__('benchmarks.lstm_textcls', fromlist=['x'])"
-             f".bench_row({bs}, {hidden}, {ref})")
-
+        rows.append((f"__import__('benchmarks.lstm_textcls', fromlist=['x'])"
+                     f".bench_row({bs}, {hidden}, {ref})", ROW_TIMEOUT))
     mods = ["transformer_lm", "resnet50", "seq2seq_nmt", "transformer_nmt",
             "serving_decode"]
     if full:
         mods.append("fused_rnn")
     for name in mods:
-        _row(f"__import__('benchmarks.{name}', fromlist=['x']).run()")
+        rows.append((f"__import__('benchmarks.{name}', fromlist=['x'])"
+                     ".run()", ROW_TIMEOUT))
     if full:
-        _row("__import__('benchmarks.resnet50', fromlist=['x'])"
-             ".run_with_infeed()")
-    _row("__import__('benchmarks.host_embedding', fromlist=['x']).run()",
-         timeout=BIG_TIMEOUT)
+        rows.append(("__import__('benchmarks.resnet50', fromlist=['x'])"
+                     ".run_with_infeed()", ROW_TIMEOUT))
+    rows.append(("__import__('benchmarks.host_embedding', fromlist=['x'])"
+                 ".run()", BIG_TIMEOUT))
 
-    # the flagship — LAST, so the driver's tail-parse records it
-    flagship_ok = _row(
-        "__import__('benchmarks.lstm_textcls', fromlist=['x']).run()")
-    if not flagship_ok:
-        # guarantee the LAST line is flagship-or-fallback, never a secondary
-        # metric masquerading as the flagship
-        print(json.dumps(bench_mlp_fallback()), flush=True)
+    budget = float("inf") if full else BUDGET_S
+    for expr, timeout in rows:
+        left = budget - (time.monotonic() - t0)
+        if left < 90:
+            print(f"bench: budget exhausted ({BUDGET_S:.0f}s) — skipping "
+                  f"remaining secondary rows from {expr!r} on; the flagship "
+                  "was measured first and prints last (raise "
+                  "PADDLE_TPU_BENCH_BUDGET_S or use --full for the long "
+                  "suite)", file=sys.stderr, flush=True)
+            break
+        if left < 0.5 * timeout:
+            # a clamped window well below the row's declared timeout is a
+            # guaranteed timeout (compile alone is 40-90 s) — skip rather
+            # than burn the budget tail measuring nothing
+            print(f"bench: skipping {expr!r} — needs ~{timeout:.0f}s, only "
+                  f"{left:.0f}s of budget left", file=sys.stderr, flush=True)
+            continue
+        # --full is the BASELINE.md refresh: keep the one flaky-RPC retry
+        # there; the budgeted default spends its time on coverage instead
+        _row(expr, timeout=min(timeout, left), tries=2 if full else 1)
+    # atexit prints the flagship as the last line
 
 
 if __name__ == "__main__":

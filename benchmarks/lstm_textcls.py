@@ -99,6 +99,13 @@ def build(batch_size: int = BATCH, hidden: int = HIDDEN):
     return run_n, step_fn, params, state, (data, lengths, labels)
 
 
+# metric key carries the methodology (len30-100 varied) — renamed from the
+# round-1 all-len-100 key so trend tracking can't silently mix semantics.
+# bench.py imports this for its killed-before-measurement null row, so the
+# key lives in ONE place.
+FLAGSHIP_METRIC = "lstm_textcls_train_ms_per_batch_bs64_h256_len30-100"
+
+
 def run(iters: int = 100, repeats: int = 3):
     """Difference a short and a long on-device loop so the fixed dispatch +
     host-fetch latency (large under the remote tunnel, where block_until_ready
@@ -111,10 +118,8 @@ def run(iters: int = 100, repeats: int = 3):
                              short=2)
     flops = step_flops(step_fn, params, state, batch[0][0], batch[1][0],
                        batch[2][0])
-    # metric key carries the methodology (len30-100 varied) — renamed from the
-    # round-1 all-len-100 key so trend tracking can't silently mix semantics
     return attach_mfu(
-        {"metric": "lstm_textcls_train_ms_per_batch_bs64_h256_len30-100",
+        {"metric": FLAGSHIP_METRIC,
          "value": round(ms, 3), "unit": "ms/batch",
          "vs_baseline": round(BASELINE_MS / ms, 3),
          "note": "varied lengths 30..100, 8 distinct rotating batches; "

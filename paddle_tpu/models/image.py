@@ -83,51 +83,6 @@ class _ConvBN(nn.Module):
         return self.act(h) if self.act else h
 
 
-class _S2DStem(nn.Module):
-    """The 7x7/s2 ImageNet stem computed via an EXACT space-to-depth rewrite.
-
-    A direct 7x7 conv over 3 input channels feeds the MXU a contraction
-    depth of 3 — measured at ~9 TF/s (4.6% of v5e peak), the single worst
-    op in the ResNet-50 step (docs/design/conv_mfu.md). Rewriting the same
-    convolution over a 2x2 space-to-depth input view makes it a 4x4/s1
-    conv with contraction depth 4*4*12=192: identical math (the kernel is
-    the SAME [7,7,cin,cout] parameter, zero-padded to 8x8 and regrouped at
-    trace time, so init/checkpoints/TP rules are unchanged), MXU-shaped
-    execution. Equivalence is tested to f32 noise
-    (tests/test_models.py::test_resnet_s2d_stem_matches_direct_conv).
-    """
-
-    def __init__(self, cin, cout, act=None):
-        super().__init__()
-        # same module layout as the direct stem: params land in
-        # ["conv"]["w"] / ["bn"], checkpoint-compatible either way
-        self.conv = nn.Conv2D(cin, cout, 7, stride=2, padding=3, bias=False)
-        self.bn = nn.BatchNorm(cout)
-        self.act = act
-
-    def __call__(self, params, x, train=False, mutable=None, **kw):
-        B, H, W, C = x.shape
-        if H % 2 or W % 2:
-            h = self.conv(params["conv"], x)     # odd sizes: direct conv
-        else:
-            w7 = params["conv"]["w"]
-            cout = w7.shape[-1]
-            # out[h,w] = sum_{i,j<7} x[2h+i-3, 2w+j-3] K[i,j]; with a
-            # leading zero pad (i'=i+1 in 0..7) and i'=2a+p this is a 4x4
-            # valid conv over the 2x2 space-to-depth grid of x padded by 4
-            xp = jnp.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)))
-            hc, wc = (H + 8) // 2, (W + 8) // 2
-            x2 = xp.reshape(B, hc, 2, wc, 2, C).transpose(
-                0, 1, 3, 2, 4, 5).reshape(B, hc, wc, 4 * C)
-            w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
-            w2 = w8.reshape(4, 2, 4, 2, C, cout).transpose(
-                0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * C, cout)
-            h = conv_ops.conv2d(x2, w2, stride=1, padding=0)
-            h = h[:, :H // 2, :W // 2]
-        h = self.bn(params["bn"], h, train=train, mutable=mutable)
-        return self.act(h) if self.act else h
-
-
 class VGG(nn.Module):
     """VGG-16 (vgg.py cfg [2,2,3,3,3] conv blocks + 2x512 fc)."""
 
@@ -219,8 +174,7 @@ class ResNet(nn.Module):
     """
 
     def __init__(self, depth: int = 50, classes: int = 1000, in_ch: int = 3,
-                 width_mult: float = 1.0, small_input: bool = False,
-                 s2d_stem: bool = True):
+                 width_mult: float = 1.0, small_input: bool = False):
         super().__init__()
         block, counts, expansion = _RESNET_CFG[depth]
         w = lambda ch: max(8, int(ch * width_mult))
@@ -228,11 +182,10 @@ class ResNet(nn.Module):
         if small_input:
             self.stem = _ConvBN(in_ch, w(64), 3, stride=1, padding=1,
                                 act=jax.nn.relu)
-        elif s2d_stem:
-            # exact space-to-depth execution of the same 7x7/s2 conv (MXU
-            # contraction 192 instead of 3 — docs/design/conv_mfu.md)
-            self.stem = _S2DStem(in_ch, w(64), act=jax.nn.relu)
         else:
+            # nn.Conv2D executes the 7x7/s2 stem via the exact
+            # space-to-depth rewrite (MXU contraction 192 instead of 3 —
+            # docs/design/conv_mfu.md, ops/conv.py::conv7s2)
             self.stem = _ConvBN(in_ch, w(64), 7, stride=2, padding=3,
                                 act=jax.nn.relu)
         c = w(64)
@@ -324,9 +277,21 @@ class _Inception(nn.Module):
         self.cout = c1 + c3 + c5 + proj
 
     def __call__(self, params, x, **kw):
-        a = self.b1(params["b1"], x)
-        b = self.b3(params["b3"], self.b3r(params["b3r"], x))
-        c = self.b5(params["b5"], self.b5r(params["b5r"], x))
+        # the three 1x1 branches reading x directly (b1, b3-reduce,
+        # b5-reduce) run as ONE conv with trace-time weight concat — same
+        # math per branch, one HBM pass over x instead of three (the 1x1
+        # convs at inception's spatial sizes are bandwidth-bound,
+        # docs/design/conv_mfu.md)
+        w = jnp.concatenate([params["b1"]["w"], params["b3r"]["w"],
+                             params["b5r"]["w"]], axis=-1)
+        bias = jnp.concatenate([params["b1"]["b"], params["b3r"]["b"],
+                                params["b5r"]["b"]])
+        fused = jax.nn.relu(conv_ops.conv2d(x, w) + bias)
+        c1 = params["b1"]["w"].shape[-1]
+        c3r = params["b3r"]["w"].shape[-1]
+        a = fused[..., :c1]
+        b = self.b3(params["b3"], fused[..., c1:c1 + c3r])
+        c = self.b5(params["b5"], fused[..., c1 + c3r:])
         d = self.bp(params["bp"], P.max_pool2d(x, 3, 1, padding=1))
         return jnp.concatenate([a, b, c, d], axis=-1)
 
@@ -380,6 +345,7 @@ class GoogleNet(nn.Module):
         r1 = r2 = r3 = None
         if train and rng is not None:
             r1, r2, r3 = jax.random.split(rng, 3)
+        # stem1 (7x7/s2) auto-routes through nn.Conv2D's s2d rewrite
         h = P.max_pool2d(self.stem1(params["stem1"], x), 3, 2, padding=1)
         h = lrn(h)
         h = self.stem3(params["stem3"], self.stem2(params["stem2"], h))

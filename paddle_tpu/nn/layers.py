@@ -87,6 +87,7 @@ class Conv2D(Module):
                  w_init: Optional[I.Initializer] = None):
         super().__init__()
         k = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.kernel, self.in_ch = k, in_ch
         self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
         self.act = _act(act)
         self.use_bias = bias
@@ -94,9 +95,25 @@ class Conv2D(Module):
         if bias:
             self.param("b", (out_ch,), I.zeros)
 
+    def _is_stem7s2(self):
+        # only shallow inputs (ImageNet's 3 channels): the rewrite exists
+        # to deepen an MXU-starved contraction; with cin already deep it
+        # just adds pad/reshape HBM traffic for nothing
+        return (self.kernel == (7, 7) and self.stride in (2, (2, 2))
+                and self.padding in (3, (3, 3)) and self.dilation == 1
+                and self.groups == 1 and self.in_ch <= 4)
+
     def __call__(self, params, x, **kw):
-        y = conv_ops.conv2d(x, params["w"], stride=self.stride, padding=self.padding,
-                            dilation=self.dilation, groups=self.groups)
+        if self._is_stem7s2():
+            # the classic ImageNet stem shape: routed through the exact
+            # space-to-depth rewrite (ops/conv.py conv7s2) — a direct 7x7
+            # over 3 channels is the measured MXU worst case
+            # (docs/design/conv_mfu.md); same params, same math
+            y = conv_ops.conv7s2(x, params["w"])
+        else:
+            y = conv_ops.conv2d(x, params["w"], stride=self.stride,
+                                padding=self.padding, dilation=self.dilation,
+                                groups=self.groups)
         if self.use_bias:
             y = y + params["b"]
         return self.act(y)

@@ -46,6 +46,44 @@ def conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
     )
 
 
+def conv7s2_space_to_depth(x: jax.Array, w7: jax.Array) -> jax.Array:
+    """The 7x7/stride-2/pad-3 stem conv computed via an EXACT space-to-depth
+    rewrite (MLPerf-style conv0 transform).
+
+    A direct 7x7 conv over few input channels (ImageNet's 3) feeds the MXU a
+    contraction depth of 3 — measured ~9 TF/s on v5e, 4.6% of peak
+    (docs/design/conv_mfu.md). Over a 2x2 space-to-depth view of x the same
+    convolution is a 4x4/s1 conv with contraction depth 16*cin: with a
+    leading zero pad (tap i' = i+1 in 0..7) and i' = 2a+p, out[h] =
+    sum x[2(h+a-2)+p] — a 4-cell window over the S2D grid. The kernel is
+    the SAME [7,7,cin,cout] parameter regrouped at trace time, so
+    checkpoints and init are unchanged; equivalence is tested to f32 noise.
+    Requires even H, W (falls back to callers' direct conv otherwise).
+    """
+    B, H, W, C = x.shape
+    assert H % 2 == 0 and W % 2 == 0 and w7.shape[:2] == (7, 7)
+    cout = w7.shape[-1]
+    xp = jnp.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    hc, wc = (H + 8) // 2, (W + 8) // 2
+    x2 = xp.reshape(B, hc, 2, wc, 2, C).transpose(
+        0, 1, 3, 2, 4, 5).reshape(B, hc, wc, 4 * C)
+    w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    w2 = w8.reshape(4, 2, 4, 2, C, cout).transpose(
+        0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * C, cout)
+    out = conv2d(x2, w2, stride=1, padding=0)
+    return out[:, :H // 2, :W // 2]
+
+
+def conv7s2(x: jax.Array, w7: jax.Array) -> jax.Array:
+    """7x7/stride-2/pad-3 conv, routed through the space-to-depth rewrite
+    when H and W are even (its precondition), direct conv otherwise. Owns
+    the parity dispatch so every stem call site stays a one-liner; callers
+    apply their own bias/norm/activation."""
+    if x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+        return conv7s2_space_to_depth(x, w7)
+    return conv2d(x, w7, stride=2, padding=3)
+
+
 def depthwise_conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
                      padding: Union[str, IntOr2] = 0) -> jax.Array:
     """w: [kh, kw, 1, channels*mult] with groups=channels

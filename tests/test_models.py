@@ -92,28 +92,72 @@ def test_image_models_forward_and_grad(cls, kw):
         lambda a, b: a + jnp.sum(jnp.abs(b)), g, 0.0)))
 
 
-def test_resnet_s2d_stem_matches_direct_conv():
-    """The space-to-depth stem is an exact compute rewrite of the SAME 7x7
-    parameter: identical params pytree, outputs equal to f32 noise, and the
-    fwd+bwd both work (docs/design/conv_mfu.md)."""
-    kw = dict(depth=18, classes=5, width_mult=0.25, small_input=False)
-    m_s2d = ResNet(s2d_stem=True, **kw)
-    m_ref = ResNet(s2d_stem=False, **kw)
-    params = m_ref.init(jax.random.PRNGKey(0))
-    # same param tree: s2d path can run the reference stem's checkpoint
-    assert (jax.tree_util.tree_structure(m_s2d.init(jax.random.PRNGKey(0)))
-            == jax.tree_util.tree_structure(params))
+def test_conv2d_stem_auto_route_matches_direct():
+    """nn.Conv2D routes the 7x7/s2/p3 stem shape through the exact
+    space-to-depth rewrite (ops/conv.py::conv7s2): the layer output —
+    including bias and act — equals the direct conv math on the SAME
+    params, on both input parities (odd sizes take the direct path), and
+    the ResNet-18 stem that relies on it is differentiable end-to-end
+    (docs/design/conv_mfu.md)."""
+    from paddle_tpu import nn
+    from paddle_tpu.ops import conv as conv_ops
+
+    layer = nn.Conv2D(3, 16, 7, stride=2, padding=3, act="relu")
+    params = layer.init(jax.random.PRNGKey(0))
+    for seed, hw in ((1, 64), (2, 63)):
+        x = jax.random.normal(jax.random.PRNGKey(seed), (2, hw, hw, 3))
+        want = jax.nn.relu(
+            conv_ops.conv2d(x, params["w"], stride=2, padding=3)
+            + params["b"])
+        np.testing.assert_allclose(np.asarray(layer(params, x)),
+                                   np.asarray(want), rtol=2e-4, atol=2e-4)
+
+    m = ResNet(depth=18, classes=5, width_mult=0.25, small_input=False)
+    rp = m.init(jax.random.PRNGKey(0))
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
-    np.testing.assert_allclose(
-        np.asarray(m_s2d(params, x)), np.asarray(m_ref(params, x)),
-        rtol=2e-4, atol=2e-4)
-    # odd spatial size falls back to the direct conv
-    x_odd = jax.random.normal(jax.random.PRNGKey(2), (2, 63, 63, 3))
-    np.testing.assert_allclose(
-        np.asarray(m_s2d(params, x_odd)), np.asarray(m_ref(params, x_odd)),
-        rtol=2e-4, atol=2e-4)
-    g = jax.grad(lambda p: m_s2d(p, x).sum())(params)
+    g = jax.grad(lambda p: m(p, x).sum())(rp)
     assert np.isfinite(float(jnp.sum(jnp.abs(g["stem"]["conv"]["w"]))))
+
+
+def test_inception_branch_fusion_matches_unfused():
+    """The fused 1x1-branch conv (one weight-concat conv instead of three)
+    and the s2d GoogleNet stem are exact rewrites: forward equals the
+    per-branch computation on the same params."""
+    from paddle_tpu.models.image import _Inception
+
+    blk = _Inception(32, 8, 12, 16, 4, 8, 8)
+    params = blk.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 14, 14, 32))
+    got = blk(params, x)
+
+    from paddle_tpu.ops import pool as P
+    a = blk.b1(params["b1"], x)
+    b = blk.b3(params["b3"], blk.b3r(params["b3r"], x))
+    c = blk.b5(params["b5"], blk.b5r(params["b5r"], x))
+    d = blk.bp(params["bp"], P.max_pool2d(x, 3, 1, padding=1))
+    want = jnp.concatenate([a, b, c, d], axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_googlenet_s2d_stem_matches_direct():
+    """GoogleNet's s2d stem path equals the direct 7x7 conv (odd input
+    sizes take the direct path)."""
+    from paddle_tpu.models import GoogleNet
+
+    m = GoogleNet(classes=7)
+    params = m.init(jax.random.PRNGKey(0))
+    x_even = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 64, 3))
+    x_odd = jax.random.normal(jax.random.PRNGKey(2), (1, 63, 63, 3))
+    from paddle_tpu.ops import conv as conv_ops
+    s2d = conv_ops.conv7s2_space_to_depth(x_even, params["stem1"]["w"])
+    direct = conv_ops.conv2d(x_even, params["stem1"]["w"], stride=2,
+                             padding=3)
+    np.testing.assert_allclose(np.asarray(s2d), np.asarray(direct),
+                               rtol=1e-4, atol=1e-4)
+    # both input parities run end-to-end
+    assert m(params, x_even).shape == (1, 7)
+    assert m(params, x_odd).shape == (1, 7)
 
 
 def test_seq2seq_learns_and_decodes():
