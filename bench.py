@@ -174,7 +174,11 @@ def main(full: bool = False):
             except OSError:
                 pass
         _emit_flagship()
-        os._exit(0)
+        # 128+signum: a reaped run must not be rc-indistinguishable from a
+        # clean one — the tail JSON line stays the honest success signal,
+        # the return code says HOW the process ended (driver contract,
+        # docs/design/bench_contract.md)
+        os._exit(128 + signum)
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)

@@ -228,9 +228,52 @@ def cmd_checkgrad(args):
     return 0 if ok else 1
 
 
+def _poll_job(procs, timeout: float, grace: float) -> int:
+    """Shared failure-detection loop: the moment ANY worker fails (or the
+    deadline passes), SIGTERM survivors with a teardown grace, then SIGKILL
+    stragglers. Returns the job rc."""
+    import time as _time
+    rc = 0
+    deadline = _time.time() + timeout
+    try:
+        # poll-all: the moment ANY worker fails, tear the job down (the
+        # docstring's failure-detection contract); one shared deadline
+        pending = list(procs)
+        while pending:
+            for p in list(pending):
+                code = p.poll()
+                if code is not None:
+                    pending.remove(p)
+                    if code and not rc:
+                        rc = code
+                        print(f"cluster_train: worker {procs.index(p)} "
+                              f"exited rc={code}; tearing the job down "
+                              f"(survivors get SIGTERM, {grace:.0f}s "
+                              f"grace).", file=sys.stderr)
+            if not rc and _time.time() > deadline:
+                rc = 124
+                print(f"cluster_train: --timeout {timeout:.0f}s "
+                      f"exceeded; tearing the job down.", file=sys.stderr)
+            if rc:     # peer failure or timeout -> graceful teardown
+                for p in pending:
+                    if p.poll() is None:
+                        p.terminate()   # survivors run their teardown hook
+                grace_end = _time.time() + grace
+                while (any(p.poll() is None for p in pending)
+                       and _time.time() < grace_end):
+                    _time.sleep(0.1)
+                break
+            _time.sleep(0.2)
+    finally:
+        for p in procs:           # a dead/hung peer must not strand the rest
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
 def _cluster_attempt(args, attempt: int) -> int:
-    """One full-job launch: spawn all workers on a fresh coordinator port,
-    poll, tear down on any failure. Returns the job rc."""
+    """One full local-job launch: spawn all workers on a fresh coordinator
+    port, then run the shared failure-detection loop."""
     import os
     import socket
     import subprocess
@@ -255,42 +298,110 @@ def _cluster_attempt(args, attempt: int) -> int:
         procs.append(subprocess.Popen(
             [sys.executable, args.script] + (args.script_args or []),
             env=env))
-    import time as _time
-    rc = 0
-    deadline = _time.time() + args.timeout
-    try:
-        # poll-all: the moment ANY worker fails, tear the job down (the
-        # docstring's failure-detection contract); one shared deadline
-        pending = list(procs)
-        while pending:
-            for p in list(pending):
-                code = p.poll()
-                if code is not None:
-                    pending.remove(p)
-                    if code and not rc:
-                        rc = code
-                        print(f"cluster_train: worker {procs.index(p)} "
-                              f"exited rc={code}; tearing the job down "
-                              f"(survivors get SIGTERM, {args.grace:.0f}s "
-                              f"grace).", file=sys.stderr)
-            if not rc and _time.time() > deadline:
-                rc = 124
-                print(f"cluster_train: --timeout {args.timeout:.0f}s "
-                      f"exceeded; tearing the job down.", file=sys.stderr)
-            if rc:     # peer failure or timeout -> graceful teardown
-                for p in pending:
-                    if p.poll() is None:
-                        p.terminate()   # survivors run their teardown hook
-                grace_end = _time.time() + args.grace
-                while (any(p.poll() is None for p in pending)
-                       and _time.time() < grace_end):
-                    _time.sleep(0.1)
-                break
-            _time.sleep(0.2)
-    finally:
-        for p in procs:           # a dead/hung peer must not strand the rest
-            if p.poll() is None:
-                p.kill()
+    return _poll_job(procs, args.timeout, args.grace)
+
+
+def _cluster_hosts(args):
+    """Host list from --hosts (comma-separated) or --hostfile (one host per
+    line, '#' comments) — the conf.py HOSTS list of the reference launcher
+    (scripts/cluster_train/conf.py)."""
+    hosts = []
+    if getattr(args, "hosts", None):
+        hosts += [h.strip() for h in args.hosts.split(",") if h.strip()]
+    if getattr(args, "hostfile", None):
+        with open(args.hostfile) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    hosts.append(line)
+    return hosts
+
+
+def _render_host_commands(args, hosts, attempt: int = 0,
+                          job_id: str = "dryrun"):
+    """Render the per-host launch command lines for a real multi-host
+    jax.distributed job — the capability of the reference's fabric/ssh
+    launcher (scripts/cluster_train/paddle.py job_prepare+job_start;
+    cluster_train_v2/fabric), re-targeted at jax.distributed membership:
+    node 0's host serves the coordinator, every node gets its process id
+    and the world size via PADDLE_TPU_* env (consumed by
+    parallel/multihost.py initialize()).
+
+    ``--ssh-template`` wraps each per-node command; placeholders ``{host}``
+    and ``{cmd}`` (shell-quoted). Default: ssh <host> '<cmd>'.
+
+    Each node runs inside a tiny bash supervisor whose command line carries
+    ``PADDLE_TPU_JOB_ID=<id>`` and which forwards SIGTERM to the python
+    child — that is what makes the job remotely reapable
+    (``pkill -f PADDLE_TPU_JOB_ID=<id>``, see :func:`_reap_remote_job`),
+    since signalling an ssh client does not signal the remote process
+    (the reference's kill_process grep-marker trick, paddle.py:51-60).
+
+    The coordinator address strips an ssh ``user@`` login prefix from
+    node 0's host, and its port is offset by the attempt number so an
+    elastic restart never collides with a stale coordinator socket from
+    the previous generation.
+    """
+    import shlex
+
+    coord_host = hosts[0].rsplit("@", 1)[-1]   # user@host is ssh login only
+    coordinator = f"{coord_host}:{args.coordinator_port + attempt}"
+    template = args.ssh_template or "ssh {host} {cmd}"
+    lines = []
+    for i, host in enumerate(hosts):
+        inner = " ".join(
+            [f"PADDLE_TPU_JOB_ID={job_id}",
+             f"PADDLE_TPU_COORDINATOR={coordinator}",
+             f"PADDLE_TPU_NUM_PROCESSES={len(hosts)}",
+             f"PADDLE_TPU_PROCESS_ID={i}",
+             f"PADDLE_TPU_RESTART_COUNT={attempt}",
+             args.remote_python, shlex.quote(args.script)]
+            + [shlex.quote(a) for a in (args.script_args or [])])
+        # supervisor: its /proc cmdline contains the job id (the exec'd
+        # python's does not); TERM/INT forward to the child
+        wrapped = ("bash -c " + shlex.quote(
+            'trap "kill -TERM $c 2>/dev/null" TERM INT; '
+            + inner + " & c=$!; wait $c"))
+        lines.append(template.format(host=shlex.quote(host),
+                                     cmd=shlex.quote(wrapped)))
+    return lines
+
+
+def _reap_remote_job(args, hosts, job_id: str):
+    """Best-effort remote teardown: ssh a targeted pkill to every host so a
+    crashed job's survivors do not keep the accelerators (the reference's
+    paddle.py kill_process). TERM first (teardown hooks run), then KILL."""
+    import shlex
+    import subprocess
+
+    template = args.ssh_template or "ssh {host} {cmd}"
+    kill = (f"pkill -TERM -f PADDLE_TPU_JOB_ID={job_id}; sleep 2; "
+            f"pkill -KILL -f PADDLE_TPU_JOB_ID={job_id}; true")
+    for host in hosts:
+        cmd = template.format(host=shlex.quote(host), cmd=shlex.quote(kill))
+        try:
+            subprocess.run(cmd, shell=True, timeout=30,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+        except Exception:
+            pass                       # a dead host cannot be reaped anyway
+
+
+def _multihost_attempt(args, hosts, attempt: int) -> int:
+    """One multi-host launch: run every rendered per-host command (ssh by
+    default) and apply the same any-failure-tears-all-down contract the
+    local path uses — the analog of paddle.py's job_all + kill-on-failure,
+    including reaping the REMOTE worker processes, not just the local ssh
+    clients."""
+    import os
+    import subprocess
+
+    job_id = f"{os.getpid():x}.{attempt}"
+    cmds = _render_host_commands(args, hosts, attempt, job_id)
+    procs = [subprocess.Popen(c, shell=True) for c in cmds]
+    rc = _poll_job(procs, args.timeout, args.grace)
+    if rc:
+        _reap_remote_job(args, hosts, job_id)
     return rc
 
 
@@ -315,10 +426,39 @@ def cmd_cluster_train(args):
     consumer's pending task chunks by lease timeout automatically
     (native/task_master.cc), so no sample is lost or double-trained across
     the restart. ``PADDLE_TPU_RESTART_COUNT`` tells the script which
-    attempt it is on. Timeouts are per-attempt."""
+    attempt it is on. Timeouts are per-attempt.
+
+    With ``--hosts``/``--hostfile`` the same job shape targets REAL
+    machines: per-host launch commands are rendered (``--ssh-template``)
+    around jax.distributed membership env — node 0's host carries the
+    coordinator at ``--coordinator-port`` — and executed (ssh by default),
+    or just printed with ``--dry-run`` for inspection/external schedulers.
+    The reference capability: scripts/cluster_train/paddle.py (fabric/ssh)
+    and cluster_train_v2/{fabric,openmpi}."""
+    hosts = _cluster_hosts(args)
+    if hosts:
+        # world size is the host list in this mode; flag the conflict
+        # instead of silently dropping an explicit local-mode option
+        if args.num_workers != 2:
+            print(f"cluster_train: --hosts mode runs one node per host "
+                  f"({len(hosts)}); ignoring --num_workers "
+                  f"{args.num_workers}.", file=sys.stderr)
+        if args.devices_per_worker:
+            print("cluster_train: --devices_per_worker is a local-mode "
+                  "testing option; ignored with --hosts (set XLA_FLAGS on "
+                  "the remote hosts instead).", file=sys.stderr)
+    if getattr(args, "dry_run", False):
+        if not hosts:
+            print("cluster_train: --dry-run needs --hosts/--hostfile",
+                  file=sys.stderr)
+            return 2
+        for line in _render_host_commands(args, hosts):
+            print(line)
+        return 0
     restarts = max(0, getattr(args, "restart_on_failure", 0) or 0)
     for attempt in range(restarts + 1):
-        rc = _cluster_attempt(args, attempt)
+        rc = (_multihost_attempt(args, hosts, attempt) if hosts
+              else _cluster_attempt(args, attempt))
         if rc == 0:
             return 0
         if attempt < restarts:
@@ -450,6 +590,27 @@ def main(argv=None) -> int:
                     help="elastic recovery: relaunch the whole job (fresh "
                          "coordinator, scripts resume from their latest "
                          "checkpoint) up to N times after a worker failure")
+    ct.add_argument("--hosts", default=None,
+                    help="comma-separated host list: launch one node per "
+                         "host over ssh (multi-host jax.distributed mode)")
+    ct.add_argument("--hostfile", default=None,
+                    help="file with one host per line ('#' comments) — the "
+                         "reference launcher's conf.py HOSTS")
+    ct.add_argument("--ssh-template", default=None, dest="ssh_template",
+                    help="per-host command template with {host} and {cmd} "
+                         "placeholders (default: \"ssh {host} {cmd}\"); "
+                         "e.g. \"ssh -p 2222 -i key {host} {cmd}\" or "
+                         "\"bash -c {cmd}\" for local testing")
+    ct.add_argument("--coordinator-port", type=int, default=7164,
+                    dest="coordinator_port",
+                    help="jax.distributed coordinator port on node 0's host "
+                         "(the reference's PADDLE_PORT)")
+    ct.add_argument("--remote-python", default="python3",
+                    dest="remote_python",
+                    help="python interpreter to invoke on each host")
+    ct.add_argument("--dry-run", action="store_true", dest="dry_run",
+                    help="print the rendered per-host commands and exit "
+                         "(for inspection or external schedulers)")
     ct.set_defaults(fn=cmd_cluster_train)
 
     v = sub.add_parser("version")

@@ -1,11 +1,14 @@
-from .lod import (NestedSeqBatch, SeqBatch, bucket_length, lengths_from_lod,
-                  lod_from_lengths, pack_nested_sequences, pack_sequences,
-                  sequence_mask)
+from .lod import (LoDBatch, NestedSeqBatch, SeqBatch, bucket_length,
+                  lengths_from_lod, lod_batch_from_offsets,
+                  lod_batch_to_offsets, lod_from_lengths, pack_lod,
+                  pack_nested_sequences, pack_sequences, sequence_mask,
+                  unpack_lod)
 from .place import CPUPlace, DeviceContext, Place, TPUPlace, default_place
 
 __all__ = [
-    "SeqBatch", "NestedSeqBatch", "sequence_mask", "pack_sequences",
-    "pack_nested_sequences", "bucket_length",
+    "SeqBatch", "NestedSeqBatch", "LoDBatch", "sequence_mask",
+    "pack_sequences", "pack_nested_sequences", "pack_lod", "unpack_lod",
+    "lod_batch_from_offsets", "lod_batch_to_offsets", "bucket_length",
     "lod_from_lengths", "lengths_from_lod",
     "Place", "TPUPlace", "CPUPlace", "DeviceContext", "default_place",
 ]

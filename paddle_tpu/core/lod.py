@@ -232,3 +232,228 @@ def pack_nested_sequences(nested, max_subseqs: Optional[int] = None,
             sub_lengths[b, s] = n
     return NestedSeqBatch(jnp.asarray(data), jnp.asarray(sub_lengths),
                           jnp.asarray(seq_lengths))
+
+
+# =============================================================================
+# N-level LoD — the general form of the reference's LoDTensor
+# (framework/lod_tensor.h:57,82: a Vector<Vector<size_t>> of offset levels
+# over a flat tensor). Static-shape regime: level k of raggedness becomes
+# padded axis k+1, with a lengths array per level. SeqBatch/NestedSeqBatch
+# above stay as the hand-tuned 1-/2-level cases every layer consumes;
+# LoDBatch is the depth-generic container that converts losslessly to and
+# from the reference's offset-vector representation at any depth.
+# =============================================================================
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class LoDBatch:
+    """An N-level ragged batch, padded dense.
+
+    * ``data``: [B, S1, S2, ..., S_{L-1}, T, *feat] — one axis per nesting
+      level; the innermost ragged axis is time.
+    * ``level_lengths``: tuple of L int32 arrays; ``level_lengths[i]`` has
+      shape ``data.shape[:i+1]`` and counts the valid entries along axis
+      ``i+1`` (sub-sequences for i < L-1, timesteps for i = L-1). Padding
+      entries carry length 0.
+
+    Level numbering matches the reference's LoD: level 0 is the outermost.
+    A pytree, so it flows through jit/grad/pjit like SeqBatch.
+    """
+
+    data: jax.Array
+    level_lengths: Tuple[jax.Array, ...]
+
+    def tree_flatten(self):
+        return (self.data,) + tuple(self.level_lengths), len(self.level_lengths)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], tuple(children[1:1 + aux]))
+
+    # -- shape helpers -----------------------------------------------------
+    @property
+    def nlevels(self) -> int:
+        return len(self.level_lengths)
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    def mask(self, level: int = -1, dtype=jnp.float32) -> jax.Array:
+        """Validity of entries along ragged axis ``level``: shape
+        ``data.shape[:level+2]``."""
+        level = range(self.nlevels)[level]
+        lens = self.level_lengths[level]
+        size = self.data.shape[level + 1]
+        pos = jnp.arange(size, dtype=lens.dtype)
+        return (pos[(None,) * lens.ndim] < lens[..., None]).astype(dtype)
+
+    # -- level moves (generalize NestedSeqBatch.inner_flat / outer) --------
+    def innermost_flat(self) -> SeqBatch:
+        """Collapse every outer ragged axis: [prod(B..S_{L-1}), T, *feat]
+        + innermost lengths — the input shape for any single-level sequence
+        op (RNN, sequence pool/conv). Padding sequences ride along with
+        length 0 and mask to nothing."""
+        lead = int(np.prod(self.data.shape[:self.nlevels]))
+        d = self.data.reshape((lead,) + self.data.shape[self.nlevels:])
+        return SeqBatch(d, self.level_lengths[-1].reshape(-1))
+
+    def lift(self, per_seq: jax.Array) -> "LoDBatch":
+        """Lift per-innermost-sequence values [prod(...), *feat] (from an op
+        applied to ``innermost_flat()``) back one level: the result is an
+        (L-1)-level LoDBatch whose time axis is the old sub-sequence axis.
+        With L-1 == 1 the result is equivalent to a SeqBatch (see
+        ``as_seq_batch``)."""
+        if self.nlevels < 2:
+            raise ValueError("lift() needs >= 2 levels; innermost_flat() of "
+                             "a 1-level batch is already a SeqBatch")
+        shape = self.data.shape[:self.nlevels] + per_seq.shape[1:]
+        return LoDBatch(per_seq.reshape(shape), self.level_lengths[:-1])
+
+    def as_seq_batch(self) -> SeqBatch:
+        if self.nlevels != 1:
+            raise ValueError(f"{self.nlevels}-level batch is not a SeqBatch")
+        return SeqBatch(self.data, self.level_lengths[0])
+
+    def as_nested(self) -> NestedSeqBatch:
+        if self.nlevels != 2:
+            raise ValueError(f"{self.nlevels}-level batch is not a "
+                             "NestedSeqBatch")
+        return NestedSeqBatch(self.data, self.level_lengths[1],
+                              self.level_lengths[0])
+
+
+def pack_lod(nested, levels: int, pad_value=0) -> LoDBatch:
+    """Host-side: depth-``levels`` nested python lists of [len, *feat]
+    arrays -> LoDBatch. ``levels=1`` expects ``[arr, ...]``, ``levels=2``
+    ``[[arr, ...], ...]`` etc. — the N-level analog of
+    :func:`pack_sequences` / :func:`pack_nested_sequences`."""
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    if not nested:
+        raise ValueError("pack_lod: empty batch")
+
+    def _leaves(node, depth):
+        if depth == levels:
+            yield np.asarray(node)
+        else:
+            for child in node:
+                yield from _leaves(child, depth + 1)
+
+    leaves = [a for sample in nested for a in _leaves(sample, 1)]
+    first = next((a for a in leaves if a.shape[0] > 0),
+                 leaves[0] if leaves else None)
+    if first is None:
+        raise ValueError("pack_lod: no sequences in batch")
+
+    # axis sizes: max fan-out per depth (axis 0 = batch, axis L = time)
+    sizes = [len(nested)] + [1] * levels
+
+    def _measure(node, depth):
+        if depth == levels:
+            sizes[levels] = max(sizes[levels], int(np.asarray(node).shape[0]))
+        else:
+            sizes[depth] = max(sizes[depth], len(node))
+            for child in node:
+                _measure(child, depth + 1)
+
+    for sample in nested:
+        _measure(sample, 1)
+
+    feat = first.shape[1:]
+    data = np.full(tuple(sizes) + feat, pad_value, dtype=first.dtype)
+    lens = [np.zeros(tuple(sizes[:i + 1]), np.int32) for i in range(levels)]
+
+    def _fill(node, depth, idx):
+        if depth == levels:
+            arr = np.asarray(node)
+            n = int(arr.shape[0])
+            lens[levels - 1][idx] = n
+            if n:
+                data[idx + (slice(0, n),)] = arr
+        else:
+            lens[depth - 1][idx] = len(node)
+            for j, child in enumerate(node):
+                _fill(child, depth + 1, idx + (j,))
+
+    for b, sample in enumerate(nested):
+        _fill(sample, 1, (b,))
+    return LoDBatch(jnp.asarray(data), tuple(jnp.asarray(l) for l in lens))
+
+
+def unpack_lod(batch: LoDBatch):
+    """Inverse of :func:`pack_lod`: LoDBatch -> nested python lists of
+    numpy [len, *feat] arrays, padding stripped. Round-trip exact."""
+    data = np.asarray(batch.data)
+    lens = [np.asarray(l) for l in batch.level_lengths]
+    L = batch.nlevels
+
+    def _build(depth, idx):
+        if depth == L:
+            return data[idx][: int(lens[L - 1][idx])]
+        return [_build(depth + 1, idx + (j,))
+                for j in range(int(lens[depth - 1][idx]))]
+
+    return [_build(1, (b,)) for b in range(batch.batch_size)]
+
+
+def lod_batch_from_offsets(flat: np.ndarray, lod) -> LoDBatch:
+    """Reference LoDTensor form -> LoDBatch: ``flat`` is the row-major
+    concatenation of innermost sequences and ``lod`` the offset levels
+    (framework/lod_tensor.h:57 — level k's offsets index level k+1's
+    entries; the last level's offsets index rows of ``flat``)."""
+    flat = np.asarray(flat)
+    lod = [list(map(int, level)) for level in lod]
+    L = len(lod)
+    if L == 0:
+        raise ValueError("lod_batch_from_offsets: need >= 1 LoD level")
+    # validate the offset chain before building: level k's last offset must
+    # cover exactly level k+1's entry count (rows of ``flat`` for the last
+    # level) — numpy slicing would otherwise clamp and corrupt silently
+    for k, level in enumerate(lod):
+        if not level or level[0] != 0:
+            raise ValueError(f"lod_batch_from_offsets: level {k} offsets "
+                             f"must start at 0, got {level[:1]}")
+        if any(level[j] > level[j + 1] for j in range(len(level) - 1)):
+            raise ValueError(f"lod_batch_from_offsets: level {k} offsets "
+                             "must be non-decreasing")
+        extent = (flat.shape[0] if k == L - 1 else len(lod[k + 1]) - 1)
+        if level[-1] != extent:
+            what = "rows of flat" if k == L - 1 else f"level {k + 1} entries"
+            raise ValueError(
+                f"lod_batch_from_offsets: level {k} covers {level[-1]} "
+                f"entries but there are {extent} {what}")
+
+    def _build(level, j):
+        lo, hi = lod[level][j], lod[level][j + 1]
+        if level == L - 1:
+            return flat[lo:hi]
+        return [_build(level + 1, t) for t in range(lo, hi)]
+
+    nested = [_build(0, i) for i in range(len(lod[0]) - 1)]
+    return pack_lod(nested, L)
+
+
+def lod_batch_to_offsets(batch: LoDBatch):
+    """LoDBatch -> (flat rows, offset levels): the exact reference
+    LoDTensor representation (lod_tensor.h:82 LoD + flat tensor)."""
+    nested = unpack_lod(batch)
+    L = batch.nlevels
+    lod = [[0] for _ in range(L)]
+    rows = []
+
+    def _walk(node, depth):
+        if depth == L:
+            rows.append(np.asarray(node))
+            lod[L - 1].append(lod[L - 1][-1] + node.shape[0])
+        else:
+            lod[depth - 1].append(lod[depth - 1][-1] + len(node))
+            for child in node:
+                _walk(child, depth + 1)
+
+    for sample in nested:
+        _walk(sample, 1)
+    feat = batch.data.shape[batch.nlevels + 1:]
+    flat = (np.concatenate(rows, axis=0) if rows
+            else np.zeros((0,) + tuple(feat), np.asarray(batch.data).dtype))
+    return flat, [tuple(level) for level in lod]

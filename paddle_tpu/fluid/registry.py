@@ -374,6 +374,22 @@ def _gru(ins, attrs):
     return {"Out": [out], "LastH": [last]}
 
 
+@OpRegistry.register("simple_rnn")
+def _simple_rnn(ins, attrs):
+    """Vanilla (Elman) recurrence — the reference's RecurrentLayer.cpp /
+    recurrent_layer: h_t = act(x_t [@W] + h_{t-1}@U + b). W optional: the
+    v2 recurrent_layer pre-projects outside, per the reference contract."""
+    from ..ops.rnn import simple_rnn
+    from ..ops import activations as _acts
+    act = _acts.get(attrs.get("act", "tanh"))
+    out, last = simple_rnn(
+        ins["X"][0], ins["Lengths"][0] if "Lengths" in ins else None,
+        ins["W"][0] if "W" in ins else None, ins["U"][0],
+        ins["B"][0] if "B" in ins else None,
+        act=act, reverse=attrs.get("reverse", False))
+    return {"Out": [out], "LastH": [last]}
+
+
 @OpRegistry.register("sequence_pool")
 def _seq_pool(ins, attrs):
     from ..ops.sequence import sequence_pool

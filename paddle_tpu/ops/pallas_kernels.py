@@ -399,14 +399,26 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     D = q.shape[-1]
     scale_v = scale if scale is not None else D ** -0.5
+    valid = None
+    if kv_lens is not None:
+        # a fully-masked sample (kv_lens == 0) has no softmax support: both
+        # paths would emit garbage rows. Attend key 0 (finite everywhere),
+        # then zero those samples' outputs — the multiply also zeroes their
+        # incoming cotangent, so no gradient reaches any key of theirs.
+        valid = (kv_lens > 0)
+        kv_lens = jnp.maximum(kv_lens, 1)
     if (block_q is None and block_k is None
             and max(q.shape[1], k.shape[1]) < SHORT_SEQ_DENSE):
-        return _dense_attention(q, k, v, causal, scale_v, kv_lens)
-    block_q, block_k = _default_blocks(block_q, block_k)
-    if interpret is None:
-        interpret = not _on_tpu()
-    return _flash(q, k, v, kv_lens, causal, scale_v, block_q, block_k,
-                  bool(interpret))
+        o = _dense_attention(q, k, v, causal, scale_v, kv_lens)
+    else:
+        block_q, block_k = _default_blocks(block_q, block_k)
+        if interpret is None:
+            interpret = not _on_tpu()
+        o = _flash(q, k, v, kv_lens, causal, scale_v, block_q, block_k,
+                   bool(interpret))
+    if valid is not None:
+        o = o * valid[:, None, None, None].astype(o.dtype)
+    return o
 
 
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,

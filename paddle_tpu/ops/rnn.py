@@ -384,14 +384,18 @@ def bidirectional(rnn_fn: Callable, x, lengths, fwd_params: dict, bwd_params: di
     raise ValueError(f"unknown merge '{merge}'")
 
 
-def simple_rnn(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array,
-               u: jax.Array, b: Optional[jax.Array] = None,
+def simple_rnn(x: jax.Array, lengths: Optional[jax.Array],
+               w: Optional[jax.Array], u: jax.Array,
+               b: Optional[jax.Array] = None,
                act: Callable = jnp.tanh, h0: Optional[jax.Array] = None,
                reverse: bool = False) -> Tuple[jax.Array, jax.Array]:
-    """Vanilla RNN (ref: gserver/layers/RecurrentLayer.cpp)."""
+    """Vanilla RNN (ref: gserver/layers/RecurrentLayer.cpp). ``w=None`` is
+    the reference's recurrent_layer contract — x is already projected to
+    the hidden width and only the recurrent transform U applies."""
     B, T, D = x.shape
     H = u.shape[0]
-    xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
+    xw = (x if w is None
+          else jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1))
     mask = (sequence_mask(lengths, T, x.dtype) if lengths is not None
             else jnp.ones((B, T), x.dtype))
     h = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)

@@ -266,6 +266,11 @@ class LeaseKeeper:
     def _run(self):
         last_ok = time.time()
         while not self._stop.wait(self.interval):
+            # stamp BEFORE the RPC: the server's expiry clock starts when it
+            # handles the request, so measuring our grace window from the
+            # request's issue time keeps the client strictly conservative
+            # relative to server-side expiry (never "held" past the server)
+            attempt_at = time.time()
             try:
                 renewed = self.lease.renew()
             except (OSError, ConnectionError):
@@ -275,7 +280,7 @@ class LeaseKeeper:
                 renewed = time.time() - last_ok < self.lease.ttl
             else:
                 if renewed:
-                    last_ok = time.time()
+                    last_ok = attempt_at
             if not renewed:
                 if self.on_lost is not None:
                     self.on_lost()

@@ -301,13 +301,25 @@ class StaticInput:
         self.layer = input
 
 
-class GeneratedInput:
+class BaseGeneratedInput:
+    """Base of the generation feedback inputs (layers.py:4061
+    BaseGeneratedInput): carries the bos/eos bookkeeping that beam_search
+    fills in; subclasses define how the previous step's emission is fed
+    back into the next step."""
+
+    def __init__(self):
+        self.bos_id = None
+        self.eos_id = None
+
+
+class GeneratedInput(BaseGeneratedInput):
     """The generation feedback input: at step t the decoder receives the
     embedding of the token emitted at t-1 (GeneratedInput in the reference's
     beam-gen DSL). ``embedding_param`` shares a training-time embedding
     table; otherwise a fresh [vocab, embedding_size] table is created."""
 
     def __init__(self, size: int, embedding_size: int, embedding_param=None):
+        super().__init__()
         self.vocab_size = size
         self.embedding_size = embedding_size
         self.embedding_param = embedding_param
@@ -1796,9 +1808,196 @@ def print_layer(input: LayerOutput, head: int = 8) -> LayerOutput:
     return input
 
 
+# ---------------------------------------------------------------------------
+# Verbatim name parity with the reference DSL. Every name in the reference's
+# __all__ (trainer_config_helpers/layers.py:34-140, 115 names) is importable
+# under its reference spelling — either the canonical function above or an
+# alias/enum here. Swept by tests/test_v2_import_parity.py.
+# ---------------------------------------------------------------------------
+
+class AggregateLevel:
+    """Aggregation level enum (layers.py:284): TO_NO_SEQUENCE pools each
+    (sub-)sequence down to one vector; TO_SEQUENCE pools each nested
+    sub-sequence to one timestep of the outer sequence (our nested_* ops)."""
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    # deprecated spellings kept by the reference for old configs
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    """Expansion level enum (layers.py:1816) — the inverse of
+    AggregateLevel, used by expand_layer."""
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+class LayerType:
+    """Layer type string enum (layers.py:153). The v2 DSL here compiles to
+    Program IR ops rather than proto layer configs, so these are parity
+    constants: ``LayerOutput``s don't carry them, but configs written
+    against the reference enum keep importing and comparing."""
+    DATA = "data"
+    MIXED_LAYER = "mixed"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    SEQUENCE_LAST_INSTANCE = "seqlastins"
+    SEQUENCE_FIRST_INSTANCE = "seqfirstins"
+    SEQUENCE_RESHAPE = "seqreshape"
+    POOLING_MAX = "max"
+    POOLING_AVG = "average"
+    FC_LAYER = "fc"
+    COST = "cost"
+    COSINE_SIM = "cos"
+    HSIGMOID = "hsigmoid"
+    CONV_LAYER = "conv"
+    CONVTRANS_LAYER = "convt"
+    POOL_LAYER = "pool"
+    POOL3D_LAYER = "pool3d"
+    BATCH_NORM_LAYER = "batch_norm"
+    NORM_LAYER = "norm"
+    SUM_TO_ONE_NORM_LAYER = "sum_to_one_norm"
+    ROW_L2_NORM_LAYER = "row_l2_norm"
+    ADDTO_LAYER = "addto"
+    CONCAT_LAYER = "concat"
+    CONCAT_PROJ_LAYER = "concat2"
+    SEQUENCE_CONCAT_LAYER = "seqconcat"
+    LSTM_STEP_LAYER = "lstm_step"
+    GRU_STEP_LAYER = "gru_step"
+    GET_OUTPUT_LAYER = "get_output"
+    EXPAND_LAYER = "expand"
+    INTERPOLATION_LAYER = "interpolation"
+    BILINEAR_INTERP_LAYER = "bilinear_interp"
+    POWER_LAYER = "power"
+    SCALING_LAYER = "scaling"
+    TRANS_LAYER = "trans"
+    ROTATE_LAYER = "rotate"
+    DROPOUT_LAYER = "dropout"
+    TENSOR_LAYER = "tensor"
+    SELECTIVE_FC_LAYER = "selective_fc"
+    SAMPLING_ID_LAYER = "sampling_id"
+    SLOPE_INTERCEPT_LAYER = "slope_intercept"
+    LINEAR_COMBINATION_LAYER = "convex_comb"
+    BLOCK_EXPAND = "blockexpand"
+    MAXOUT = "maxout"
+    SPP_LAYER = "spp"
+    PAD_LAYER = "pad"
+    MULTIPLEX_LAYER = "multiplex"
+    ROW_CONV_LAYER = "row_conv"
+    PRINT_LAYER = "print"
+    PRIORBOX_LAYER = "priorbox"
+    MULTIBOX_LOSS_LAYER = "multibox_loss"
+    DETECTION_OUTPUT_LAYER = "detection_output"
+    CTC_LAYER = "ctc"
+    WARP_CTC_LAYER = "warp_ctc"
+    CRF_LAYER = "crf"
+    CRF_DECODING_LAYER = "crf_decoding"
+    NCE_LAYER = "nce"
+    MAXID_LAYER = "maxid"
+    EOSID_LAYER = "eos_id"
+    RECURRENT_LAYER = "recurrent"
+    CROP_LAYER = "crop"
+    SUB_NESTED_SEQ = "sub_nested_seq"
+    CLIP_LAYER = "clip"
+    SEQ_SLICE = "seq_slice"
+    KMAX_SEQ_SCORE = "kmax_seq_score"
+    SCALE_SHIFT_LAYER = "scale_shift"
+    RESIZE = "resize"
+    SUB_SEQ_LAYER = "subseq"
+    SCALE_SUB_REGION_LAYER = "scale_sub_region"
+
+    @classmethod
+    def is_layer_type(cls, type_name) -> bool:
+        return any(getattr(cls, k) == type_name for k in dir(cls)
+                   if not k.startswith("_") and
+                   isinstance(getattr(cls, k), str))
+
+
+def SubsequenceInput(input: LayerOutput) -> LayerOutput:
+    """DEPRECATED in the reference (layers.py:3925) and here: nested
+    sub-sequence inputs to recurrent_group are detected from the layer's
+    own input_type, so this marker is an identity passthrough."""
+    return input
+
+
+def layer_support(*attrs):
+    """Parity decorator (layers.py:388). The reference uses it to validate
+    ExtraLayerAttribute support per layer; Program-IR layers take plain
+    keyword attrs, so this wraps the function unchanged."""
+    def decorator(method):
+        return method
+    return decorator
+
+
+class BeamInput:
+    """One beam for cross_entropy_over_beam (layers.py:6206): candidate
+    scores over the beam, the selected candidate ids, and the gold index."""
+
+    def __init__(self, candidate_scores: LayerOutput,
+                 selected_candidates: LayerOutput, gold: LayerOutput):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def recurrent_layer(input: LayerOutput, act: Optional[str] = None,
+                    bias_attr: bool = True,
+                    reverse: bool = False) -> LayerOutput:
+    """Simple (Elman) full-matrix recurrence over a sequence
+    (layers.py:3846 recurrent_layer; gserver/layers/RecurrentLayer.cpp):
+    h_t = act(x_t + h_{t-1} @ U + b). As in the reference, the input is
+    NOT projected — its width is the state width; compose with fc/mixed
+    for the input transform. Compiles to one masked lax.scan."""
+    b = default_main_program().current_block()
+    size = _shape(input)[-1]
+    u = FL._create_parameter("rnn_u", (size, size), "float32",
+                             I.uniform(-0.08, 0.08))
+    ins = {"X": [input.var.name], "Lengths": [input.lengths.name],
+           "U": [u.name]}
+    if bias_attr:
+        bias = FL._create_parameter("rnn_b", (size,), "float32", I.zeros)
+        ins["B"] = [bias.name]
+    out = b.create_var(shape=input.var.shape, dtype="float32")
+    last = b.create_var(shape=(-1, size), dtype="float32")
+    b.append_op("simple_rnn", ins,
+                {"Out": [out.name], "LastH": [last.name]},
+                {"act": act or "tanh", "reverse": reverse})
+    return LayerOutput(out, input.lengths, input.input_type)
+
+
+def warp_ctc_layer(input: LayerOutput, label: LayerOutput, size: int,
+                   blank: int = 0,
+                   norm_by_times: bool = False) -> LayerOutput:
+    """warp_ctc_layer (layers.py WarpCTCLayer): the reference keeps two CTC
+    backends (CTCLayer and Baidu's warp-ctc) with identical loss semantics;
+    here one XLA implementation serves both names. ``norm_by_times`` is
+    accepted for signature parity — the returned loss is already
+    batch-mean-normalized, matching the trainer's use."""
+    return ctc_layer(input, label, size, blank=blank)
+
+
 # name-parity aliases (the reference exports these spellings in __all__)
 convex_comb_layer = linear_comb_layer
 cross_entropy = cross_entropy_cost
 cross_entropy_with_selfnorm = cross_entropy_with_selfnorm_cost
 multi_binary_label_cross_entropy = multi_binary_label_cross_entropy_cost
 hsigmoid = hsigmoid_layer
+data_layer = data
+embedding_layer = embedding
+fc_layer = fc
+pooling_layer = pooling
+img_conv_layer = img_conv
+img_pool_layer = img_pool
+img_pool3d_layer = img_pool3d
+img_conv3d_layer = img_conv3d
+concat_layer = concat
+dropout_layer = dropout
+context_projection = context_projection_layer
+maxid_layer = max_id_layer
+printer_layer = print_layer
+# gru_step_naive_layer (layers.py:3713) differs from gru_step_layer only in
+# dropping the fused-kernel constraint on gate layout; one XLA gru_unit op
+# serves both spellings
+gru_step_naive_layer = gru_step_layer

@@ -1,0 +1,150 @@
+"""N-level LoD (core/lod.py LoDBatch) — generalizing the reference's
+LoDTensor (framework/lod_tensor.h:57,82) beyond 2 nesting levels under the
+static-shape regime: one padded axis per level + per-level lengths, with
+lossless conversion to/from the reference's offset-vector form.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.lod import (LoDBatch, SeqBatch, lod_batch_from_offsets,
+                                 lod_batch_to_offsets, pack_lod, unpack_lod)
+
+RS = np.random.RandomState(7)
+
+
+def _rand_nested(depth, fanout=3, feat=(2,), dtype=np.float32):
+    """Random ragged structure of the given depth (>=1 child per node so the
+    structure is well-formed; ragged lengths incl. empty innermost seqs)."""
+    if depth == 1:
+        return RS.randn(int(RS.randint(0, 5)), *feat).astype(dtype)
+    return [_rand_nested(depth - 1, fanout, feat)
+            for _ in range(int(RS.randint(1, fanout + 1)))]
+
+
+@pytest.mark.parametrize("levels", [1, 2, 3, 4])
+def test_pack_unpack_roundtrip(levels):
+    nested = [_rand_nested(levels) for _ in range(4)]
+    batch = pack_lod(nested, levels)
+    assert batch.nlevels == levels
+    assert batch.data.ndim == levels + 2  # [B, S1..S_{L-1}, T, feat]
+    assert len(batch.level_lengths) == levels
+    for i, lens in enumerate(batch.level_lengths):
+        assert lens.shape == batch.data.shape[:i + 1]
+    back = unpack_lod(batch)
+    assert len(back) == len(nested)
+
+    def _eq(a, b):
+        if isinstance(a, list):
+            assert isinstance(b, list) and len(a) == len(b)
+            for x, y in zip(a, b):
+                _eq(x, y)
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    _eq(nested, back)
+
+
+def test_three_level_offsets_roundtrip_matches_reference_form():
+    """LoDBatch <-> the reference's (flat rows, offset levels) encoding
+    (lod_tensor.h:82): a 3-level LoD round-trips exactly both ways."""
+    # 2 samples; sample 0 has 2 level-1 children, sample 1 has 1
+    lod = [(0, 2, 3), (0, 2, 5, 7), (0, 3, 5, 9, 11, 12, 15, 17)]
+    flat = RS.randn(17, 4).astype(np.float32)
+    batch = lod_batch_from_offsets(flat, lod)
+    assert batch.nlevels == 3
+    assert batch.batch_size == 2
+    # padded shape: [B=2, S1=2, S2=3, T=4, 4]
+    assert batch.data.shape == (2, 2, 3, 4, 4)
+    flat2, lod2 = lod_batch_to_offsets(batch)
+    assert [tuple(l) for l in lod2] == [tuple(l) for l in lod]
+    np.testing.assert_array_equal(flat2, flat)
+
+
+def test_from_offsets_rejects_inconsistent_lod():
+    with pytest.raises(ValueError, match="covers 3"):
+        lod_batch_from_offsets(np.zeros((2, 4), np.float32), [(0, 3)])
+    with pytest.raises(ValueError, match="level 0 covers"):
+        lod_batch_from_offsets(np.zeros((5, 4), np.float32),
+                               [(0, 1), (0, 2, 5)])
+    with pytest.raises(ValueError, match="non-decreasing"):
+        lod_batch_from_offsets(np.zeros((2, 4), np.float32), [(0, 2, 1, 2)])
+    with pytest.raises(ValueError, match="start at 0"):
+        lod_batch_from_offsets(np.zeros((2, 4), np.float32), [(1, 2)])
+
+
+def test_three_level_masks_and_flat_view():
+    lod = [(0, 2, 3), (0, 2, 5, 7), (0, 3, 5, 9, 11, 12, 15, 17)]
+    flat = RS.randn(17, 4).astype(np.float32)
+    b = lod_batch_from_offsets(flat, lod)
+    m0 = np.asarray(b.mask(0))             # [B, S1]
+    assert m0.tolist() == [[1, 1], [1, 0]]
+    m2 = np.asarray(b.mask(2))             # [B, S1, S2, T]
+    # total valid timesteps == rows of the flat tensor
+    assert int(m2.sum()) == 17
+    inner = b.innermost_flat()
+    assert isinstance(inner, SeqBatch)
+    assert inner.data.shape == (2 * 2 * 3, 4, 4)
+    # all valid rows survive in the flat view
+    assert int(np.asarray(inner.lengths).sum()) == 17
+
+
+def test_three_level_sequence_op_composes_and_jits():
+    """The reference's nested recurrent_group composition at depth 3:
+    reduce innermost sequences (masked mean), lift, reduce again (masked
+    sum), lift, then pool the outer sequence — all under one jit."""
+    nested = [_rand_nested(3) for _ in range(4)]
+    batch = pack_lod(nested, 3)
+
+    @jax.jit
+    def pipeline(b: LoDBatch):
+        inner = b.innermost_flat()                  # [N2, T, F]
+        m = inner.mask()                            # [N2, T]
+        denom = jnp.maximum(m.sum(-1, keepdims=True), 1.0)
+        mean2 = (inner.data * m[..., None]).sum(1) / denom   # [N2, F]
+        lvl2 = b.lift(mean2)                        # 2-level batch [B,S1,S2,F]
+        inner1 = lvl2.innermost_flat()              # [N1, S2, F]
+        s = (inner1.data * inner1.mask()[..., None]).sum(1)  # [N1, F]
+        lvl1 = lvl2.lift(s)                         # 1-level batch [B, S1, F]
+        top = lvl1.as_seq_batch()
+        return (top.data * top.mask()[..., None]).sum(1)     # [B, F]
+
+    got = np.asarray(pipeline(batch))
+
+    # plain-python reference over the ragged lists
+    want = []
+    for sample in nested:
+        acc = np.zeros(2, np.float32)
+        for sub in sample:
+            for seq in sub:
+                if len(seq):
+                    acc += np.asarray(seq).mean(0)
+        want.append(acc)
+    np.testing.assert_allclose(got, np.stack(want), rtol=1e-5, atol=1e-5)
+
+
+def test_lod_batch_is_a_pytree():
+    nested = [_rand_nested(3) for _ in range(2)]
+    b = pack_lod(nested, 3)
+    leaves, treedef = jax.tree_util.tree_flatten(b)
+    assert len(leaves) == 4  # data + 3 length arrays
+    b2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(b2, LoDBatch) and b2.nlevels == 3
+    # grads flow through the data leaf (lengths stay int32 aux inputs)
+    g = jax.grad(lambda d: jnp.sum(
+        LoDBatch(d, b.level_lengths).innermost_flat().data ** 2))(b.data)
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(b.data))
+
+
+def test_as_nested_matches_two_level_packer():
+    from paddle_tpu.core.lod import pack_nested_sequences
+    nested = [_rand_nested(2) for _ in range(3)]
+    a = pack_lod(nested, 2).as_nested()
+    b = pack_nested_sequences(nested, bucket=False)
+    assert a.data.shape == b.data.shape
+    np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+    np.testing.assert_array_equal(np.asarray(a.sub_lengths),
+                                  np.asarray(b.sub_lengths))
+    np.testing.assert_array_equal(np.asarray(a.seq_lengths),
+                                  np.asarray(b.seq_lengths))

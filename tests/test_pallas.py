@@ -120,6 +120,39 @@ def test_flash_attention_kv_lens_matches_masked_reference(causal):
     assert np.all(np.asarray(dv)[2, 5:] == 0)
 
 
+@pytest.mark.parametrize("dense_route", [True, False])
+def test_flash_attention_kv_len_zero_sample_is_zeroed(dense_route):
+    """A fully-masked sample (kv_lens == 0) must produce exactly-zero output
+    rows and exactly-zero grads — not garbage/NaN — on both the short-seq
+    dense route and the Pallas route; other samples must be unaffected."""
+    rng = jax.random.PRNGKey(13)
+    kq, kk, kv = jax.random.split(rng, 3)
+    B, T, S, H, D = 3, 32, 32, 2, 16
+    q = jax.random.normal(kq, (B, T, H, D))
+    k = jax.random.normal(kk, (B, S, H, D))
+    v = jax.random.normal(kv, (B, S, H, D))
+    lens = jnp.array([32, 0, 5], jnp.int32)
+    blocks = {} if dense_route else dict(block_q=16, block_k=16,
+                                         interpret=True)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, kv_lens=lens, **blocks)
+
+    out = np.asarray(f(q, k, v))
+    assert np.all(np.isfinite(out))
+    assert np.all(out[1] == 0)
+    # the other samples match a run without the dead sample in the batch
+    ref = np.asarray(flash_attention(q[::2], k[::2], v[::2],
+                                     kv_lens=lens[::2], **blocks))
+    np.testing.assert_allclose(out[::2], ref, rtol=2e-5, atol=2e-5)
+
+    dq, dk, dv = jax.grad(lambda *a: jnp.sum(f(*a)), (0, 1, 2))(q, k, v)
+    for garr in (dq, dk, dv):
+        garr = np.asarray(garr)
+        assert np.all(np.isfinite(garr))
+        assert np.all(garr[1] == 0)
+
+
 def test_flash_cross_attention_shorter_kv():
     """S != T cross-attention shape with kv_lens (the NMT decoder->encoder
     use): matches the dense reference."""

@@ -299,6 +299,10 @@ SPECS = {
     "gru": dict(ins={"X": [f32(B, T, D)], "Lengths": [LENGTHS],
                      "W": [f32(D, 3 * H)], "U": [f32(H, 3 * H)],
                      "B": [f32(3 * H)]}, out="Out", grad=[("W", 0)]),
+    "simple_rnn": dict(ins={"X": [f32(B, T, D)], "Lengths": [LENGTHS],
+                            "W": [f32(D, H)], "U": [f32(H, H)],
+                            "B": [f32(H)]}, out="Out",
+                       grad=[("W", 0), ("U", 0)]),
     "lstm_unit": dict(ins={"X": [f32(B, 4 * H)], "HPrev": [f32(B, H)],
                            "CPrev": [f32(B, H)], "U": [f32(H, 4 * H)],
                            "B": [f32(4 * H)]}, out="H",

@@ -1,0 +1,114 @@
+"""Verbatim v2 DSL name parity.
+
+The reference exports 115 names from its layer DSL
+(trainer_config_helpers/layers.py:34-140 ``__all__``). Every one must be
+importable from ``paddle_tpu.v2.layer`` under its reference spelling —
+either as the canonical implementation or a documented alias
+(docs/v2_layer_parity.md).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.v2.layer as L
+
+# the reference's __all__, verbatim (layers.py:34-140)
+REFERENCE_ALL = [
+    "full_matrix_projection", "AggregateLevel", "ExpandLevel",
+    "identity_projection", "dotmul_projection", "dotmul_operator",
+    "repeat_layer", "seq_reshape_layer", "table_projection", "mixed_layer",
+    "data_layer", "embedding_layer", "fc_layer", "grumemory",
+    "pooling_layer", "lstmemory", "last_seq", "first_seq", "cos_sim",
+    "hsigmoid", "conv_projection", "square_error_cost", "regression_cost",
+    "classification_cost", "LayerOutput", "img_conv_layer",
+    "img_pool_layer", "batch_norm_layer", "img_cmrnorm_layer",
+    "addto_layer", "concat_layer", "seq_concat_layer", "lstm_step_layer",
+    "recurrent_group", "memory", "StaticInput", "expand_layer",
+    "scaling_layer", "scaling_projection", "power_layer",
+    "interpolation_layer", "bilinear_interp_layer", "trans_layer",
+    "rotate_layer", "sum_to_one_norm_layer", "row_l2_norm_layer",
+    "get_output_layer", "LayerType", "context_projection", "beam_search",
+    "maxid_layer", "GeneratedInput", "SubsequenceInput", "gru_step_layer",
+    "gru_step_naive_layer", "recurrent_layer", "BaseGeneratedInput",
+    "conv_operator", "conv_shift_layer", "tensor_layer",
+    "selective_fc_layer", "sampling_id_layer", "slope_intercept_layer",
+    "trans_full_matrix_projection", "linear_comb_layer",
+    "convex_comb_layer", "ctc_layer", "warp_ctc_layer", "crf_layer",
+    "crf_decoding_layer", "nce_layer", "cross_entropy_with_selfnorm",
+    "cross_entropy", "BeamInput", "cross_entropy_over_beam",
+    "multi_binary_label_cross_entropy", "sum_cost", "rank_cost",
+    "lambda_cost", "huber_regression_cost", "huber_classification_cost",
+    "block_expand_layer", "maxout_layer", "dot_prod_layer",
+    "out_prod_layer", "printer_layer", "print_layer", "priorbox_layer",
+    "cross_channel_norm_layer", "multibox_loss_layer",
+    "detection_output_layer", "roi_pool_layer", "spp_layer", "pad_layer",
+    "eos_layer", "smooth_l1_cost", "layer_support", "multiplex_layer",
+    "row_conv_layer", "dropout_layer", "prelu_layer",
+    "switch_order_layer", "gated_unit_layer", "crop_layer",
+    "sub_nested_seq_layer", "clip_layer", "slice_projection",
+    "seq_slice_layer", "kmax_seq_score_layer", "img_pool3d_layer",
+    "scale_shift_layer", "img_conv3d_layer", "resize_layer",
+    "sub_seq_layer", "scale_sub_region_layer",
+]
+
+
+def test_reference_all_is_115_names():
+    assert len(REFERENCE_ALL) == 115
+    assert len(set(REFERENCE_ALL)) == 115
+
+
+@pytest.mark.parametrize("name", REFERENCE_ALL)
+def test_reference_name_importable(name):
+    """`from paddle_tpu.v2.layer import <name>` works for every reference
+    spelling and yields a callable or a DSL class/enum, never None."""
+    assert hasattr(L, name), name
+    assert getattr(L, name) is not None
+
+
+def test_enum_values_match_reference():
+    assert L.AggregateLevel.TO_NO_SEQUENCE == "non-seq"
+    assert L.AggregateLevel.TO_SEQUENCE == "seq"
+    assert L.AggregateLevel.EACH_TIMESTEP == "non-seq"
+    assert L.ExpandLevel.FROM_NO_SEQUENCE == "non-seq"
+    assert L.ExpandLevel.FROM_TIMESTEP == "non-seq"
+    assert L.LayerType.is_layer_type("fc")
+    assert not L.LayerType.is_layer_type("no_such_layer")
+
+
+def test_generated_input_is_base_generated_input():
+    gi = L.GeneratedInput(size=7, embedding_size=4)
+    assert isinstance(gi, L.BaseGeneratedInput)
+    assert gi.bos_id is None and gi.eos_id is None
+
+
+def test_recurrent_layer_runs_and_matches_manual_scan():
+    """recurrent_layer compiles to a masked scan with the reference's
+    h_t = act(x_t + h_{t-1} @ U + b) semantics (RecurrentLayer.cpp)."""
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.fluid.executor import Executor
+
+    fluid.reset_default_programs()
+    x = L.data("x", paddle.data_type.dense_vector_sequence(5))
+    out = L.recurrent_layer(x)
+    xs = np.random.RandomState(0).randn(2, 4, 5).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    exe = Executor()
+    exe.run(fluid.default_startup_program())
+    res = np.asarray(exe.run(fluid.default_main_program(),
+                             feed={"x": xs, "x__len__": lens},
+                             fetch_list=[out.var.name])[0])
+    assert res.shape == (2, 4, 5)
+    # manual replay with the created parameters (uniquified names: find by
+    # prefix in the program's parameter list)
+    gb = fluid.default_main_program().global_block()
+    u = np.asarray(exe.scope.get(next(n for n in gb.vars if "rnn_u" in n)))
+    bvec = np.asarray(exe.scope.get(next(n for n in gb.vars
+                                         if "rnn_b" in n)))
+    h = np.zeros((2, 5), np.float32)
+    want = np.zeros_like(xs)
+    for t in range(4):
+        h_new = np.tanh(xs[:, t] + h @ u + bvec)
+        m = (t < lens)[:, None]
+        h = np.where(m, h_new, h)
+        want[:, t] = np.where(m, h, 0.0)
+    np.testing.assert_allclose(res, want, rtol=1e-5, atol=1e-5)
