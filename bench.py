@@ -37,7 +37,10 @@ import time
 
 ROOT = os.path.dirname(os.path.abspath(__file__))
 ROW_TIMEOUT = 420.0        # compile (~40-90 s) + measure, with slack
-BIG_TIMEOUT = 900.0        # rows with heavy host-side setup (20 GB table)
+# host_embedding measured 110 s end-to-end once the native zero-fill path
+# removed the 20 GB numpy+memcpy init (was ~90 s of the old ~200 s); 300
+# declares honest headroom so the default budget run keeps the row
+BIG_TIMEOUT = 300.0
 # Global wall budget for the SECONDARY rows: the flagship is measured first
 # and guaranteed; once the budget is gone the remaining secondaries are
 # skipped (loudly) and the run exits 0 — rc=0 + flagship-last hold even
@@ -216,6 +219,8 @@ def main(full: bool = False):
     for name in mods:
         rows.append((f"__import__('benchmarks.{name}', fromlist=['x'])"
                      ".run()", ROW_TIMEOUT))
+    rows.append(("__import__('benchmarks.serving_decode', fromlist=['x'])"
+                 ".run_continuous()", ROW_TIMEOUT))
     if full:
         rows.append(("__import__('benchmarks.resnet50', fromlist=['x'])"
                      ".run_with_infeed()", ROW_TIMEOUT))

@@ -1,0 +1,475 @@
+// Length-framed RPC server for the task master — the C++ host-RPC plane
+// (reference: pserver/ProtoServer.h:36 length-framed messages over raw
+// sockets + go/master/service.go's RPC surface). The accept/dispatch loop
+// runs natively over the ptm_* C ABI (task_master.cc); Python keeps the
+// control plane (lease election, fencing decisions, snapshot policy) and
+// pushes the resulting fenced flag down via ptms_set_fenced.
+//
+// Wire format (runtime/master_service.py): uint32 LE body length + JSON
+// body. Requests: {"op": str, "task_id"?: int, "payloads"?: [str]}.
+// Responses mirror MasterServer._dispatch exactly, including the
+// "fenced: ..." error string the client's failover logic matches on.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+// task_master.cc C ABI
+extern "C" {
+void ptm_set_dataset(void* h, const char** payloads, int n);
+int ptm_get_task(void* h, double now, char* buf, int buflen, int* needed);
+int ptm_task_finished(void* h, int task_id);
+int ptm_task_failed(void* h, int task_id);
+int ptm_new_pass(void* h);
+void ptm_stats(void* h, int* todo, int* pending, int* done, int* discarded,
+               int* epoch);
+}
+
+namespace {
+
+constexpr uint32_t kMaxFrame = 64u << 20;  // 64 MB request guard
+
+double mono_now() {
+  // CLOCK_MONOTONIC — the same clock Python's time.monotonic() uses, so
+  // deadlines set here agree with the Python housekeeping tick's clock
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+// ---------------------------------------------------------------- JSON ----
+// Minimal parser for the request shapes above (full string escapes incl.
+// \uXXXX with surrogate pairs) and an escaping emitter for responses.
+
+struct Parser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit Parser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++; }
+
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  void utf8(uint32_t cp, std::string* out) {
+    if (cp < 0x80) { out->push_back((char)cp); }
+    else if (cp < 0x800) {
+      out->push_back((char)(0xC0 | (cp >> 6)));
+      out->push_back((char)(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out->push_back((char)(0xE0 | (cp >> 12)));
+      out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back((char)(0x80 | (cp & 0x3F)));
+    } else {
+      out->push_back((char)(0xF0 | (cp >> 18)));
+      out->push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+      out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back((char)(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool hex4(uint32_t* v) {
+    if (end - p < 4) return false;
+    *v = 0;
+    for (int i = 0; i < 4; i++) {
+      char c = *p++;
+      *v <<= 4;
+      if (c >= '0' && c <= '9') *v |= c - '0';
+      else if (c >= 'a' && c <= 'f') *v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') *v |= c - 'A' + 10;
+      else return false;
+    }
+    return true;
+  }
+
+  bool str(std::string* out) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    p++;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        p++;
+        if (p >= end) return false;
+        char c = *p++;
+        switch (c) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            uint32_t cp;
+            if (!hex4(&cp)) return false;
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
+              if (!lit("\\u")) return false;
+              uint32_t lo;
+              if (!hex4(&lo) || lo < 0xDC00 || lo > 0xDFFF) return false;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            utf8(cp, out);
+            break;
+          }
+          default: return false;
+        }
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= end) return false;
+    p++;  // closing quote
+    return true;
+  }
+
+  bool integer(long long* out) {
+    ws();
+    char* q = nullptr;
+    long long v = strtoll(p, &q, 10);
+    if (q == p) return false;
+    *out = v;
+    p = q;
+    return true;
+  }
+
+  // skip any JSON value (for unknown keys)
+  bool skip() {
+    ws();
+    if (p >= end) return false;
+    if (*p == '"') { std::string s; return str(&s); }
+    if (*p == '{' || *p == '[') {
+      char open = *p, close = (open == '{') ? '}' : ']';
+      p++;
+      ws();
+      if (p < end && *p == close) { p++; return true; }
+      for (;;) {
+        if (open == '{') {
+          std::string k;
+          if (!str(&k)) return false;
+          ws();
+          if (p >= end || *p != ':') return false;
+          p++;
+        }
+        if (!skip()) return false;
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == close) { p++; return true; }
+        return false;
+      }
+    }
+    if (lit("true") || lit("false") || lit("null")) return true;
+    long long v;
+    return integer(&v);
+  }
+};
+
+struct Request {
+  std::string op;
+  long long task_id = -1;
+  std::vector<std::string> payloads;
+  bool ok = false;
+};
+
+Request parse_request(const std::string& body) {
+  Request r;
+  Parser ps(body);
+  ps.ws();
+  if (ps.p >= ps.end || *ps.p != '{') return r;
+  ps.p++;
+  ps.ws();
+  if (ps.p < ps.end && *ps.p == '}') { ps.p++; r.ok = true; return r; }
+  for (;;) {
+    std::string key;
+    if (!ps.str(&key)) return r;
+    ps.ws();
+    if (ps.p >= ps.end || *ps.p != ':') return r;
+    ps.p++;
+    if (key == "op") {
+      if (!ps.str(&r.op)) return r;
+    } else if (key == "task_id") {
+      if (!ps.integer(&r.task_id)) return r;
+    } else if (key == "payloads") {
+      ps.ws();
+      if (ps.p >= ps.end || *ps.p != '[') return r;
+      ps.p++;
+      ps.ws();
+      if (ps.p < ps.end && *ps.p == ']') {
+        ps.p++;
+      } else {
+        for (;;) {
+          std::string s;
+          if (!ps.str(&s)) return r;
+          r.payloads.push_back(std::move(s));
+          ps.ws();
+          if (ps.p < ps.end && *ps.p == ',') { ps.p++; continue; }
+          if (ps.p < ps.end && *ps.p == ']') { ps.p++; break; }
+          return r;
+        }
+      }
+    } else {
+      if (!ps.skip()) return r;
+    }
+    ps.ws();
+    if (ps.p < ps.end && *ps.p == ',') { ps.p++; continue; }
+    if (ps.p < ps.end && *ps.p == '}') { ps.p++; r.ok = true; return r; }
+    return r;
+  }
+}
+
+void json_escape(const std::string& s, std::string* out) {
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back((char)c);
+        }
+    }
+  }
+}
+
+// ---------------------------------------------------------------- server --
+
+struct Server {
+  void* master = nullptr;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> fenced{false};
+  std::thread accept_thread;
+  std::mutex mu;                 // guards conns + active
+  std::condition_variable cv;    // signals active reaching 0
+  std::set<int> conns;
+  int active = 0;                // live (detached) handler threads
+
+  std::string dispatch(const Request& req) {
+    static const char* kMutating[] = {"set_dataset", "get_task",
+                                      "task_finished", "task_failed",
+                                      "new_pass"};
+    bool mutating = false;
+    for (const char* m : kMutating) mutating |= (req.op == m);
+    if (mutating && fenced.load()) {
+      return "{\"ok\": false, \"error\": \"fenced: stale master token\"}";
+    }
+    if (req.op == "set_dataset") {
+      std::vector<const char*> ptrs;
+      ptrs.reserve(req.payloads.size());
+      for (const auto& s : req.payloads) ptrs.push_back(s.c_str());
+      ptm_set_dataset(master, ptrs.data(), (int)ptrs.size());
+      return "{\"ok\": true}";
+    }
+    if (req.op == "get_task") {
+      std::vector<char> buf(4096);
+      int id, needed = 0;
+      for (;;) {
+        id = ptm_get_task(master, mono_now(), buf.data(), (int)buf.size(),
+                          &needed);
+        if (id == -3) { buf.resize(needed); continue; }
+        break;
+      }
+      if (id < 0) {
+        return std::string("{\"ok\": true, \"task\": null, "
+                           "\"pass_finished\": ") +
+               (id == -2 ? "true}" : "false}");
+      }
+      std::string out = "{\"ok\": true, \"task\": {\"id\": ";
+      out += std::to_string(id);
+      out += ", \"payload\": \"";
+      json_escape(buf.data(), &out);
+      out += "\"}}";
+      return out;
+    }
+    if (req.op == "task_finished") {
+      ptm_task_finished(master, (int)req.task_id);
+      return "{\"ok\": true}";
+    }
+    if (req.op == "task_failed") {
+      int discarded = ptm_task_failed(master, (int)req.task_id);
+      return std::string("{\"ok\": true, \"discarded\": ") +
+             (discarded == 1 ? "true}" : "false}");
+    }
+    if (req.op == "new_pass") {
+      return std::string("{\"ok\": ") +
+             (ptm_new_pass(master) == 0 ? "true}" : "false}");
+    }
+    if (req.op == "stats") {
+      int todo, pending, done, disc, epoch;
+      ptm_stats(master, &todo, &pending, &done, &disc, &epoch);
+      std::string out = "{\"ok\": true, \"todo\": " + std::to_string(todo);
+      out += ", \"pending\": " + std::to_string(pending);
+      out += ", \"done\": " + std::to_string(done);
+      out += ", \"discarded\": " + std::to_string(disc);
+      out += ", \"epoch\": " + std::to_string(epoch) + "}";
+      return out;
+    }
+    std::string out = "{\"ok\": false, \"error\": \"unknown op '";
+    json_escape(req.op, &out);
+    out += "'\"}";
+    return out;
+  }
+
+  static bool recv_exact(int fd, char* buf, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+      ssize_t r = recv(fd, buf + got, n - got, 0);
+      if (r <= 0) return false;
+      got += (size_t)r;
+    }
+    return true;
+  }
+
+  static bool send_all(int fd, const char* buf, size_t n) {
+    size_t sent = 0;
+    while (sent < n) {
+      ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      sent += (size_t)r;
+    }
+    return true;
+  }
+
+  void handle(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    while (!stop.load()) {
+      uint32_t len_le;
+      if (!recv_exact(fd, (char*)&len_le, 4)) break;
+      uint32_t n = le32toh(len_le);
+      if (n > kMaxFrame) break;
+      std::string body(n, '\0');
+      if (n && !recv_exact(fd, &body[0], n)) break;
+      Request req = parse_request(body);
+      std::string resp =
+          req.ok ? dispatch(req)
+                 : std::string("{\"ok\": false, \"error\": \"bad request\"}");
+      uint32_t out_le = htole32((uint32_t)resp.size());
+      char hdr[4];
+      memcpy(hdr, &out_le, 4);
+      if (!send_all(fd, hdr, 4) ||
+          !send_all(fd, resp.data(), resp.size()))
+        break;
+    }
+    // erase BEFORE close: once closed, the kernel may hand the same fd
+    // number to a concurrent accept — erasing after would remove the NEW
+    // connection from the set and ptms_stop could never sever it
+    {
+      std::lock_guard<std::mutex> g(mu);
+      conns.erase(fd);
+    }
+    close(fd);
+    {
+      std::lock_guard<std::mutex> g(mu);
+      if (--active == 0) cv.notify_all();
+    }
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> g(mu);
+      if (stop.load()) { close(fd); return; }
+      conns.insert(fd);
+      active++;
+      // detached: liveness is tracked by `active` (bounded by open
+      // connections), not by an ever-growing vector of joinable threads
+      std::thread([this, fd] { handle(fd); }).detach();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Start serving `master` (a ptm_create handle) on host:port (port 0 = any;
+// the bound port is written to *out_port). Returns a server handle or NULL.
+void* ptms_start(void* master, const char* host, int port, int* out_port) {
+  auto* s = new Server();
+  s->master = master;
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) { delete s; return nullptr; }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (!host || !*host) host = "127.0.0.1";
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof addr) != 0 ||
+      listen(s->listen_fd, 64) != 0) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = s->port;
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int ptms_port(void* h) { return static_cast<Server*>(h)->port; }
+
+// Fencing flag, pushed from the Python control plane (lease/fence checks):
+// while set, mutating ops answer the "fenced: ..." error the client's
+// failover logic matches on; reads (stats) still serve.
+void ptms_set_fenced(void* h, int fenced) {
+  static_cast<Server*>(h)->fenced.store(fenced != 0);
+}
+
+void ptms_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // sever every live connection, then wait for the detached handlers to
+  // drain (they erase themselves and decrement `active` on exit)
+  std::unique_lock<std::mutex> g(s->mu);
+  for (int fd : s->conns) ::shutdown(fd, SHUT_RDWR);
+  s->cv.wait(g, [s] { return s->active == 0; });
+  g.unlock();
+  delete s;
+}
+
+}  // extern "C"

@@ -374,9 +374,17 @@ def _reap_remote_job(args, hosts, job_id: str):
     import shlex
     import subprocess
 
+    import shlex as _shlex
+
     template = args.ssh_template or "ssh {host} {cmd}"
-    kill = (f"pkill -TERM -f PADDLE_TPU_JOB_ID={job_id}; sleep 2; "
-            f"pkill -KILL -f PADDLE_TPU_JOB_ID={job_id}; true")
+    # bracket the first id char: the regex still matches the literal job id
+    # in the supervisors' cmdlines, but the REAPING shell's own cmdline
+    # (which contains the pattern text "…=[x]yz") does not match it — so
+    # pkill never TERMs the shell running the sleep+KILL escalation (the
+    # reference's grep -v marker trick, paddle.py kill_process)
+    pat = f"PADDLE_TPU_JOB_ID=[{job_id[0]}]{job_id[1:]}"
+    kill = (f"pkill -TERM -f {_shlex.quote(pat)}; sleep 2; "
+            f"pkill -KILL -f {_shlex.quote(pat)}; true")
     for host in hosts:
         cmd = template.format(host=shlex.quote(host), cmd=shlex.quote(kill))
         try:
@@ -396,7 +404,8 @@ def _multihost_attempt(args, hosts, attempt: int) -> int:
     import os
     import subprocess
 
-    job_id = f"{os.getpid():x}.{attempt}"
+    # dot-free id: it doubles as a pkill -f regex literal in the reaper
+    job_id = f"{os.getpid():x}x{attempt}"
     cmds = _render_host_commands(args, hosts, attempt, job_id)
     procs = [subprocess.Popen(c, shell=True) for c in cmds]
     rc = _poll_job(procs, args.timeout, args.grace)

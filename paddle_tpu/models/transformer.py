@@ -187,14 +187,26 @@ class TransformerLM(nn.Module):
         return ids
 
     # -- incremental decoding (the serving path) ---------------------------
-    def prefill(self, params, prompt):
+    def prefill(self, params, prompt, lengths=None):
         """Run the prompt once, materializing per-layer KV caches padded to
         max_len. Returns (cell, last_logits [B, V]); cell carries the caches
-        and the per-sample write position."""
+        and the per-sample write position.
+
+        ``lengths`` [B] (optional) makes the prompt batch RAGGED — prompts
+        right-padded to a common T0. Each sample's write position starts at
+        its true length and its returned logits are the ones at position
+        length-1. Padded-tail cache rows briefly hold garbage k/v, but the
+        decode mask (j <= pos) never reads a row past ``pos``, and each
+        generation step overwrites row ``pos`` before advancing — so the
+        garbage is overwritten strictly before it becomes readable. This is
+        the slot-refill path of continuous batching (serving.py)."""
         B, T0 = prompt.shape
         x = self.embed(params["embed"], prompt)
         x = x + params["pos_embed"][:T0].astype(x.dtype)
-        cell = {"pos": jnp.full((B,), T0, jnp.int32)}
+        if lengths is None:
+            cell = {"pos": jnp.full((B,), T0, jnp.int32)}
+        else:
+            cell = {"pos": jnp.asarray(lengths, jnp.int32)}
         pad = self.max_len - T0
         for i in range(len(self.blocks)):
             blk = self.blocks[i]
@@ -206,7 +218,9 @@ class TransformerLM(nn.Module):
         x = self.ln_f(params["ln_f"], x)
         logits = (x @ params["embed"]["w"].T.astype(x.dtype)
                   if self.tie_head else self.head(params["head"], x))
-        return cell, logits[:, -1]
+        if lengths is None:
+            return cell, logits[:, -1]
+        return cell, logits[jnp.arange(B), cell["pos"] - 1]
 
     def decode_step(self, params, cell, tokens, *,
                     cache_len: Optional[int] = None):

@@ -70,6 +70,9 @@ class HostEmbeddingTable:
             rs = np.random.RandomState(seed)
             init = (rs.standard_normal((vocab_size, dim)) * 0.01).astype(
                 np.float32)
+        elif isinstance(init, str) and init == "zeros":
+            # native zero-fill: no numpy source buffer, no 20 GB memcpy
+            init = (vocab_size, dim)
         self.opt = HostOptimizer(optimizer, init, lr=lr, **opt_kw)
         # np.dtype resolves jnp.bfloat16 via ml_dtypes; f32 = exact master
         self.compute_dtype = np.dtype(compute_dtype if compute_dtype

@@ -89,6 +89,14 @@ def _configure(lib: ctypes.CDLL):
     lib.ptm_snapshot.argtypes = [c.c_void_p, c.c_char_p]
     lib.ptm_restore.restype = c.c_int
     lib.ptm_restore.argtypes = [c.c_void_p, c.c_char_p]
+    # master RPC server (master_server.cc — ProtoServer-analog data plane)
+    lib.ptms_start.restype = c.c_void_p
+    lib.ptms_start.argtypes = [c.c_void_p, c.c_char_p, c.c_int,
+                               c.POINTER(c.c_int)]
+    lib.ptms_port.restype = c.c_int
+    lib.ptms_port.argtypes = [c.c_void_p]
+    lib.ptms_set_fenced.argtypes = [c.c_void_p, c.c_int]
+    lib.ptms_stop.argtypes = [c.c_void_p]
     # recordio
     lib.ptr_writer_open.restype = c.c_void_p
     lib.ptr_writer_open.argtypes = [c.c_char_p]

@@ -2,10 +2,13 @@
 
 Re-provides the reference's distributed data-dispatch plane:
 * Go master RPC service (go/master/service.go GetTask/TaskFinished/TaskFailed
-  RPCs) -> :class:`MasterServer` serving the native C++ TaskMaster
-  (native/task_master.cc) over a length-prefixed JSON protocol — the framing
-  discipline of ProtoServer (pserver/ProtoServer.h:36: length-framed proto
-  messages over raw sockets).
+  RPCs) -> :class:`MasterServer`. The accept/dispatch loop itself is C++
+  (native/master_server.cc serving the native TaskMaster,
+  native/task_master.cc) over a length-prefixed JSON protocol — the framing
+  discipline AND the native socket plane of ProtoServer
+  (pserver/ProtoServer.h:36: length-framed messages over raw sockets).
+  Python keeps the control plane (lease election, fencing, snapshots) and
+  pushes the fencing flag down to the native dispatch.
 * auto-reconnecting client (go/connection/conn.go) -> :class:`MasterClient`.
 * periodic timeout tick + snapshot (service.go:198-200, :166-227) -> the
   server's housekeeping thread.
@@ -19,7 +22,6 @@ from __future__ import annotations
 
 import json
 import socket
-import socketserver
 import struct
 import threading
 import time
@@ -108,32 +110,9 @@ class MasterServer:
         self._deposed = False
         self._fence_checked_at = float("-inf")
         self.lease_lost = threading.Event()
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def setup(self):
-                with outer._conn_lock:
-                    outer._conns.add(self.request)
-
-            def finish(self):
-                with outer._conn_lock:
-                    outer._conns.discard(self.request)
-
-            def handle(self):
-                while not outer._stop.is_set():
-                    req = _recv_msg(self.request)
-                    if req is None:
-                        return
-                    _send_msg(self.request, outer._dispatch(req))
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._conns: set = set()
-        self._conn_lock = threading.Lock()
-        self._server = Server((host, port), Handler)
-        self.address: Tuple[str, int] = self._server.server_address
+        self._host, self._port = host, port
+        self._srv_h = None        # native server handle (master_server.cc)
+        self.address: Tuple[str, int] = (host, port)
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -145,13 +124,11 @@ class MasterServer:
             # us: it refreshes the TTL and recovers the fencing token after
             # a same-owner restart
             if not self.lease.try_acquire():
-                self._server.server_close()   # don't leak the bound socket
                 raise RuntimeError(
                     f"lease {self.lease.path} held by {self.lease.holder()}")
             self.fence_token = self.lease.token
             if self._fence is not None and \
                     not self._fence.claim(self.fence_token):
-                self._server.server_close()
                 self.lease.release()   # don't wedge standby takeover
                 fence_loc = getattr(self._fence, "fence_path",
                                     getattr(self._fence, "key", "?"))
@@ -163,11 +140,36 @@ class MasterServer:
                     "seed the lease epoch past the recorded value")
             self._keeper = LeaseKeeper(self.lease, on_lost=self._on_lease_lost)
             self._keeper.start()
-        t = threading.Thread(target=self._server.serve_forever, daemon=True)
-        t.start()
-        h = threading.Thread(target=self._housekeeping, daemon=True)
-        h.start()
-        self._threads = [t, h]
+        # the accept/dispatch loop is NATIVE (master_server.cc, the
+        # ProtoServer-analog): it serves the ptm_* data plane directly;
+        # Python retains the control plane and pushes the fenced flag down
+        import ctypes
+
+        from .lib import load_library
+        lib = load_library()
+        if lib is None:
+            if self._keeper is not None:
+                self._keeper.stop(release=True)
+                self._keeper = None
+            raise RuntimeError("native host runtime unavailable "
+                               "(libpaddle_tpu_host.so)")
+        out_port = ctypes.c_int(0)
+        h = lib.ptms_start(self.master._h, self._host.encode(), self._port,
+                           ctypes.byref(out_port))
+        if not h:
+            if self._keeper is not None:
+                self._keeper.stop(release=True)
+                self._keeper = None
+            raise OSError(f"ptms_start failed to bind "
+                          f"{self._host}:{self._port}")
+        self._srv_h = h
+        self._lib = lib
+        self.address = (self._host, out_port.value)
+        # push the initial fencing state before any request can mutate
+        lib.ptms_set_fenced(h, 1 if self._fenced_out() else 0)
+        hk = threading.Thread(target=self._housekeeping, daemon=True)
+        hk.start()
+        self._threads = [hk]
         return self
 
     def _on_lease_lost(self):
@@ -181,22 +183,11 @@ class MasterServer:
         if self._keeper is not None:
             self._keeper.stop(release=release_lease)
             self._keeper = None
-        self._server.shutdown()
-        self._server.server_close()
-        # shutdown() only stops the accept loop; live handler threads would
-        # keep answering connected clients from this (now deposed) master's
-        # state — the split-brain the lease exists to prevent. Sever them.
-        with self._conn_lock:
-            conns = list(self._conns)
-        for s in conns:
-            try:
-                s.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                s.close()
-            except OSError:
-                pass
+        # native stop severs the listener AND every live connection — a
+        # deposed master must not keep answering connected clients
+        h, self._srv_h = self._srv_h, None
+        if h:
+            self._lib.ptms_stop(h)
 
     def try_snapshot(self) -> bool:
         """Fenced snapshot write: refused (False) once a newer master has
@@ -221,6 +212,12 @@ class MasterServer:
                 # a newer master owns the snapshot: we are deposed
                 self._on_lease_lost()
                 return
+            # keep the native server's fencing flag current (the C++
+            # dispatch consults only this flag — same staleness bound as
+            # the old per-request cached check, one tick/renewal window)
+            if self._srv_h is not None:
+                self._lib.ptms_set_fenced(
+                    self._srv_h, 1 if self._fenced_out() else 0)
 
     def _fenced_out(self) -> bool:
         """Deposed-master check. Deposition is permanent, so a positive
@@ -262,6 +259,9 @@ class MasterServer:
          "new_pass"})
 
     # -- dispatch ----------------------------------------------------------
+    # The network path dispatches in C++ (master_server.cc, byte-identical
+    # protocol); this Python twin is the readable protocol reference and the
+    # in-process entry the fencing tests drive directly.
     def _dispatch(self, req):
         op = req.get("op")
         if op in self._MUTATING_OPS and self._fenced_out():

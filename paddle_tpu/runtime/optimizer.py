@@ -47,21 +47,32 @@ def _configure(lib):
 
 
 class HostOptimizer:
-    def __init__(self, opt_type: str, param: np.ndarray, lr: float = 0.01,
+    def __init__(self, opt_type: str, param, lr: float = 0.01,
                  lr_policy: str = "const", decay_a: float = 0.0,
                  decay_b: float = 0.0, mu: float = 0.9, rho: float = 0.95,
                  eps: float = 1e-6, beta1: float = 0.9, beta2: float = 0.999):
+        """``param`` may be a shape tuple instead of an array: the native
+        side then zero-fills in place — no host-side source buffer, no
+        copy. The fast path for >HBM embedding tables (a 20 GB table
+        starts as ONE allocation instead of numpy-zeros + memcpy)."""
         lib = load_library()
         if lib is None:
             raise RuntimeError("native host runtime unavailable")
         _configure(lib)
         self._lib = lib
-        self.shape = param.shape
-        flat = np.ascontiguousarray(param, np.float32).reshape(-1)
-        self.n = flat.size
+        if isinstance(param, tuple):
+            self.shape = param
+            self.n = int(np.prod(param))
+            src = None
+        else:
+            param = np.asarray(param)
+            self.shape = param.shape
+            flat = np.ascontiguousarray(param, np.float32).reshape(-1)
+            self.n = flat.size
+            src = flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
         self.opt_type = opt_type
         self._h = lib.pto_create(
-            _TYPES[opt_type], flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            _TYPES[opt_type], src,
             self.n, lr, _LR[lr_policy], decay_a, decay_b, mu, rho, eps,
             beta1, beta2)
 

@@ -1,0 +1,211 @@
+"""Continuous (in-flight) batching for KV-cache decode — the modern serving
+loop on top of the incremental-decode path (models/transformer.py
+prefill/decode_step; the 2017 reference's serving plane stops at the C
+inference ABI, capi/gradient_machine.h:73 — this is the modern capability
+axis on top of it).
+
+Design for the TPU/XLA regime:
+
+* The decode state is a fixed pool of ``slots`` — per-layer KV caches
+  padded to max_len plus a per-slot position vector. ``decode_step`` is
+  already per-sample-positional (writes at ``pos[b]``, masks reads at
+  ``j <= pos[b]``), so slots at DIFFERENT sequence positions decode in one
+  batched step — the core of continuous batching.
+* Host control happens only at SEGMENT boundaries: the device runs a jitted
+  ``lax.scan`` of ``segment`` steps, then the host collects the emitted
+  block, finishes requests (EOS / budget), and refills free slots by a
+  ragged ``prefill`` scattered into the pool. Per-token host round-trips
+  would pay a dispatch RTT per token; per-segment sync amortizes it 32x.
+* All shapes are bucketed (prompt pad bucket, cache-read bucket, fixed
+  segment) so the number of compiled programs is bounded.
+
+Exactness: each request's greedy continuation is token-for-token identical
+to running it alone through ``generate_cached`` (tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.lod import bucket_length
+
+
+@dataclass
+class Request:
+    """One generation request: prompt ids, generation budget, optional EOS
+    (generation stops BEFORE emitting eos_id; it is not returned)."""
+    rid: int
+    prompt: np.ndarray
+    max_new: int
+    eos_id: Optional[int] = None
+
+
+@dataclass
+class _Slot:
+    req: Optional[Request] = None
+    left: int = 0
+    out: List[int] = field(default_factory=list)
+
+
+class ContinuousBatcher:
+    def __init__(self, model, params, *, slots: int = 8, segment: int = 32,
+                 cache_bucket: int = 256,
+                 prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512)):
+        self.model, self.params = model, params
+        self.n_slots, self.segment = slots, segment
+        self.cache_bucket = cache_bucket
+        self.prompt_buckets = prompt_buckets
+        self._seg_fns = {}      # cache_len -> jitted segment scan
+        self._prefill_fns = {}  # Tpad -> jitted ragged prefill
+        self._merge = None      # jitted masked slot merge
+
+    # -- jitted pieces (cached per static shape) ---------------------------
+    def _seg_fn(self, cache_len: int):
+        fn = self._seg_fns.get(cache_len)
+        if fn is None:
+            model = self.model
+
+            def seg(params, cell, cur):
+                def body(carry, _):
+                    cell, cur = carry
+                    logits, cell = model.decode_step(params, cell, cur,
+                                                     cache_len=cache_len)
+                    nxt = jnp.argmax(logits, axis=-1).astype(cur.dtype)
+                    return (cell, nxt), cur
+                (cell, cur), toks = jax.lax.scan(body, (cell, cur), None,
+                                                 length=self.segment)
+                return cell, cur, jnp.moveaxis(toks, 0, 1)   # [B, segment]
+            fn = self._seg_fns.setdefault(cache_len, jax.jit(seg))
+        return fn
+
+    def _prefill_fn(self, tpad: int):
+        """Always full-pool-width [slots, tpad]: admissions place each new
+        request at ITS slot row (dummies elsewhere), so the only compile
+        axis is the prompt pad bucket — never the group size."""
+        fn = self._prefill_fns.get(tpad)
+        if fn is None:
+            model = self.model
+
+            def pf(params, prompts, lengths):
+                cell, last = model.prefill(params, prompts, lengths)
+                first = jnp.argmax(last, axis=-1).astype(prompts.dtype)
+                return cell, first
+            fn = self._prefill_fns.setdefault(tpad, jax.jit(pf))
+        return fn
+
+    def _merge_fn(self):
+        if self._merge is None:
+            def merge(cell, cur, new_cell, new_cur, mask):
+                def mix(old, new):
+                    m = mask.reshape((-1,) + (1,) * (old.ndim - 1))
+                    return jnp.where(m, new, old)
+                cell = {k: mix(v, new_cell[k]) for k, v in cell.items()}
+                return cell, jnp.where(mask, new_cur, cur)
+            self._merge = jax.jit(merge)
+        return self._merge
+
+    # -- the serving loop --------------------------------------------------
+    def serve(self, requests: Sequence[Request]) -> Dict[int, np.ndarray]:
+        """Run every request to completion; returns {rid: generated ids}.
+        Order of completion depends on scheduling; results do not."""
+        queue = list(requests)
+        for r in queue:
+            r.prompt = np.asarray(r.prompt, np.int32).reshape(-1)
+            if r.prompt.size + 1 > self.model.max_len:
+                raise ValueError(f"request {r.rid}: prompt longer than "
+                                 f"max_len {self.model.max_len}")
+        slots = [_Slot() for _ in range(self.n_slots)]
+        results: Dict[int, np.ndarray] = {}
+
+        # device pool: allocate by prefilling a dummy full batch through the
+        # JITTED prefill at the smallest prompt bucket — admissions at that
+        # bucket reuse the compile, and nothing here runs eagerly (an eager
+        # prefill is ~25 dispatch round-trips on a remote-tunnel host)
+        tpad0 = min(bucket_length(1, self.prompt_buckets),
+                    self.model.max_len - 1)
+        dummy = np.zeros((self.n_slots, tpad0), np.int32)
+        cell, _ = self._prefill_fn(tpad0)(
+            self.params, jnp.asarray(dummy),
+            jnp.zeros((self.n_slots,), jnp.int32))
+        cur = jnp.zeros((self.n_slots,), jnp.int32)
+        pos_host = np.zeros((self.n_slots,), np.int64)
+
+        def admit():
+            nonlocal cell, cur
+            free = [i for i, s in enumerate(slots) if s.req is None]
+            if not queue or not free:
+                return
+            group = []
+            for i in free:
+                if not queue:
+                    break
+                group.append((i, queue.pop(0)))
+            tpad = bucket_length(max(r.prompt.size for _, r in group),
+                                 self.prompt_buckets)
+            tpad = min(tpad, self.model.max_len - 1)
+            prompts = np.zeros((self.n_slots, tpad), np.int32)
+            lens = np.zeros((self.n_slots,), np.int32)
+            mask = np.zeros((self.n_slots,), bool)
+            for i, r in group:
+                prompts[i, :r.prompt.size] = r.prompt
+                lens[i] = r.prompt.size
+                mask[i] = True
+            new_cell, first = self._prefill_fn(tpad)(
+                self.params, jnp.asarray(prompts), jnp.asarray(lens))
+            cell, cur = self._merge_fn()(cell, cur, new_cell, first,
+                                         jnp.asarray(mask))
+            for i, r in group:
+                slots[i].req = r
+                # the slot emits ``first`` then continues; cap the budget so
+                # positions stay inside max_len
+                slots[i].left = min(r.max_new,
+                                    self.model.max_len - r.prompt.size)
+                slots[i].out = []
+                pos_host[i] = r.prompt.size
+
+        def park_idle():
+            nonlocal cell, cur, pos_host
+            idle = [i for i, s in enumerate(slots) if s.req is None
+                    and pos_host[i] + 2 * self.segment >= self.model.max_len]
+            if idle:
+                idx = jnp.asarray(idle, jnp.int32)
+                newpos = cell["pos"].at[idx].set(0)
+                cell = dict(cell, pos=newpos)
+                pos_host[idle] = 0
+
+        admit()
+        while any(s.req is not None for s in slots):
+            park_idle()
+            # cache reads sized to the LIVE slots only: a drained slot
+            # decoding garbage at a high position must not drag every
+            # sample's HBM reads up (its own out-of-bound mask just reads
+            # garbage, which is discarded)
+            max_pos = max((int(pos_host[i]) for i, s in enumerate(slots)
+                           if s.req is not None), default=0)
+            cache_len = min(
+                -(-(max_pos + self.segment + 1) // self.cache_bucket)
+                * self.cache_bucket, self.model.max_len)
+            cell, cur, toks = self._seg_fn(cache_len)(self.params, cell, cur)
+            pos_host += self.segment
+            block = np.asarray(toks)               # [B, segment] host sync
+            for i, s in enumerate(slots):
+                if s.req is None:
+                    continue
+                take = block[i, :min(s.left, block.shape[1])]
+                done = len(take) >= s.left         # budget reached
+                if s.req.eos_id is not None:
+                    hits = np.nonzero(take == s.req.eos_id)[0]
+                    if hits.size:
+                        take, done = take[:hits[0]], True
+                s.out.extend(int(t) for t in take)
+                s.left -= len(take)
+                if done or s.left <= 0:
+                    results[s.req.rid] = np.asarray(s.out, np.int32)
+                    slots[i] = _Slot()             # free the slot
+            admit()
+        return results
