@@ -1,0 +1,134 @@
+"""Capture + analyze an in-graph XLA trace of the ResNet-50 train step —
+the evidence backing docs/design/conv_mfu.md's ceiling claim with REAL
+in-graph per-HLO timings instead of isolated-op upper bounds.
+
+Usage (on the TPU host):
+    python benchmarks/trace_conv_mfu.py                     # capture+analyze
+    python benchmarks/trace_conv_mfu.py <xplane.pb> [steps] # analyze
+    (``steps`` = profiled step count of that trace; default 20, which is
+    what capture() records — pass it for traces captured elsewhere or the
+    per-step numbers are silently scaled wrong)
+
+Pipeline: utils/profiler.py (jax.profiler trace) -> .xplane.pb ->
+xprof's hlo_stats tool -> per-HLO total_self_time / model_flop_rate /
+measured_memory_bw / bound_by -> the category and roofline summaries
+printed below (and pasted into docs/design/conv_mfu.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import defaultdict
+
+# must precede the first google.protobuf import anywhere in the process
+# (jax pulls it in): xprof's generated protos need the pure-python impl
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+PEAK_HBM_GBPS = 819.0    # v5e HBM (no device_kind table exists for BW yet)
+STEPS = 20
+
+
+def _peak_tflops() -> float:
+    from benchmarks.mfu import peak_flops_per_sec
+
+    peak = peak_flops_per_sec()
+    return peak / 1e12 if peak else 197.0   # v5e fallback off-device
+
+
+def capture(logdir: str = "/tmp/rn50_trace") -> str:
+    import jax
+
+    import benchmarks.resnet50 as rb
+    from paddle_tpu.utils import profiler
+
+    run_n, _, params, state, (xs, ys) = rb.build()
+    params, state, loss = run_n(params, state, xs, ys, 3)   # compile+warm
+    jax.block_until_ready(loss)
+    with profiler.profile(logdir):
+        params, state, loss = run_n(params, state, xs, ys, STEPS)
+        jax.block_until_ready(loss)
+        float(loss)
+    return profiler.trace_files(logdir)[-1]
+
+
+def hlo_rows(xplane_path: str):
+    from xprof.convert import raw_to_tool_data as r
+
+    data, _ = r.xspace_to_tool_data([xplane_path], "hlo_stats", {})
+    d = json.loads(data)
+    cols = [c["id"] for c in d["cols"]]
+    return [dict(zip(cols, [c.get("v") for c in row["c"]]))
+            for row in d["rows"]]
+
+
+def analyze(rows, steps: int = STEPS):
+    peak_tflops = _peak_tflops()
+    total_us = sum(r["total_self_time"] for r in rows)
+    step_ms = total_us / 1e3 / steps
+    # model_flop_rate is GFLOP/s and self time is us: GFLOP = rate * t * 1e-6
+    gflops_step = sum((r["model_flop_rate"] or 0) * r["total_self_time"]
+                      for r in rows) / 1e6 / steps
+    # step_ms is ms: GFLOP / ms = TFLOP/s
+    mfu = gflops_step / step_ms / peak_tflops
+    print(f"device step: {step_ms:.2f} ms, model {gflops_step:.0f} GFLOP "
+          f"-> in-graph MFU {100 * mfu:.1f}%")
+
+    agg = defaultdict(lambda: [0.0, 0.0, 0.0])
+    for r in rows:
+        a = agg[r["category"]]
+        a[0] += r["total_self_time"]
+        a[1] += (r["model_flop_rate"] or 0.0) * r["total_self_time"]
+        a[2] += (r["measured_memory_bw"] or 0.0) * r["total_self_time"]
+    print(f"\n{'category':26s} {'ms/step':>8s} {'%time':>6s} "
+          f"{'TFLOP/s':>8s} {'GB/s':>6s}")
+    for cat, (t, ft, bt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        if t / total_us < 0.005:
+            continue
+        print(f"{cat:26s} {t / 1e3 / steps:8.2f} {100 * t / total_us:6.1f} "
+              f"{ft / t / 1e3:8.1f} {bt / t:6.0f}")
+
+    conv = [r for r in rows if r["category"] == "convolution fusion"]
+    conv_t = sum(r["total_self_time"] for r in conv)
+    for bound in ("HBM", "Compute"):
+        sub = [r for r in conv if r["bound_by"] == bound]
+        t = sum(r["total_self_time"] for r in sub)
+        if not t:
+            continue
+        fr = sum((r["model_flop_rate"] or 0) * r["total_self_time"]
+                 for r in sub) / t
+        bw = sum((r["measured_memory_bw"] or 0) * r["total_self_time"]
+                 for r in sub) / t
+        print(f"conv fusions {bound:8s}: {100 * t / conv_t:5.1f}% of conv "
+              f"time at {fr / 1e3:5.1f} TFLOP/s "
+              f"({100 * fr / 1e3 / peak_tflops:.0f}% MXU) / {bw:.0f} GB/s "
+              f"({100 * bw / PEAK_HBM_GBPS:.0f}% HBM)")
+
+    # roofline-perfect bound: every op at min(its achieved time scaled to
+    # 100% of whichever roof binds it) — what the step would cost if XLA
+    # hit BOTH roofs perfectly everywhere
+    ideal_us = 0.0
+    for r in rows:
+        t = r["total_self_time"]
+        fr = (r["model_flop_rate"] or 0.0) / 1e3 / peak_tflops
+        bw = min((r["measured_memory_bw"] or 0.0), PEAK_HBM_GBPS) \
+            / PEAK_HBM_GBPS
+        util = max(fr, bw)
+        ideal_us += t * min(util, 1.0)
+    ideal_ms = ideal_us / 1e3 / steps
+    print(f"\nroofline-perfect step (both roofs at 100%): {ideal_ms:.2f} ms "
+          f"-> MFU ceiling {100 * gflops_step / ideal_ms / peak_tflops:.1f}%")
+    return step_ms, mfu
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if len(sys.argv) > 1:
+        path = sys.argv[1]
+        steps = int(sys.argv[2]) if len(sys.argv) > 2 else STEPS
+    else:
+        path, steps = capture(), STEPS
+    print(f"trace: {path} ({steps} steps)")
+    analyze(hlo_rows(path), steps)
