@@ -222,6 +222,16 @@ def main(full: bool = False):
     rows.append(("__import__('benchmarks.serving_decode', fromlist=['x'])"
                  ".run_continuous()", ROW_TIMEOUT))
     if full:
+        # the remaining BASELINE.md rows, so a --full session covers the
+        # whole measured table in one output
+        rows.append(("__import__('benchmarks.seq2seq_nmt', fromlist=['x'])"
+                     ".run(batch=256)", ROW_TIMEOUT))
+        for bs in (8, 32):
+            rows.append((f"__import__('benchmarks.serving_decode', "
+                         f"fromlist=['x']).run_config({bs})", ROW_TIMEOUT))
+        rows.append(("__import__('benchmarks.serving_decode', "
+                     "fromlist=['x']).run_config(8, bucket=None)",
+                     ROW_TIMEOUT))
         rows.append(("__import__('benchmarks.resnet50', fromlist=['x'])"
                      ".run_with_infeed()", ROW_TIMEOUT))
     rows.append(("__import__('benchmarks.host_embedding', fromlist=['x'])"

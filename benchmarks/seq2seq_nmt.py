@@ -28,7 +28,7 @@ BATCH = 64
 NBUF = 4
 
 
-def build():
+def build(batch: int = BATCH):
     from paddle_tpu.core import SeqBatch
     from paddle_tpu.models import AttentionSeq2Seq
     from paddle_tpu.optimizer import Adam
@@ -67,28 +67,29 @@ def build():
         return jax.lax.fori_loop(0, n, body, (params, state, jnp.float32(0)))
 
     rs = np.random.RandomState(0)
-    srcs = jnp.asarray(rs.randint(3, SRC_VOCAB, (NBUF, BATCH, SEQ)), jnp.int32)
-    tins = jnp.asarray(rs.randint(3, TRG_VOCAB, (NBUF, BATCH, SEQ)), jnp.int32)
-    touts = jnp.asarray(rs.randint(3, TRG_VOCAB, (NBUF, BATCH, SEQ)), jnp.int32)
-    slens = jnp.asarray(rs.randint(MIN_LEN, SEQ + 1, (NBUF, BATCH)), jnp.int32)
-    tlens = jnp.asarray(rs.randint(MIN_LEN, SEQ + 1, (NBUF, BATCH)), jnp.int32)
+    srcs = jnp.asarray(rs.randint(3, SRC_VOCAB, (NBUF, batch, SEQ)), jnp.int32)
+    tins = jnp.asarray(rs.randint(3, TRG_VOCAB, (NBUF, batch, SEQ)), jnp.int32)
+    touts = jnp.asarray(rs.randint(3, TRG_VOCAB, (NBUF, batch, SEQ)), jnp.int32)
+    slens = jnp.asarray(rs.randint(MIN_LEN, SEQ + 1, (NBUF, batch)), jnp.int32)
+    tlens = jnp.asarray(rs.randint(MIN_LEN, SEQ + 1, (NBUF, batch)), jnp.int32)
     # true target tokens per step, averaged over the rotation
     tokens_per_step = float(np.asarray(tlens).sum()) / NBUF
     return (run_n, step_fn, params, state, (srcs, slens, tins, touts, tlens),
             tokens_per_step)
 
 
-def run(iters: int = 30, repeats: int = 2):
+def run(iters: int = 30, repeats: int = 2, batch: int = BATCH):
     from benchmarks.mfu import attach_mfu, step_flops
     from benchmarks.timing import chained_ms_per_step
 
-    run_n, step_fn, params, state, b, tokens_per_step = build()
+    run_n, step_fn, params, state, b, tokens_per_step = build(batch)
     sec = chained_ms_per_step(run_n, (params, state) + b, iters,
                               repeats) / 1e3
     flops = step_flops(step_fn, params, state, *(a[0] for a in b))
     # true-token semantics + varied lengths are in the key (vs r1's padded-len32)
     return attach_mfu(
-        {"metric": "seq2seq_nmt_train_true_tokens_per_sec_h512_len16-32_bs64",
+        {"metric": "seq2seq_nmt_train_true_tokens_per_sec_h512_"
+                   f"len16-32_bs{batch}",
          "value": round(tokens_per_step / sec, 1), "unit": "tokens/sec",
          "vs_baseline": None,  # reference published no seq2seq number
          "note": "varied lengths 16..32, true-token count, 4 rotating "
