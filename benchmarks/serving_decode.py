@@ -139,9 +139,10 @@ def run_continuous(n_requests: int = 128, slots: int = 64,
             "requests": n_requests, "delivered_tokens": delivered,
             "budget_tokens": total_new,
             "note": "in-flight batching, mixed prompt/gen lengths "
-                    "U[32,256], slot refill at segment boundaries via "
-                    "ragged prefill + masked merge; greedy tokens exactly "
-                    "equal solo decode (tests/test_serving.py)"}
+                    "U[32,256], longest-first admission, slot refill at "
+                    "segment boundaries via ragged prefill + masked merge; "
+                    "greedy tokens exactly equal solo decode "
+                    "(tests/test_serving.py)"}
 
 
 if __name__ == "__main__":

@@ -48,6 +48,25 @@ def test_continuous_batching_matches_solo_decode(model_and_params):
                     f"gen {r.max_new}) diverged under continuous batching")
 
 
+def test_schedule_fifo_and_longest_first_agree(model_and_params):
+    """Admission order is a throughput knob only: per-request outputs are
+    identical under both schedules."""
+    model, params = model_and_params
+    rs = np.random.RandomState(9)
+    reqs = [Request(i, rs.randint(0, VOCAB, int(rs.randint(3, 30))),
+                    int(rs.randint(1, 25))) for i in range(7)]
+    out = {}
+    for sched in ("fifo", "longest_first"):
+        b = ContinuousBatcher(model, params, slots=3, segment=8,
+                              cache_bucket=32, schedule=sched)
+        out[sched] = b.serve([Request(r.rid, r.prompt, r.max_new)
+                              for r in reqs])
+    assert sorted(out["fifo"]) == sorted(out["longest_first"])
+    for rid in out["fifo"]:
+        np.testing.assert_array_equal(out["fifo"][rid],
+                                      out["longest_first"][rid])
+
+
 def test_continuous_batching_eos_truncates(model_and_params):
     model, params = model_and_params
     rs = np.random.RandomState(5)
