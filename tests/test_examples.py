@@ -34,6 +34,20 @@ def test_example_trains_and_cost_falls(config, passes):
     assert costs[-1] < costs[0], out
 
 
+def test_serving_example_runs():
+    """examples/serving_llm.py: the continuous-batching serving demo serves
+    every request and reports delivered throughput (CI shape)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SERVING_DEMO_SMALL"] = "1"
+    out = subprocess.run(
+        [sys.executable, "examples/serving_llm.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "served 10 requests" in out.stdout
+    assert "tok/s delivered" in out.stdout
+
+
 def test_checkgrad_job():
     """--job=checkgrad parity (TrainerMain.cpp:54): numeric vs analytic
     gradients through the executor on a demo config."""
