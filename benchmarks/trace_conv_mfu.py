@@ -37,13 +37,20 @@ def _peak_tflops() -> float:
     return peak / 1e12 if peak else 197.0   # v5e fallback off-device
 
 
-def capture(logdir: str = "/tmp/rn50_trace") -> str:
+def capture(logdir: str = "/tmp/rn50_trace", model: str = "resnet50",
+            batch: int = 64) -> str:
     import jax
 
-    import benchmarks.resnet50 as rb
     from paddle_tpu.utils import profiler
 
-    run_n, _, params, state, (xs, ys) = rb.build()
+    if model == "resnet50":
+        import benchmarks.resnet50 as rb
+
+        run_n, _, params, state, (xs, ys) = rb.build(batch)
+    else:
+        import benchmarks.image_suite as ims
+
+        run_n, _, params, state, (xs, ys), _ = ims.build(model, batch)
     params, state, loss = run_n(params, state, xs, ys, 3)   # compile+warm
     jax.block_until_ready(loss)
     with profiler.profile(logdir):
@@ -125,10 +132,14 @@ def analyze(rows, steps: int = STEPS):
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    if len(sys.argv) > 1:
+    if len(sys.argv) > 1 and sys.argv[1].endswith(".pb"):
         path = sys.argv[1]
         steps = int(sys.argv[2]) if len(sys.argv) > 2 else STEPS
     else:
-        path, steps = capture(), STEPS
+        # `trace_conv_mfu.py [model [batch]]` — model as in image_suite
+        # ("googlenet"/"alexnet"/"smallnet") or the default "resnet50"
+        model = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+        path, steps = capture(f"/tmp/{model}_trace", model, batch), STEPS
     print(f"trace: {path} ({steps} steps)")
     analyze(hlo_rows(path), steps)

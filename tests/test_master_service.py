@@ -332,3 +332,79 @@ def test_deposed_master_writes_are_fenced(tmp_path):
     finally:
         ca.close()
         a.stop(release_lease=False)
+
+
+# ---------------------------------------------------------------------------
+# native server robustness (master_server.cc): hostile/degenerate wire input
+# must never wedge the C++ accept/dispatch plane (ProtoServer.h:36 analog —
+# a control-plane daemon shared by every trainer).
+# ---------------------------------------------------------------------------
+
+def _raw(addr, payload: bytes, expect_reply: bool = True,
+         half_close: bool = False):
+    import socket
+    import struct
+
+    from paddle_tpu.runtime.master_service import _recv_exact
+
+    s = socket.create_connection(addr, timeout=10.0)
+    try:
+        s.sendall(payload)
+        if half_close:
+            s.shutdown(socket.SHUT_WR)   # EOF: no more bytes are coming
+        if not expect_reply:
+            return None
+        hdr = _recv_exact(s, 4)
+        if hdr is None:
+            return None
+        (n,) = struct.unpack("<I", hdr)
+        return _recv_exact(s, n)
+    finally:
+        s.close()
+
+
+def test_native_server_survives_hostile_frames(server):
+    """Garbage JSON, unknown ops, truncated frames, oversized length
+    headers, and unicode-escape payloads: each is answered or the
+    connection dropped — and the server keeps serving well-formed clients
+    afterwards."""
+    import json
+    import struct
+
+    addr = server.address
+
+    def frame(obj) -> bytes:
+        body = json.dumps(obj).encode()
+        return struct.pack("<I", len(body)) + body
+
+    # unknown op -> structured error
+    r = json.loads(_raw(addr, frame({"op": "no_such_op"})))
+    assert r["ok"] is False and "unknown op" in r["error"]
+
+    # malformed JSON -> bad-request error, not a crash
+    bad = b"this is not json"
+    r = json.loads(_raw(addr, struct.pack("<I", len(bad)) + bad))
+    assert r["ok"] is False
+
+    # unicode escapes (incl. surrogate pair) round-trip through payloads
+    snowman = "sn☃man \U0001F600 q\"uote\\slash"
+    r = json.loads(_raw(addr, frame({"op": "set_dataset",
+                                     "payloads": [snowman]})))
+    assert r["ok"] is True
+    r = json.loads(_raw(addr, frame({"op": "get_task"})))
+    assert r["ok"] is True and r["task"]["payload"] == snowman
+
+    # oversized length header -> connection dropped, no allocation bomb
+    assert _raw(addr, struct.pack("<I", 1 << 30)) is None
+
+    # truncated frame (header promises more bytes than ever arrive, then
+    # EOF) -> dropped without a reply
+    assert _raw(addr, struct.pack("<I", 100) + b"short",
+                half_close=True) is None
+
+    # the server still works for a well-formed client
+    c = _client(server)
+    c.set_dataset(["after-the-storm"])
+    t = c.get_task()
+    assert t is not None and t[1] == "after-the-storm"
+    c.task_finished(t[0])
