@@ -1,9 +1,11 @@
-"""Capture + analyze an in-graph XLA trace of the ResNet-50 train step —
-the evidence backing docs/design/conv_mfu.md's ceiling claim with REAL
-in-graph per-HLO timings instead of isolated-op upper bounds.
+"""Capture + analyze an in-graph XLA trace of a benchmark train step —
+the evidence backing docs/design/conv_mfu.md's and nmt_roofline.md's
+ceiling claims with REAL in-graph per-HLO timings instead of isolated-op
+upper bounds. Models: resnet50 (default), any image_suite key
+(googlenet/alexnet/smallnet), or seq2seq_nmt.
 
 Usage (on the TPU host):
-    python benchmarks/trace_conv_mfu.py                     # capture+analyze
+    python benchmarks/trace_conv_mfu.py [model [batch]]     # capture+analyze
     python benchmarks/trace_conv_mfu.py <xplane.pb> [steps] # analyze
     (``steps`` = profiled step count of that trace; default 20, which is
     what capture() records — pass it for traces captured elsewhere or the
@@ -46,17 +48,22 @@ def capture(logdir: str = "/tmp/rn50_trace", model: str = "resnet50",
     if model == "resnet50":
         import benchmarks.resnet50 as rb
 
-        run_n, _, params, state, (xs, ys) = rb.build(batch)
+        run_n, _, params, state, bufs = rb.build(batch)
+    elif model == "seq2seq_nmt":
+        import benchmarks.seq2seq_nmt as nmt
+
+        run_n, _, params, state, bufs, _ = nmt.build(batch)
     else:
         import benchmarks.image_suite as ims
 
-        run_n, _, params, state, (xs, ys), _ = ims.build(model, batch)
-    params, state, loss = run_n(params, state, xs, ys, 3)   # compile+warm
-    jax.block_until_ready(loss)
+        run_n, _, params, state, bufs, _ = ims.build(model, batch)
+    args = (params, state) + tuple(bufs)
+    out = run_n(*args, 3)                                   # compile+warm
+    jax.block_until_ready(out[-1])
     with profiler.profile(logdir):
-        params, state, loss = run_n(params, state, xs, ys, STEPS)
-        jax.block_until_ready(loss)
-        float(loss)
+        out = run_n(*args, STEPS)
+        jax.block_until_ready(out[-1])
+        float(out[-1])
     return profiler.trace_files(logdir)[-1]
 
 
@@ -136,8 +143,9 @@ if __name__ == "__main__":
         path = sys.argv[1]
         steps = int(sys.argv[2]) if len(sys.argv) > 2 else STEPS
     else:
-        # `trace_conv_mfu.py [model [batch]]` — model as in image_suite
-        # ("googlenet"/"alexnet"/"smallnet") or the default "resnet50"
+        # `trace_conv_mfu.py [model [batch]]` — an image_suite key
+        # ("googlenet"/"alexnet"/"smallnet"), "seq2seq_nmt", or the
+        # default "resnet50"
         model = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
         path, steps = capture(f"/tmp/{model}_trace", model, batch), STEPS

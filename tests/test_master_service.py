@@ -340,8 +340,7 @@ def test_deposed_master_writes_are_fenced(tmp_path):
 # a control-plane daemon shared by every trainer).
 # ---------------------------------------------------------------------------
 
-def _raw(addr, payload: bytes, expect_reply: bool = True,
-         half_close: bool = False):
+def _raw(addr, payload: bytes, half_close: bool = False):
     import socket
     import struct
 
@@ -352,8 +351,6 @@ def _raw(addr, payload: bytes, expect_reply: bool = True,
         s.sendall(payload)
         if half_close:
             s.shutdown(socket.SHUT_WR)   # EOF: no more bytes are coming
-        if not expect_reply:
-            return None
         hdr = _recv_exact(s, 4)
         if hdr is None:
             return None
