@@ -2,7 +2,9 @@
 the evidence backing docs/design/conv_mfu.md's and nmt_roofline.md's
 ceiling claims with REAL in-graph per-HLO timings instead of isolated-op
 upper bounds. Models: resnet50 (default), any image_suite key
-(googlenet/alexnet/smallnet), or seq2seq_nmt.
+(googlenet/alexnet/smallnet), seq2seq_nmt, or transformer_lm
+(pass its bench batch, e.g. `transformer_lm 8` — the bare default of 64
+is the conv benches' batch).
 
 Usage (on the TPU host):
     python benchmarks/trace_conv_mfu.py [model [batch]]     # capture+analyze
@@ -53,6 +55,11 @@ def capture(logdir: str = "/tmp/rn50_trace", model: str = "resnet50",
         import benchmarks.seq2seq_nmt as nmt
 
         run_n, _, params, state, bufs, _ = nmt.build(batch)
+    elif model == "transformer_lm":
+        import benchmarks.transformer_lm as tlm
+
+        run_n, _, params, state, idss = tlm.build(batch)
+        bufs = (idss,)
     else:
         import benchmarks.image_suite as ims
 
@@ -144,8 +151,8 @@ if __name__ == "__main__":
         steps = int(sys.argv[2]) if len(sys.argv) > 2 else STEPS
     else:
         # `trace_conv_mfu.py [model [batch]]` — an image_suite key
-        # ("googlenet"/"alexnet"/"smallnet"), "seq2seq_nmt", or the
-        # default "resnet50"
+        # ("googlenet"/"alexnet"/"smallnet"), "seq2seq_nmt",
+        # "transformer_lm" (pass batch 8), or the default "resnet50"
         model = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
         path, steps = capture(f"/tmp/{model}_trace", model, batch), STEPS

@@ -44,9 +44,31 @@ def _on_tpu() -> bool:
 # forward kernel
 # ---------------------------------------------------------------------------
 
+
+def _k_block_hi(n_k: int, qi, block_q: int, block_k: int, kv_len,
+                causal: bool, has_lens: bool):
+    """Upper k-block bound shared by the forward and dq kernels: skip
+    k-blocks the masks zero out ENTIRELY — causally, blocks past the
+    q-block's last row; by length, blocks at/past kv_len. Statically gated
+    on n_k > 1: a dynamic fori_loop bound lowers to a while loop whose
+    control overhead measurably LOSES when there is only one k-block
+    anyway (the T<=1024 default-block case, measured -8..20%); with
+    several blocks the diagonal walk saves up to half the streamed tiles.
+    Skipped blocks contribute p == 0 exactly, so fwd lse and the bwd
+    recomputation stay consistent by construction."""
+    hi = n_k
+    if n_k > 1:
+        if causal:
+            hi = jnp.minimum(hi, ((qi + 1) * block_q + block_k - 1)
+                             // block_k)
+        if has_lens:
+            hi = jnp.minimum(hi, (kv_len + block_k - 1) // block_k)
+    return hi
+
+
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, *,
                    block_k: int, scale: float, causal: bool, seq_len: int,
-                   true_len: int):
+                   true_len: int, has_lens: bool):
     """One (batch*head, q-block) program: stream KV tiles, online softmax.
 
     q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, block_q, D];
@@ -84,10 +106,11 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, *,
         acc_new = acc * corr + pv
         return acc_new, m_new, l_new
 
+    hi = _k_block_hi(n_k, qi, block_q, block_k, kv_len, causal, has_lens)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l_safe)
@@ -99,7 +122,8 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, *,
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       len_ref, dq_ref, *, block_k: int, scale: float,
-                      causal: bool, seq_len: int, true_len: int):
+                      causal: bool, seq_len: int, true_len: int,
+                      has_lens: bool):
     """dq for one (batch*head, q-block): recompute p tiles from saved lse.
 
     dS = P * (dO·Vᵀ − delta);   dQ = scale · dS·K.
@@ -134,13 +158,17 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                       preferred_element_type=jnp.float32)
         return dq
 
-    dq = jax.lax.fori_loop(0, n_k, body, jnp.zeros((block_q, d), jnp.float32))
+    # same skipping as the forward (see _k_block_hi: skipped blocks have
+    # p == 0 and contribute nothing to dq)
+    hi = _k_block_hi(n_k, qi, block_q, block_k, kv_len, causal, has_lens)
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        len_ref, dk_ref, dv_ref, *, block_q: int, scale: float,
-                       causal: bool, seq_len: int, true_len: int):
+                       causal: bool, seq_len: int, true_len: int,
+                       n_k_blocks: int):
     """dk/dv for one (batch*head, kv-block): stream Q tiles.
 
     dV = Pᵀ·dO;   dK = scale · dSᵀ·Q.
@@ -180,9 +208,16 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
+    # causal skip from the other side: q-blocks that end strictly before
+    # this k-block's first key are fully below the diagonal — p == 0 rows
+    # only, no dk/dv contribution. Statically gated on BOTH grids being
+    # multi-block: with a single k-block ki == 0 always and lo == 0, so a
+    # dynamic lower bound would be pure while-loop overhead (measured -8%)
+    lo = ((ki * block_k) // block_q
+          if (causal and n_q > 1 and n_k_blocks > 1) else 0)
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, n_q, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)             # scale folded into q
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -252,7 +287,8 @@ def _fa_fwd_call(q, k, v, causal, scale, block_q, block_k, interpret,
     qb, kb, vb = _to_bh(q, Tp), _to_bh(k, Sp), _to_bh(v, Sp)
     lensb = _lens_to_bh(kv_lens, B, H, S)
     kernel = functools.partial(_fa_fwd_kernel, block_k=blk_k, scale=scale,
-                               causal=causal, seq_len=Sp, true_len=S)
+                               causal=causal, seq_len=Sp, true_len=S,
+                               has_lens=kv_lens is not None)
     grid = (B * H, Tp // blk_q)
     out, lse = pl.pallas_call(
         kernel,
@@ -304,7 +340,8 @@ def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     # dq: grid over q blocks, stream kv tiles (loop bound Sp, mask keys >= S)
     dq_kernel = functools.partial(_fa_bwd_dq_kernel, block_k=blk_k,
                                   scale=scale, causal=causal, seq_len=Sp,
-                                  true_len=S)
+                                  true_len=S,
+                                  has_lens=kv_lens is not None)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B * H, Tp // blk_q),
@@ -319,7 +356,7 @@ def _fa_bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     # rows have zero do/delta so they contribute nothing); mask keys >= S
     dkv_kernel = functools.partial(_fa_bwd_dkv_kernel, block_q=blk_q,
                                    scale=scale, causal=causal, seq_len=Tp,
-                                   true_len=S)
+                                   true_len=S, n_k_blocks=Sp // blk_k)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, Sp // blk_k),
