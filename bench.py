@@ -234,6 +234,8 @@ def main(full: bool = False):
                      ROW_TIMEOUT))
         rows.append(("__import__('benchmarks.resnet50', fromlist=['x'])"
                      ".run_with_infeed()", ROW_TIMEOUT))
+        rows.append(("__import__('benchmarks.transformer_lm', "
+                     "fromlist=['x']).run_long()", ROW_TIMEOUT))
     rows.append(("__import__('benchmarks.host_embedding', fromlist=['x'])"
                  ".run()", BIG_TIMEOUT))
 

@@ -25,12 +25,12 @@ BATCH = 8
 NBUF = 2
 
 
-def build(batch: int = BATCH):
+def build(batch: int = BATCH, seq: int = SEQ):
     from paddle_tpu.models import TransformerLM
     from paddle_tpu.optimizer import Adam
 
     model = TransformerLM(VOCAB, d_model=D_MODEL, n_heads=N_HEADS,
-                          n_layers=N_LAYERS, max_len=SEQ)
+                          n_layers=N_LAYERS, max_len=seq)
     params = model.init(jax.random.PRNGKey(0))
     opt = Adam(3e-4)
     state = opt.init(params)
@@ -58,26 +58,36 @@ def build(batch: int = BATCH):
         return jax.lax.fori_loop(0, n, body, (params, state, jnp.float32(0)))
 
     rs = np.random.RandomState(0)
-    idss = jnp.asarray(rs.randint(0, VOCAB, (NBUF, batch, SEQ)), jnp.int32)
+    idss = jnp.asarray(rs.randint(0, VOCAB, (NBUF, batch, seq)), jnp.int32)
     return run_n, step_fn, params, state, idss
 
 
-def run(iters: int = 12, repeats: int = 2, batch: int = BATCH):
+def run(iters: int = 12, repeats: int = 2, batch: int = BATCH,
+        seq: int = SEQ):
     from benchmarks.mfu import attach_mfu, step_flops
     from benchmarks.timing import chained_ms_per_step
 
-    run_n, step_fn, params, state, idss = build(batch)
+    run_n, step_fn, params, state, idss = build(batch, seq)
     ms = chained_ms_per_step(run_n, (params, state, idss), iters, repeats)
     flops = step_flops(step_fn, params, state, idss[0])
-    tokens = batch * (SEQ - 1)
+    tokens = batch * (seq - 1)
     return attach_mfu(
         {"metric": f"transformer_lm_gpt2s_train_tokens_per_sec_bs{batch}"
-                   f"_seq{SEQ}",
+                   f"_seq{seq}",
          "value": round(tokens / (ms / 1e3), 1), "unit": "tokens/sec",
          "vs_baseline": None,   # no 2017 transformer to compare against
          "note": "GPT-2-small shape, causal Pallas flash attention, bf16 "
                  "compute + f32 master Adam"},
         flops, ms / 1e3)
+
+
+def run_long(batch: int = 2, seq: int = 4096):
+    """Long-context single-chip row: same GPT-2-small blocks with the
+    positional table stretched to ``seq`` — exercises the flash kernels'
+    causal block skipping (docs/design/attention_kernels.md). Sequences
+    past ~8k on ONE chip exceed the kernels' whole-K/V-in-VMEM budget;
+    that is the ring-attention regime (parallel/ring_attention.py)."""
+    return run(iters=8, batch=batch, seq=seq)
 
 
 if __name__ == "__main__":
