@@ -30,10 +30,15 @@ from typing import List, Optional, Tuple
 from .master import TaskMaster
 
 _HDR = struct.Struct("<I")
+# same guard as the C++ plane (master_server.cc kMaxFrame): a hostile
+# 4-byte header must not make the daemon attempt a multi-GiB allocation
+_MAX_FRAME = 64 << 20
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
     payload = json.dumps(obj).encode()
+    if len(payload) > _MAX_FRAME:
+        raise ValueError(f"frame too large ({len(payload)} bytes)")
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 
@@ -42,6 +47,8 @@ def _recv_msg(sock: socket.socket):
     if hdr is None:
         return None
     (n,) = _HDR.unpack(hdr)
+    if n > _MAX_FRAME:
+        return None                     # drop the connection, not the heap
     body = _recv_exact(sock, n)
     if body is None:
         return None

@@ -178,3 +178,61 @@ def test_blob_store_roundtrip_and_fencing():
         st.close()
     finally:
         srv.stop()
+
+
+def test_coord_server_survives_hostile_frames():
+    """The coordination service is a control-plane daemon every worker and
+    standby master talks to: garbage JSON, unknown ops, truncated frames,
+    and oversized length headers must never take it down — a well-formed
+    client keeps working afterwards."""
+    import json
+    import socket
+    import struct
+
+    from paddle_tpu.runtime.coord import CoordServer, NetworkLease
+    from paddle_tpu.runtime.master_service import _recv_exact
+
+    srv = CoordServer().start()
+    try:
+        addr = srv.address
+
+        def raw(payload: bytes, half_close: bool = False):
+            s = socket.create_connection(addr, timeout=10.0)
+            try:
+                s.sendall(payload)
+                if half_close:
+                    s.shutdown(socket.SHUT_WR)
+                hdr = _recv_exact(s, 4)
+                if hdr is None:
+                    return None
+                (n,) = struct.unpack("<I", hdr)
+                return _recv_exact(s, n)
+            finally:
+                s.close()
+
+        def frame(obj) -> bytes:
+            body = json.dumps(obj).encode()
+            return struct.pack("<I", len(body)) + body
+
+        # unknown op -> structured error
+        r = json.loads(raw(frame({"op": "no_such_op"})))
+        assert r["ok"] is False and "unknown op" in r["error"]
+        # garbage JSON / truncated frame: the connection may drop, but the
+        # server must survive each
+        raw(struct.pack("<I", 12) + b"not-json-at!")
+        raw(struct.pack("<I", 100) + b"short", half_close=True)
+        # oversized length header: dropped WITHOUT attempting the
+        # allocation (_recv_msg's _MAX_FRAME guard) — no reply
+        assert raw(struct.pack("<I", 1 << 30), half_close=True) is None
+
+        # ...and still serve a real client
+        lease = NetworkLease(addr[0], addr[1], "jobs/master", owner="m-a",
+                             ttl=5.0)
+        try:
+            assert lease.try_acquire()
+            assert lease.holder()[0] == "m-a"
+            lease.release()
+        finally:
+            lease.close()
+    finally:
+        srv.stop()
