@@ -90,3 +90,21 @@ def test_continuous_batching_budget_caps_at_max_len(model_and_params):
     assert len(got[0]) == 5                     # clamped to max_len - prompt
     want = _solo(model, params, prompt, 5)
     np.testing.assert_array_equal(got[0], want)
+
+
+def test_idle_slot_parking_near_max_len(model_and_params):
+    """With an empty queue, drained slots keep idle-decoding; their garbage
+    positions must be parked before reaching max_len (the park_idle path) so
+    a long-running request's neighbors never clamp-write, and the long
+    request itself stays exact."""
+    model, params = model_and_params
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(0, VOCAB, 5)
+    gen = MAX_LEN - 5 - 1                 # as long a run as max_len allows
+    b = ContinuousBatcher(model, params, slots=3, segment=8,
+                          cache_bucket=32)
+    # one real request; the two other slots idle for ~gen/8 segments, far
+    # past the parking threshold of max_len - 2*segment
+    got = b.serve([Request(0, prompt, gen)])
+    want = _solo(model, params, prompt, gen)
+    np.testing.assert_array_equal(got[0], want)
