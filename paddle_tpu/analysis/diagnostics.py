@@ -1,0 +1,104 @@
+"""Diagnostic model for the static verifier / linter (analysis subpackage).
+
+Every finding — structural error, shape mismatch, lint — is one
+:class:`Diagnostic` carrying a stable code, a severity, the op's location
+(block idx + op idx + op type) and a fix hint.  The location string format
+``block B, op #I (type)`` is shared verbatim with the executor's trace-time
+error notes (fluid/executor.py:_trace_ops) so a static diagnostic and the
+runtime failure for the same op cite the same site.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+
+class Severity(enum.IntEnum):
+    """Ordered so ``severity >= Severity.WARNING`` style filters work."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name.lower()
+
+
+def op_site(block_idx: Optional[int], op_idx: Optional[int],
+            op_type: Optional[str]) -> str:
+    """Canonical location string — keep in sync with executor._trace_ops."""
+    if block_idx is None:
+        return "program"
+    if op_idx is None:
+        return f"block {block_idx}"
+    t = f" ({op_type})" if op_type else ""
+    return f"block {block_idx}, op #{op_idx}{t}"
+
+
+@dataclass
+class Diagnostic:
+    """One verifier/linter finding.
+
+    ``code`` is stable across releases (``V0xx`` structural, ``S0xx`` shape,
+    ``L0xx`` lint) so tooling can filter/suppress by id.
+    """
+
+    code: str
+    severity: Severity
+    message: str
+    block_idx: Optional[int] = None
+    op_idx: Optional[int] = None
+    op_type: Optional[str] = None
+    var: Optional[str] = None
+    hint: Optional[str] = None
+    # which program the finding is in ("main"/"startup") when several are
+    # analyzed together, e.g. by the lint CLI; block/op indices alone are
+    # ambiguous across programs
+    program: Optional[str] = None
+
+    def location(self) -> str:
+        site = op_site(self.block_idx, self.op_idx, self.op_type)
+        return f"[{self.program}] {site}" if self.program else site
+
+    def __str__(self):
+        parts = [f"{self.severity}", f"[{self.code}]", self.location() + ":",
+                 self.message]
+        s = " ".join(parts)
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "severity": str(self.severity),
+                "message": self.message, "block_idx": self.block_idx,
+                "op_idx": self.op_idx, "op_type": self.op_type,
+                "var": self.var, "hint": self.hint, "program": self.program}
+
+
+def errors(diags: Sequence[Diagnostic]) -> List[Diagnostic]:
+    return [d for d in diags if d.severity >= Severity.ERROR]
+
+
+def max_severity(diags: Sequence[Diagnostic]) -> Optional[Severity]:
+    return max((d.severity for d in diags), default=None)
+
+
+def format_diagnostics(diags: Sequence[Diagnostic]) -> str:
+    if not diags:
+        return "no diagnostics"
+    return "\n".join(str(d) for d in diags)
+
+
+class ProgramVerificationError(ValueError):
+    """Raised by ``Executor.run(verify=True)`` / ``check_or_raise`` when a
+    program has error-severity diagnostics.  ``.diagnostics`` holds the full
+    list (warnings included) for tooling."""
+
+    def __init__(self, diags: Sequence[Diagnostic]):
+        self.diagnostics = list(diags)
+        errs = errors(diags)
+        super().__init__(
+            f"program verification failed with {len(errs)} error(s):\n"
+            + format_diagnostics(errs))

@@ -1,0 +1,221 @@
+"""Configurable lint catalogue over Program IR.
+
+Lints are advisory by default (WARNING/INFO); the CLI's ``--fail-on`` and
+:func:`lint_program`'s ``severity_overrides`` promote or demote them.  IDs:
+
+- **L001 dead-op** (warning): an op none of whose outputs is ever read by a
+  later op (in any block), fetched, or synced to the scope (persistable).
+  The traced XLA graph silently drops it, so it is almost always a builder
+  bug.  The last op of a block is exempt when no fetch list is given — its
+  outputs are the block's results.
+- **L002 unused-variable** (info): a declared var no op reads or writes and
+  nobody fetches — desc noise that bloats serialized programs.
+- **L003 trace-safety** (warning): attrs that break jit tracing or program
+  serialization — host callables outside ``fill_init.init`` (cannot
+  round-trip through ``Program.to_dict``; if they close over arrays the op
+  becomes trace-dependent) and array-valued attrs (constants baked into the
+  desc make the compiled fn shape-dependent on builder state).
+- **L004 sharding-consistency** (error): a ``Variable.sharding`` annotation
+  or op-level ``sharding`` attr that repeats an axis or has more entries
+  than the tensor has dims — XLA would reject or mis-partition it at
+  compile time.  An axis name outside the valid set is an ERROR when the
+  caller pins ``mesh_axes`` explicitly, but only a WARNING against the
+  default ``parallel.mesh.CANONICAL_ORDER`` (``make_mesh`` accepts custom
+  axis names, so an unknown name may be a real custom axis).  A malformed
+  spec (non-string entries, a non-sequence) is reported, never raised on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from .diagnostics import Diagnostic, Severity
+from .verify import BLOCK_ATTR_KEYS, _ATTR_BIND_KEYS, _ATTR_READ_KEYS, _names
+
+LINT_CATALOGUE = {
+    "L001": ("dead-op", Severity.WARNING),
+    "L002": ("unused-variable", Severity.INFO),
+    "L003": ("trace-safety", Severity.WARNING),
+    "L004": ("sharding-consistency", Severity.ERROR),
+}
+
+# control-flow / executor-lowered ops act through sub-blocks, not outputs
+_STRUCTURAL_OPS = {"while", "conditional_block", "static_rnn",
+                   "beam_search_gen", "autodiff_grad", "feed", "fetch"}
+
+# env-read attr keys beyond verify's tables (names read at lowering time)
+_EXTRA_READ_KEYS = ("mem_update_names", "step_out_names", "prob_name",
+                    "token_embed_name", "last_mem_outputs", "loss", "params")
+
+
+def _attr_read_names(op) -> Set[str]:
+    reads: Set[str] = set()
+    for table in (_ATTR_READ_KEYS, _ATTR_BIND_KEYS):
+        for key in table.get(op.type, ()):
+            reads.update(_names(op.attrs.get(key)))
+    for key in _EXTRA_READ_KEYS:
+        if key in op.attrs:
+            reads.update(_names(op.attrs.get(key)))
+    return reads
+
+
+def _all_reads(program) -> Set[str]:
+    reads: Set[str] = set()
+    for block in program.blocks:
+        for op in block.ops:
+            reads.update(op.input_vars())
+            reads.update(_attr_read_names(op))
+    return reads
+
+
+def lint_program(program, fetch: Iterable[str] = (),
+                 mesh_axes: Optional[Sequence[str]] = None,
+                 enable: Optional[Iterable[str]] = None,
+                 severity_overrides: Optional[Dict[str, Severity]] = None,
+                 diags: Optional[List[Diagnostic]] = None) -> List[Diagnostic]:
+    """Run the lint catalogue; returns the diagnostic list.
+
+    ``fetch`` — names the caller will fetch (liveness roots for L001/L002).
+    ``mesh_axes`` — valid sharding axis names; defaults to
+    ``parallel.mesh.CANONICAL_ORDER``.  ``enable`` — subset of lint IDs to
+    run (default: all).  ``severity_overrides`` — e.g. promote
+    ``{"L001": Severity.ERROR}`` to make dead ops hard failures.
+    """
+    diags = [] if diags is None else diags
+    enabled = set(enable) if enable is not None else set(LINT_CATALOGUE)
+    overrides = severity_overrides or {}
+
+    def emit(code: str, message: str, severity: Optional[Severity] = None,
+             **kw):
+        sev = overrides.get(
+            code, severity if severity is not None
+            else LINT_CATALOGUE[code][1])
+        diags.append(Diagnostic(code, sev, message, **kw))
+
+    fetch = set(fetch)
+    reads = _all_reads(program)
+    persistables = {name for block in program.blocks
+                    for name, v in block.vars.items() if v.persistable}
+
+    if "L001" in enabled:
+        _lint_dead_ops(program, reads, fetch, persistables, emit)
+    if "L002" in enabled:
+        _lint_unused_vars(program, reads, fetch, emit)
+    if "L003" in enabled:
+        _lint_trace_safety(program, emit)
+    if "L004" in enabled:
+        _lint_sharding(program, mesh_axes, emit)
+    return diags
+
+
+def _lint_dead_ops(program, reads, fetch, persistables, emit):
+    live = reads | fetch | persistables
+    for block in program.blocks:
+        for idx, op in enumerate(block.ops):
+            if op.type in _STRUCTURAL_OPS or any(
+                    key in op.attrs for key in BLOCK_ATTR_KEYS):
+                continue
+            outs = op.output_vars()
+            if not outs:
+                continue
+            if not fetch and idx == len(block.ops) - 1:
+                continue  # a block's final op produces its implicit result
+            if not any(n in live for n in outs):
+                emit("L001",
+                     f"dead op: outputs {outs} are never read, fetched, or "
+                     "persisted — the compiled computation drops this op",
+                     block_idx=block.idx, op_idx=idx, op_type=op.type,
+                     hint="fetch the result, feed it to another op, or "
+                          "delete the op")
+
+
+def _lint_unused_vars(program, reads, fetch, emit):
+    touched: Set[str] = set(reads)
+    for block in program.blocks:
+        for op in block.ops:
+            touched.update(op.output_vars())
+    for block in program.blocks:
+        for name, v in block.vars.items():
+            if name in touched or name in fetch or name == "__step__":
+                continue
+            kind = "feed slot" if v.is_data else "variable"
+            emit("L002", f"unused {kind} '{name}' (no op reads or writes it)",
+                 block_idx=block.idx, var=name,
+                 hint="remove the declaration or wire it into the program")
+
+
+def _lint_trace_safety(program, emit):
+    for block in program.blocks:
+        for idx, op in enumerate(block.ops):
+            for key, val in op.attrs.items():
+                if callable(val) and not (op.type == "fill_init"
+                                          and key == "init"):
+                    emit("L003",
+                         f"attr '{key}' is a host callable "
+                         f"({getattr(val, '__name__', type(val).__name__)}): "
+                         "it cannot serialize and, if it closes over traced "
+                         "arrays, makes the op trace-dependent",
+                         block_idx=block.idx, op_idx=idx, op_type=op.type,
+                         hint="pass data through inputs and plain attrs; "
+                              "host init callables belong on fill_init only")
+                elif getattr(val, "shape", None) and hasattr(val, "dtype"):
+                    # non-scalar ndarray / jax array baked into the desc
+                    emit("L003",
+                         f"attr '{key}' holds an array baked into the desc; "
+                         "under jit its value is frozen at trace time "
+                         "(shape/data changes will not recompile)",
+                         block_idx=block.idx, op_idx=idx, op_type=op.type,
+                         hint="feed arrays through op inputs instead")
+
+
+def _lint_sharding(program, mesh_axes, emit):
+    explicit = mesh_axes is not None
+    if not explicit:
+        from ..parallel.mesh import CANONICAL_ORDER
+        mesh_axes = CANONICAL_ORDER
+    valid = set(mesh_axes)
+    # make_mesh accepts axis names beyond CANONICAL_ORDER, so an unknown
+    # name is only a hard error when the caller pinned the axes
+    unknown_sev = Severity.ERROR if explicit else Severity.WARNING
+
+    def check(spec, ndim, where, **site):
+        if spec is None:
+            return
+        if isinstance(spec, str):
+            spec = (spec,)
+        try:
+            entries = list(spec)
+        except TypeError:
+            emit("L004", f"{where} is not a sharding spec "
+                         f"({spec!r}); expected a sequence of axis "
+                         "names / None", **site)
+            return
+        axes = [a for a in entries if a is not None]
+        for a in axes:
+            if not isinstance(a, str):
+                emit("L004", f"{where} has non-string entry {a!r}", **site)
+            elif a not in valid:
+                emit("L004",
+                     f"{where} names unknown mesh axis '{a}' "
+                     f"(valid: {sorted(valid)})", severity=unknown_sev,
+                     **site)
+        axes = [a for a in axes if isinstance(a, str)]
+        dup = {a for a in axes if axes.count(a) > 1}
+        if dup:
+            emit("L004",
+                 f"{where} repeats mesh axes {sorted(dup)}; an axis may "
+                 "shard at most one tensor dim", **site)
+        if ndim is not None and len(entries) > ndim:
+            emit("L004",
+                 f"{where} has {len(entries)} entries for a "
+                 f"{ndim}-dim tensor", **site)
+
+    for block in program.blocks:
+        for name, v in block.vars.items():
+            check(getattr(v, "sharding", None), len(v.shape) or None,
+                  f"sharding annotation on var '{name}'",
+                  block_idx=block.idx, var=name)
+        for idx, op in enumerate(block.ops):
+            check(op.attrs.get("sharding"), None,
+                  f"op attr 'sharding'",
+                  block_idx=block.idx, op_idx=idx, op_type=op.type)

@@ -122,7 +122,11 @@ def _trace_ops(ops, env: Dict[str, Any], ctx: TraceContext):
             if getattr(e, "_op_ctx", False):
                 raise          # innermost op already carries its context
             chain = " -> ".join(o.type for o in ops[max(0, idx - 4):idx + 1])
-            msg = (f"op #{idx} {op.type!r} failed while tracing the Program "
+            # one source of truth for the location format so a runtime
+            # failure and the static diagnostic for an op cite the same site
+            from ..analysis.diagnostics import op_site
+            site = op_site(getattr(op.block, "idx", None), idx, op.type)
+            msg = (f"{site} failed while tracing the Program "
                    f"(inputs={op.inputs}, outputs={op.outputs})\n"
                    f"  op chain: ...{chain}")
             if hasattr(e, "add_note"):
@@ -330,13 +334,14 @@ class Executor:
         self.place = place
         self.scope = scope if scope is not None else global_scope()
         self._cache: Dict[Tuple, Any] = {}
+        self._verified: set = set()   # analysis pre-flights already passed
         self._step = 0   # feeds the implicit '__step__' var (stochastic ops)
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None,
-            use_cache: bool = True) -> List[np.ndarray]:
+            use_cache: bool = True, verify: bool = False) -> List[np.ndarray]:
         from .framework import default_main_program
         program = program or default_main_program()
         feed = {k: jnp.asarray(v) for k, v in (feed or {}).items()}
@@ -347,6 +352,18 @@ class Executor:
         if "__step__" in block.vars and "__step__" not in feed:
             feed["__step__"] = jnp.asarray(self._step, jnp.int32)
             self._step += 1
+        if verify:
+            # static pre-flight: reject malformed programs with precise
+            # Diagnostics BEFORE burning a trace/compile (analysis subpackage).
+            # Memoized like the compiled-fn cache so a training loop pays the
+            # analysis once per (program version, feed signature), not per step.
+            from .. import analysis
+            vkey = (program._serial, program.version, tuple(fetch_names),
+                    tuple((k, v.shape, str(v.dtype))
+                          for k, v in sorted(feed.items())))
+            if vkey not in self._verified:
+                analysis.check_or_raise(program, feed=feed, fetch=fetch_names)
+                self._verified.add(vkey)
 
         # vars the block reads from the scope (persistables created earlier)
         persist_in = [name for name, v in block.vars.items()

@@ -26,7 +26,8 @@ class Variable:
     def __init__(self, block: "Block", name: str, shape: Sequence[int] = (),
                  dtype: str = "float32", persistable: bool = False,
                  is_data: bool = False, lod_level: int = 0,
-                 trainable: bool = True):
+                 trainable: bool = True,
+                 sharding: Optional[Sequence[Optional[str]]] = None):
         self.block = block
         self.name = name
         self.shape = tuple(int(s) for s in shape)
@@ -38,6 +39,12 @@ class Variable:
         # evaluator accumulators) sets trainable=False so autodiff/optimizers
         # skip it while the executor still syncs it to the scope
         self.trainable = trainable
+        # optional mesh-axis annotation, one entry per tensor dim (None =
+        # replicated); validated against parallel.mesh.CANONICAL_ORDER by
+        # analysis.lints L004. A bare string means one axis, not its chars.
+        if isinstance(sharding, str):
+            sharding = (sharding,)
+        self.sharding = tuple(sharding) if sharding is not None else None
 
     def __repr__(self):
         return (f"Variable({self.name}, shape={self.shape}, dtype={self.dtype}"
@@ -48,10 +55,12 @@ class Variable:
              "dtype": self.dtype, "persistable": self.persistable,
              "is_data": self.is_data, "lod_level": self.lod_level,
              "trainable": self.trainable}
-        # per-parameter attrs (ParamAttr): only present when set
+        # per-parameter attrs (ParamAttr) + sharding: only present when set
         for k in ("lr_scale", "l2_rate"):
             if getattr(self, k, None) is not None:
                 d[k] = getattr(self, k)
+        if self.sharding is not None:
+            d["sharding"] = list(self.sharding)
         return d
 
 
@@ -80,10 +89,14 @@ class Operator:
         return f"Operator({self.type}: {self.inputs} -> {self.outputs})"
 
     def to_dict(self):
+        # callable attrs (host initializers) cannot serialize, but DROPPING
+        # the key would make the serialized op lie about its attr surface —
+        # diagnostics and goldens need the key, so emit a named placeholder
         return {"type": self.type, "inputs": self.inputs,
                 "outputs": self.outputs,
-                "attrs": {k: v for k, v in self.attrs.items()
-                          if not callable(v)}}
+                "attrs": {k: (v if not callable(v) else
+                              f"<callable:{getattr(v, '__name__', type(v).__name__)}>")
+                          for k, v in self.attrs.items()}}
 
 
 class Block:
@@ -196,7 +209,7 @@ class Program:
                 v = Variable(
                     b, vd["name"], vd["shape"], vd["dtype"],
                     vd["persistable"], vd["is_data"], vd.get("lod_level", 0),
-                    vd.get("trainable", True))
+                    vd.get("trainable", True), vd.get("sharding"))
                 for k in ("lr_scale", "l2_rate"):
                     if k in vd:
                         setattr(v, k, vd[k])

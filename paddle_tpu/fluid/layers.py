@@ -90,10 +90,16 @@ def _create_parameter(name_hint: str, shape, dtype="float32",
 
 
 def data(name: str, shape: Sequence[int], dtype="float32",
-         lod_level: int = 0) -> Variable:
-    """Feed slot (layers.py data:179); shape excludes the batch dim."""
+         lod_level: int = 0,
+         sharding: Optional[Sequence[Optional[str]]] = None) -> Variable:
+    """Feed slot (layers.py data:179); shape excludes the batch dim.
+
+    ``sharding`` optionally names one mesh axis per dim (batch dim included,
+    None = replicated), e.g. ``("data", None)`` — checked against
+    parallel.mesh axis names by ``analysis.lint_program`` (L004)."""
     return _block().create_var(name=name, shape=(-1,) + tuple(shape),
-                               dtype=dtype, is_data=True, lod_level=lod_level)
+                               dtype=dtype, is_data=True, lod_level=lod_level,
+                               sharding=sharding)
 
 
 def fc(input: Variable, size: int, act: Optional[str] = None,

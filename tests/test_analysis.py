@@ -1,0 +1,493 @@
+"""paddle_tpu.analysis — static verifier + shape interpreter + lint catalogue.
+
+Tier-1 (JAX_PLATFORMS=cpu safe; conftest forces the virtual CPU mesh).
+Covers the acceptance contract: every golden config and every config-style
+example verifies clean (zero error-severity diagnostics), while crafted
+malformed programs — undefined var, unregistered op, duplicate write, bad
+sub-block scope/index, shape mismatch, dead op — are each rejected with a
+structured Diagnostic, both through the library API, ``paddle_tpu lint``,
+and ``Executor.run(verify=True)``.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import paddle_tpu.analysis as A
+import paddle_tpu.fluid as fluid
+from golden_configs import CONFIGS
+from paddle_tpu.fluid import layers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# config-style examples (module-level `cost`): the ones `paddle_tpu train`
+# accepts and therefore the ones `paddle_tpu lint` must pass
+CONFIG_EXAMPLES = [
+    "examples/fit_a_line.py",
+    "examples/mnist_lenet.py",
+    "examples/quick_start_sentiment.py",
+    "examples/sequence_tagging.py",
+    "examples/traffic_prediction.py",
+]
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    fluid.reset_default_programs()
+    fluid.executor._global_scope = fluid.Scope()
+    yield
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _error_codes(diags):
+    return [d.code for d in A.errors(diags)]
+
+
+# ------------------------------------------------------- known-good programs --
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_golden_config_verifies_clean(name):
+    prog = CONFIGS[name]()
+    diags = A.analyze_program(prog)
+    assert not A.errors(diags), A.format_diagnostics(diags)
+    sdiags = A.analyze_program(fluid.default_startup_program())
+    assert not A.errors(sdiags), A.format_diagnostics(sdiags)
+
+
+@pytest.mark.parametrize("cfg", CONFIG_EXAMPLES)
+def test_example_config_lints_clean(cfg, capsys):
+    from paddle_tpu import cli
+    rc = cli.main(["lint", "--config", os.path.join(REPO, cfg)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 error(s)" in out
+
+
+def test_control_flow_program_verifies_clean():
+    """while + TensorArray greedy-decode shape (the hardest scoping case:
+    sub-block ops read parent vars, parent fetches loop results)."""
+    V, T = 5, 6
+    table = layers.data("table", shape=(V,))
+    start = layers.data("start", shape=())
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", T - 1)
+    cur = layers.cast(start, "int64")
+    toks = layers.array_write(cur, i, capacity=T)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        b = fluid.default_main_program().current_block()
+        row = b.create_var(shape=(V,), dtype="float32")
+        b.append_op("gather", {"X": [table.name], "Index": [cur.name]},
+                    {"Out": [row.name]})
+        _, idx = layers.topk(row, 1)
+        nxt = layers.cast(layers.reshape(idx, ()), "int64")
+        layers.assign(nxt, cur)
+        layers.increment(i)
+        layers.array_write(cur, i, array=toks)
+        layers.less_than(i, n, cond=cond)
+    # un-batched decode: analysis must use the REAL feed shapes (a (V, V)
+    # transition table, a scalar start token), not the declared -1 batch dims
+    diags = A.analyze_program(fluid.default_main_program(),
+                              feed={"table": np.zeros((V, V), np.float32),
+                                    "start": np.asarray(0.0, np.float32)},
+                              fetch=[toks.name])
+    assert not A.errors(diags), A.format_diagnostics(diags)
+
+
+# --------------------------------------------------- crafted malformed programs
+
+def test_rejects_undefined_input_var():
+    x = layers.data("x", shape=(4,))
+    g = fluid.default_main_program().global_block()
+    out = g.create_var(shape=(-1, 4))
+    g.append_op("elementwise_add", {"X": [x.name], "Y": ["ghost"]},
+                {"Out": [out.name]})
+    diags = A.analyze_program(fluid.default_main_program())
+    assert "V001" in _error_codes(diags)
+    d = next(d for d in diags if d.code == "V001")
+    assert d.var == "ghost" and d.op_type == "elementwise_add"
+    assert d.location() == "block 0, op #0 (elementwise_add)"
+
+
+def test_rejects_unregistered_op():
+    x = layers.data("x", shape=(4,))
+    layers.fc(x, 8)
+    prog = fluid.default_main_program()
+    prog.global_block().ops[0].type = "totally_bogus_op"
+    diags = A.analyze_program(prog)
+    assert "V002" in _error_codes(diags)
+
+
+def test_rejects_duplicate_output_write():
+    x = layers.data("x", shape=(4,))
+    g = fluid.default_main_program().global_block()
+    a = g.create_var(shape=(-1, 4))
+    g.append_op("scale", {"X": [x.name]}, {"Out": [a.name]}, {"scale": 2.0})
+    g.append_op("scale", {"X": [x.name]}, {"Out": [a.name]}, {"scale": 3.0})
+    diags = A.analyze_program(fluid.default_main_program(), fetch=[a.name])
+    assert "V003" in _error_codes(diags)
+    # read-then-rewrite (in-place update) is NOT a duplicate write
+    fluid.reset_default_programs()
+    x = layers.data("x", shape=(4,))
+    g = fluid.default_main_program().global_block()
+    a = g.create_var(shape=(-1, 4))
+    g.append_op("scale", {"X": [x.name]}, {"Out": [a.name]}, {"scale": 2.0})
+    g.append_op("elementwise_add", {"X": [a.name], "Y": [x.name]},
+                {"Out": [a.name]})
+    diags = A.analyze_program(fluid.default_main_program(), fetch=[a.name])
+    assert "V003" not in _codes(diags)
+
+
+def test_rejects_sibling_branch_scope_violation():
+    """A var declared in the true branch is NOT visible in the false branch
+    (parent-scope lookup goes UP, never sideways)."""
+    x = layers.data("x", shape=())
+    outv = layers.fill_constant((), "float32", 0.0)
+    thresh = layers.fill_constant((), "float32", 5.0)
+    pred = layers.greater_than(x, thresh)
+    c = fluid.Cond(pred)
+    with c.true_block():
+        doubled = layers.elementwise_add(x, x)
+        layers.assign(doubled, outv)
+    with c.false_block():
+        b = fluid.default_main_program().current_block()
+        bad = b.create_var(shape=(), dtype="float32")
+        b.append_op("scale", {"X": [doubled.name]}, {"Out": [bad.name]},
+                    {"scale": 1.0})
+        layers.assign(bad, outv)
+    diags = A.analyze_program(fluid.default_main_program(),
+                              fetch=[outv.name])
+    errs = [d for d in A.errors(diags) if d.code == "V001"]
+    assert errs and errs[0].var == doubled.name
+    assert "sibling" in (errs[0].hint or "")
+
+
+def test_rejects_invalid_sub_block_index():
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", 3)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        layers.increment(i)
+        layers.less_than(i, n, cond=cond)
+    prog = fluid.default_main_program()
+    prog.global_block().ops[-1].attrs["sub_block_idx"] = 99
+    diags = A.analyze_program(prog, fetch=[i.name])
+    assert "V004" in _error_codes(diags)
+
+
+def test_rejects_cyclic_sub_block():
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", 3)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        layers.increment(i)
+        layers.less_than(i, n, cond=cond)
+    prog = fluid.default_main_program()
+    # make the sub-block's own op point back at itself
+    sub = prog.blocks[1]
+    sub.append_op("while", {"Condition": [cond.name]}, {},
+                  {"sub_block_idx": 1})
+    diags = A.analyze_program(prog, fetch=[i.name])
+    assert any(d.code == "V004" and "cycle" in d.message
+               for d in A.errors(diags))
+
+
+def test_rejects_while_condition_never_updated():
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", 3)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        layers.increment(i)       # cond never written in the body
+    diags = A.analyze_program(fluid.default_main_program(), fetch=[i.name])
+    assert "V005" in _error_codes(diags)
+
+
+def test_rejects_shape_mismatch_statically():
+    x = layers.data("x", shape=(8,))
+    g = fluid.default_main_program().global_block()
+    g.create_var(name="w", shape=(4, 2), persistable=True)
+    o = g.create_var(shape=(-1, 2))
+    g.append_op("mul", {"X": [x.name], "Y": ["w"]}, {"Out": [o.name]})
+    diags = A.analyze_program(fluid.default_main_program(), fetch=[o.name])
+    errs = [d for d in A.errors(diags) if d.code == "S001"]
+    assert errs and errs[0].op_type == "mul"
+
+
+def test_rejects_loop_carry_shape_change():
+    """A while body that changes a carried var's dtype is statically
+    rejected (XLA loop carries must be invariant)."""
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", 3)
+    v = layers.fill_constant((), "float32", 0.0)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        b = fluid.default_main_program().current_block()
+        b.append_op("cast", {"X": [v.name]}, {"Out": [v.name]},
+                    {"dtype": "int32"})      # v: float32 -> int32 in carry
+        layers.increment(i)
+        layers.less_than(i, n, cond=cond)
+    diags = A.analyze_program(fluid.default_main_program(), fetch=[v.name])
+    assert "S003" in _error_codes(diags)
+
+
+def test_flags_dead_op():
+    x = layers.data("x", shape=(4,))
+    layers.fc(x, 8)                      # dead: nothing reads or fetches it
+    loss = layers.mean(layers.elementwise_mul(x, x))
+    diags = A.analyze_program(fluid.default_main_program(),
+                              fetch=[loss.name])
+    dead = [d for d in diags if d.code == "L001"]
+    assert dead and dead[0].severity == A.Severity.WARNING
+    # promotable to a hard failure
+    diags = A.lint_program(fluid.default_main_program(), fetch=[loss.name],
+                           severity_overrides={"L001": A.Severity.ERROR})
+    assert "L001" in _error_codes(diags)
+
+
+def test_fetch_of_undefined_var_rejected():
+    x = layers.data("x", shape=(4,))
+    layers.fc(x, 8)
+    diags = A.analyze_program(fluid.default_main_program(),
+                              fetch=["never_defined"])
+    assert "V006" in _error_codes(diags)
+
+
+# --------------------------------------------------------------- lint extras --
+
+def test_trace_safety_lint_flags_callable_attr():
+    x = layers.data("x", shape=(4,))
+    g = fluid.default_main_program().global_block()
+    o = g.create_var(shape=(-1, 4))
+    g.append_op("scale", {"X": [x.name]}, {"Out": [o.name]},
+                {"scale": 1.0, "post_hook": lambda v: v})
+    diags = A.lint_program(fluid.default_main_program(), fetch=[o.name])
+    assert any(d.code == "L003" for d in diags)
+    # fill_init's host init callable is the sanctioned exception
+    layers.fc(x, 4)
+    sdiags = A.lint_program(fluid.default_startup_program())
+    assert not any(d.code == "L003" for d in sdiags)
+
+
+def test_sharding_annotation_lint_and_roundtrip():
+    x = layers.data("x", shape=(4,), sharding=("data", None))
+    ok = A.lint_program(fluid.default_main_program(), fetch=[x.name])
+    assert not any(d.code == "L004" for d in ok)
+    # a repeated axis is always an error; an unknown axis is a warning
+    # against the default CANONICAL_ORDER (make_mesh allows custom names)
+    # but an error when the caller pins mesh_axes explicitly
+    y = layers.data("y", shape=(4,), sharding=("warp", "warp"))
+    diags = A.lint_program(fluid.default_main_program(),
+                           fetch=[x.name, y.name])
+    unknown = next(d for d in diags if d.code == "L004"
+                   and "unknown mesh axis 'warp'" in d.message)
+    repeated = next(d for d in diags if d.code == "L004"
+                    and "repeats" in d.message)
+    assert unknown.severity == A.Severity.WARNING
+    assert repeated.severity == A.Severity.ERROR
+    strict = A.lint_program(fluid.default_main_program(),
+                            fetch=[x.name, y.name],
+                            mesh_axes=["data", "model"])
+    assert any(d.code == "L004" and "unknown mesh axis 'warp'" in d.message
+               and d.severity == A.Severity.ERROR for d in strict)
+    # a malformed op-level spec is reported, not crashed on
+    g = fluid.default_main_program().global_block()
+    o = g.create_var(shape=(-1, 4))
+    g.append_op("scale", {"X": [x.name]}, {"Out": [o.name]},
+                {"scale": 1.0, "sharding": 7})
+    bad = A.lint_program(fluid.default_main_program(), fetch=[o.name])
+    assert any(d.code == "L004" and "not a sharding spec" in d.message
+               for d in bad)
+    # a bare-string spec means ONE axis, not its characters
+    z = layers.data("z", shape=(4,), sharding="data")
+    assert z.sharding == ("data",)
+    # annotation rides Program JSON
+    clone = fluid.Program.from_dict(fluid.default_main_program().to_dict())
+    assert clone.global_block().var("x").sharding == ("data", None)
+
+
+def test_unused_var_lint():
+    layers.data("x", shape=(4,))
+    g = fluid.default_main_program().global_block()
+    g.create_var(name="orphan", shape=(3,))
+    diags = A.lint_program(fluid.default_main_program())
+    assert any(d.code == "L002" and d.var == "orphan" for d in diags)
+
+
+# ----------------------------------------------------------- executor wiring --
+
+def test_executor_verify_true_runs_good_program():
+    x = layers.data("x", shape=(4,))
+    h = layers.fc(x, 8, act="tanh")
+    loss = layers.mean(h)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(feed={"x": np.zeros((2, 4), np.float32)},
+                   fetch_list=[loss], verify=True)
+    assert np.isfinite(out)
+
+
+def test_executor_verify_true_rejects_before_trace():
+    x = layers.data("x", shape=(4,))
+    g = fluid.default_main_program().global_block()
+    o = g.create_var(shape=(-1, 4))
+    g.append_op("elementwise_add", {"X": [x.name], "Y": ["ghost"]},
+                {"Out": [o.name]})
+    exe = fluid.Executor()
+    with pytest.raises(A.ProgramVerificationError) as ei:
+        exe.run(feed={"x": np.zeros((2, 4), np.float32)},
+                fetch_list=[o], verify=True)
+    assert any(d.code == "V001" for d in ei.value.diagnostics)
+
+
+def test_executor_verify_true_uses_real_feed_shapes():
+    """A rank-breaking feed is rejected statically with the op site."""
+    x = layers.data("x", shape=(4,))
+    w = layers.data("w", shape=(4,))
+    out = layers.elementwise_add(x, w)
+    exe = fluid.Executor()
+    with pytest.raises(A.ProgramVerificationError) as ei:
+        exe.run(feed={"x": np.zeros((2, 4), np.float32),
+                      "w": np.zeros((2, 5), np.float32)},
+                fetch_list=[out], verify=True)
+    assert any(d.code == "S001" for d in ei.value.diagnostics)
+
+
+# ------------------------------------------------------------------ CLI path --
+
+def test_cli_lint_rejects_bad_config(tmp_path, capsys):
+    from paddle_tpu import cli
+    bad = tmp_path / "bad_cfg.py"
+    bad.write_text(
+        "import paddle_tpu.fluid as fluid\n"
+        "from paddle_tpu.fluid import layers\n"
+        "x = layers.data('x', shape=(4,))\n"
+        "g = fluid.default_main_program().global_block()\n"
+        "o = g.create_var(shape=(-1, 4))\n"
+        "g.append_op('elementwise_add', {'X': [x.name], 'Y': ['ghost']},"
+        " {'Out': [o.name]})\n")
+    rc = cli.main(["lint", "--config", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "V001" in out
+
+
+def test_cli_lint_fail_on_warning_promotes_dead_op(tmp_path, capsys):
+    from paddle_tpu import cli
+    cfg = tmp_path / "dead_cfg.py"
+    cfg.write_text(
+        "import paddle_tpu.fluid as fluid\n"
+        "from paddle_tpu.fluid import layers\n"
+        "x = layers.data('x', shape=(4,))\n"
+        "dead = layers.fc(x, 8)\n"
+        "cost = layers.mean(layers.elementwise_mul(x, x))\n")
+    assert cli.main(["lint", "--config", str(cfg)]) == 0   # warning only
+    capsys.readouterr()
+    rc = cli.main(["lint", "--config", str(cfg), "--fail-on", "warning"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "L001" in out
+
+
+def test_cli_lint_json_output(tmp_path, capsys):
+    import json
+    from paddle_tpu import cli
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(
+        "from paddle_tpu.fluid import layers\n"
+        "x = layers.data('x', shape=(4,))\n"
+        "cost = layers.mean(layers.fc(x, 2))\n")
+    rc = cli.main(["lint", "--config", str(cfg), "--json"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    # stdout is PURE JSON (summary goes to stderr) so `lint --json | jq` works
+    payload = json.loads(captured.out)
+    assert isinstance(payload, list)
+    assert "lint:" in captured.err
+    # every diagnostic carries its program structurally, not via message text
+    assert all(d["program"] in ("main", "startup") for d in payload)
+
+
+def test_cli_lint_missing_config_is_usage_error(tmp_path, capsys):
+    """Exit 2 (usage), distinguishable from exit 1 (findings)."""
+    from paddle_tpu import cli
+    rc = cli.main(["lint", "--config", str(tmp_path / "nope.py")])
+    assert rc == 2
+    assert "cannot load config" in capsys.readouterr().err
+
+
+# ------------------------------------------------------------- extensibility --
+
+def test_register_shape_infer_rule_for_custom_op():
+    from paddle_tpu.analysis import register_shape_infer
+    from paddle_tpu.fluid.registry import OpRegistry
+
+    @OpRegistry.register("test_analysis_double")
+    def _double(ins, attrs):
+        return {"Out": [ins["X"][0] * 2]}
+
+    calls = []
+
+    @register_shape_infer("test_analysis_double")
+    def _infer(op, ins, ctx):
+        calls.append(op.type)
+        s = ins["X"][0]
+        import jax
+        return {"Out": [jax.ShapeDtypeStruct(s.shape, s.dtype)]}
+
+    try:
+        x = layers.data("x", shape=(4,))
+        g = fluid.default_main_program().global_block()
+        o = g.create_var(shape=(-1, 4))
+        g.append_op("test_analysis_double", {"X": [x.name]},
+                    {"Out": [o.name]})
+        diags = A.analyze_program(fluid.default_main_program(),
+                                  fetch=[o.name])
+        assert not A.errors(diags) and calls == ["test_analysis_double"]
+    finally:
+        OpRegistry._ops.pop("test_analysis_double", None)
+        A.ShapeInferRegistry._rules.pop("test_analysis_double", None)
+
+
+def test_operator_to_dict_keeps_callable_attr_keys():
+    """Satellite: serialized ops must keep attr KEYS for callables (named
+    placeholder), not silently drop them."""
+    x = layers.data("x", shape=(4,))
+    layers.fc(x, 8)
+    startup = fluid.default_startup_program()
+    fill = next(op for op in startup.global_block().ops
+                if op.type == "fill_init")
+    d = fill.to_dict()
+    assert "init" in d["attrs"], "callable attr key was dropped"
+    assert isinstance(d["attrs"]["init"], str)
+    assert d["attrs"]["init"].startswith("<callable:")
+    import json
+    json.dumps(d)  # placeholder must be JSON-able
+
+
+def test_diagnostic_location_matches_runtime_provenance():
+    """Static diagnostics and trace-time error notes cite the same site
+    format ('block B, op #I (...)')."""
+    assert A.op_site(0, 3, "concat") == "block 0, op #3 (concat)"
+    x = layers.data("x", shape=(4,))
+    h = layers.fc(x, 8, act="relu")
+    y = layers.data("y", shape=(3,))
+    bad = layers.concat([h, y], axis=0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(Exception) as ei:
+        exe.run(fluid.default_main_program(),
+                feed={"x": np.zeros((2, 4), np.float32),
+                      "y": np.zeros((2, 3), np.float32)},
+                fetch_list=[bad])
+    msg = str(ei.value) + "\n".join(getattr(ei.value, "__notes__", []))
+    assert "block 0, op #" in msg
+    # and the same defect is caught statically, citing the same block
+    diags = A.analyze_program(fluid.default_main_program(),
+                              fetch=[bad.name])
+    errs = [d for d in A.errors(diags) if d.code == "S001"]
+    assert errs and errs[0].block_idx == 0 and errs[0].op_type == "concat"

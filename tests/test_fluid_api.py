@@ -162,4 +162,6 @@ def test_failing_op_names_itself_in_the_error():
     # context arrives via add_note (3.11+) so the original exception object —
     # and its structured args — survives; notes are not part of str()
     msg = str(ei.value) + "\n".join(getattr(ei.value, "__notes__", []))
-    assert "'concat'" in msg and "op chain" in msg
+    # op provenance uses the analysis.op_site format so runtime errors and
+    # static diagnostics cite the same location
+    assert "block 0, op #3 (concat)" in msg and "op chain" in msg

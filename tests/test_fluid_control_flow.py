@@ -95,6 +95,81 @@ def test_conditional_block_both_branches():
     assert float(hi) == 14.0 and float(lo) == 3.0
 
 
+def _build_sibling_branch_read():
+    """false branch reads `doubled`, which only the true branch defines —
+    parent-scope lookup goes UP, never sideways, so this program is broken."""
+    x = layers.data("x", shape=())
+    out = layers.fill_constant((), "float32", 0.0)
+    thresh = layers.fill_constant((), "float32", 5.0)
+    pred = layers.greater_than(x, thresh)
+    c = fluid.Cond(pred)
+    with c.true_block():
+        doubled = layers.elementwise_add(x, x)
+        layers.assign(doubled, out)
+    with c.false_block():
+        b = fluid.default_main_program().current_block()
+        bad = b.create_var(shape=(), dtype="float32")
+        b.append_op("scale", {"X": [doubled.name]}, {"Out": [bad.name]},
+                    {"scale": 1.0})
+        layers.assign(bad, out)
+    return out, doubled
+
+
+def test_sibling_branch_read_rejected_by_verifier():
+    import paddle_tpu.analysis as A
+    out, doubled = _build_sibling_branch_read()
+    diags = A.verify_program(fluid.default_main_program(),
+                             fetch=[out.name])
+    errs = [d for d in A.errors(diags) if d.code == "V001"]
+    assert errs and errs[0].var == doubled.name
+    # the diagnostic cites the false branch, not the true one
+    false_idx = fluid.default_main_program().global_block().ops[-1] \
+        .attrs["false_block_idx"]
+    assert errs[0].block_idx == false_idx
+
+
+def test_sibling_branch_read_fails_cleanly_at_trace_time():
+    """The same broken program must ALSO fail at trace time with the var
+    name and the op-site format the static diagnostic uses — not succeed by
+    leaking the true branch's env into the false branch."""
+    import paddle_tpu.analysis as A
+    out, doubled = _build_sibling_branch_read()
+    exe = fluid.Executor()
+    with pytest.raises(Exception) as ei:
+        exe.run(feed={"x": np.float32(3.0)}, fetch_list=[out])
+    msg = str(ei.value) + "\n".join(getattr(ei.value, "__notes__", []))
+    assert doubled.name in msg
+    assert "op #" in msg and "(scale)" in msg
+    # verify=True rejects it BEFORE any tracing, citing the same var
+    with pytest.raises(A.ProgramVerificationError) as vi:
+        exe.run(feed={"x": np.float32(3.0)}, fetch_list=[out], verify=True)
+    assert any(d.code == "V001" and d.var == doubled.name
+               for d in vi.value.diagnostics)
+
+
+def test_while_body_var_not_visible_after_loop():
+    """A temp defined only inside a while body is out of scope afterwards:
+    the verifier rejects a global-block read of it (and the fetch)."""
+    import paddle_tpu.analysis as A
+    i = layers.fill_constant((), "int32", 0)
+    n = layers.fill_constant((), "int32", 3)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        b = fluid.default_main_program().current_block()
+        tmp = b.create_var(shape=(), dtype="int32")
+        b.append_op("scale", {"X": [i.name]}, {"Out": [tmp.name]},
+                    {"scale": 2.0})
+        layers.increment(i)
+        layers.less_than(i, n, cond=cond)
+    g = fluid.default_main_program().global_block()
+    leak = g.create_var(shape=(), dtype="int32")
+    g.append_op("scale", {"X": [tmp.name]}, {"Out": [leak.name]},
+                {"scale": 1.0})
+    diags = A.verify_program(fluid.default_main_program(), fetch=[leak.name])
+    errs = [d for d in A.errors(diags) if d.code == "V001"]
+    assert errs and errs[0].var == tmp.name and errs[0].block_idx == 0
+
+
 # ------------------------------------------------------------- static_rnn ----
 
 def test_static_rnn_matches_manual_accumulation():
