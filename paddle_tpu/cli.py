@@ -57,13 +57,24 @@ def cmd_train(args):
             print(f"pass {e.pass_id} done; last cost "
                   f"{costs[-1] if costs else float('nan'):.6f}")
             if args.save_dir:
-                import os
+                import io
+                import json as _json
 
-                from .trainer.checkpoint import pass_dir
-                d = pass_dir(args.save_dir, e.pass_id)
-                os.makedirs(d, exist_ok=True)
-                with open(os.path.join(d, "params.tar"), "wb") as f:
-                    trainer.parameters.to_tar(f)
+                from .trainer.checkpoint import (FORMAT_VERSION,
+                                                 publish_members)
+                # the same tmp-dir + CRC manifest + atomic-rename protocol
+                # as save_checkpoint: a crash mid-dump leaves no dir that
+                # latest_pass would mistake for a checkpoint. state.json
+                # rides along so load_checkpoint can read the dir, not
+                # just verify it
+                buf = io.BytesIO()
+                trainer.parameters.to_tar(buf)
+                state = _json.dumps({"pass_id": e.pass_id,
+                                     "version": FORMAT_VERSION,
+                                     "pass_complete": True}).encode()
+                publish_members(args.save_dir, e.pass_id,
+                                [("params.tar", buf.getvalue()),
+                                 ("state.json", state)])
 
     train_reader = cfg["train_reader"]
     srv = None

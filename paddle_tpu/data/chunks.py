@@ -14,6 +14,8 @@ import os
 import pickle
 from typing import Any, Callable, Iterable, List, Optional
 
+from .. import faults
+from ..utils.retry import RetryBudgetExceeded, RetryPolicy
 from .reader import Reader
 
 
@@ -57,10 +59,15 @@ def chunk_reader(paths: Iterable[str]) -> Reader:
     return reader
 
 
+class _Starved(Exception):
+    """Internal: the master had no task for us but the pass is not done."""
+
+
 def cloud_reader(master_client, *, pass_end_sentinel: bool = False,
                  poll_interval: float = 0.1,
                  max_idle_polls: int = 600,
-                 new_pass_at_end: bool = False) -> Reader:
+                 new_pass_at_end: bool = False,
+                 poll_policy: Optional[RetryPolicy] = None) -> Reader:
     """Fault-tolerant distributed reader (creator.py:91 cloud_reader): pull
     chunk tasks from the master service, stream their samples, report
     finished/failed. One pass = until the master says the pass is done.
@@ -69,29 +76,59 @@ def cloud_reader(master_client, *, pass_end_sentinel: bool = False,
     so the next ``reader()`` call streams a fresh pass — correct for a
     single consumer (the --local_master dev mode); multi-consumer jobs
     coordinate the pass transition externally (e.g. rank 0 only).
+
+    Idle polling (other consumers hold every pending task) runs under a
+    :class:`RetryPolicy` — gentle exponential backoff instead of a fixed
+    busy-poll, bounded by an overall starvation deadline equivalent to the
+    legacy ``max_idle_polls * poll_interval`` budget. Pass ``poll_policy``
+    to tune it (a fake-clock policy makes tests sleepless).
     """
-    import time
+
+    _END = object()
 
     def reader():
-        idle = 0
-        while True:
+        if poll_policy is not None:
+            # starvation is the only retryable event at this site; a caller
+            # tunes the schedule/deadline and must not need to know about
+            # the module-private _Starved marker
+            import copy
+            policy = copy.copy(poll_policy)
+            policy.retryable = _Starved
+        else:
+            policy = RetryPolicy(
+                max_attempts=None, base_delay=poll_interval, multiplier=1.5,
+                max_delay=max(poll_interval * 10, poll_interval),
+                deadline=max_idle_polls * poll_interval,
+                jitter=0.1, retryable=_Starved)
+
+        def poll_once():
             task = master_client.get_task()
-            if task is None:
-                todo, pending, done, disc, epoch = master_client.stats()
-                if todo == 0 and pending == 0:
-                    if new_pass_at_end:
-                        master_client.new_pass()
-                    return                      # pass complete
-                idle += 1
-                if idle > max_idle_polls:
-                    raise TimeoutError("master starved the reader")
-                time.sleep(poll_interval)
-                continue
-            idle = 0
+            if task is not None:
+                return task
+            todo, pending, done, disc, epoch = master_client.stats()
+            if todo == 0 and pending == 0:
+                return _END                     # pass complete
+            raise _Starved()
+
+        while True:
+            try:
+                task = policy.call(poll_once, describe="task poll")
+            except RetryBudgetExceeded as e:
+                raise TimeoutError(
+                    f"master starved the reader "
+                    f"({e.attempts} idle polls)") from e
+            if task is _END:
+                if new_pass_at_end:
+                    master_client.new_pass()
+                return
             task_id, path = task
             try:
+                faults.fire("reader.next")      # chaos: per-task failure
                 yield from chunk_reader([path])()
             except Exception:
+                # the elastic contract (go/master re-dispatch): report the
+                # task failed and let the master hand it to a healthy
+                # consumer (or discard after failure_max strikes)
                 master_client.task_failed(task_id)
                 continue
             master_client.task_finished(task_id)

@@ -22,10 +22,14 @@ class DoubleBuffer:
     """
 
     def __init__(self, batches: Callable[[], Iterable[Any]], depth: int = 2,
-                 transform: Optional[Callable[[Any], Any]] = None):
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 timeout: Optional[float] = None):
         self.batches = batches
         self.depth = depth
         self.transform = transform
+        # watchdog: a producer that silently wedges (dead data source, hung
+        # filesystem) must surface as TimeoutError, not hang the train loop
+        self.timeout = timeout
 
     def __iter__(self) -> Iterator[Any]:
         from .reader import buffered, map_readers
@@ -34,4 +38,4 @@ class DoubleBuffer:
             # transform runs on the worker thread, overlapping host conversion
             # with device compute
             creator = map_readers(self.transform, creator)
-        return iter(buffered(creator, self.depth)())
+        return iter(buffered(creator, self.depth, timeout=self.timeout)())

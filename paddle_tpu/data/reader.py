@@ -76,9 +76,14 @@ def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
 _SENTINEL = object()
 
 
-def buffered(reader_creator: Reader, size: int) -> Reader:
+def buffered(reader_creator: Reader, size: int,
+             timeout: float = None) -> Reader:
     """Background-thread read-ahead of up to ``size`` samples — the per-reader
-    analog of the C++ DoubleBuffer (DataProvider.h:249)."""
+    analog of the C++ DoubleBuffer (DataProvider.h:249).
+
+    ``timeout`` is a watchdog: if the producer thread delivers nothing for
+    that many seconds, the consumer raises TimeoutError instead of blocking
+    forever behind a wedged data source."""
 
     def reader():
         q: queue.Queue = queue.Queue(maxsize=size)
@@ -97,7 +102,12 @@ def buffered(reader_creator: Reader, size: int) -> Reader:
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         while True:
-            s = q.get()
+            try:
+                s = q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"prefetch watchdog: no batch within {timeout}s "
+                    "(data source wedged?)") from None
             if s is end:
                 if err:
                     raise err[0]

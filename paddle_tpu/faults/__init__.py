@@ -1,0 +1,11 @@
+"""paddle_tpu.faults — deterministic fault injection for the runtime.
+
+See :mod:`paddle_tpu.faults.inject` for the site catalogue and semantics,
+and docs/design/faults.md for the design contract.
+"""
+
+from .inject import (SITES, Fault, FaultError, FaultPlan, filter_bytes,
+                     filter_value, fire, is_active)
+
+__all__ = ["FaultPlan", "Fault", "FaultError", "SITES",
+           "fire", "filter_bytes", "filter_value", "is_active"]

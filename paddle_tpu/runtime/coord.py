@@ -41,7 +41,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from .master_service import _recv_msg, _send_msg
+from .. import faults
+from .master_service import _recv_msg, _RpcClient, _send_msg
 
 
 class CoordServer:
@@ -157,48 +158,16 @@ class CoordServer:
         return {"ok": True, "claimed": True, "recorded": token}
 
 
-class _CoordClient:
-    """Minimal reconnecting client for CoordServer calls."""
+class _CoordClient(_RpcClient):
+    """Reconnecting client for CoordServer calls: the shared
+    :class:`_RpcClient` plumbing (RetryPolicy backoff, per-call socket
+    deadline, drop-socket-on-error) against one endpoint, exposing the raw
+    request interface."""
 
-    def __init__(self, host: str, port: int, retries: int = 5,
-                 retry_delay: float = 0.2):
-        self.addr = (host, port)
-        self.retries = retries
-        self.retry_delay = retry_delay
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+    _rpc_name = "coord rpc"
 
     def call(self, req):
-        with self._lock:
-            last = None
-            for attempt in range(self.retries):
-                try:
-                    if self._sock is None:
-                        self._sock = socket.create_connection(
-                            self.addr, timeout=10.0)
-                        self._sock.setsockopt(socket.IPPROTO_TCP,
-                                              socket.TCP_NODELAY, 1)
-                    _send_msg(self._sock, req)
-                    resp = _recv_msg(self._sock)
-                    if resp is None:
-                        raise ConnectionError("coord server closed connection")
-                    return resp
-                except (OSError, ConnectionError) as e:
-                    last = e
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                    self._sock = None
-                    time.sleep(self.retry_delay * (attempt + 1))
-            raise ConnectionError(f"coord server unreachable: {last}")
-
-    def close(self):
-        with self._lock:
-            if self._sock is not None:
-                self._sock.close()
-                self._sock = None
+        return self._call(req)
 
 
 class NetworkLease:
@@ -248,6 +217,7 @@ class NetworkLease:
         return False
 
     def renew(self, now: Optional[float] = None) -> bool:
+        faults.fire("lease.renew")
         r = self._client.call({"op": "lease_renew", "name": self.name,
                                "owner": self.owner, "ttl": self.ttl})
         if r.get("renewed"):

@@ -41,6 +41,8 @@ import time
 import uuid
 from typing import Optional, Tuple
 
+from .. import faults
+
 
 @contextlib.contextmanager
 def _flocked(lock_path: str):
@@ -109,6 +111,7 @@ class FileLease:
 
     def renew(self, now: Optional[float] = None) -> bool:
         """Extend our lease; False (lease LOST) if someone else took it."""
+        faults.fire("lease.renew")   # chaos: stall/FS-outage injection point
         now = time.time() if now is None else now
         with _flocked(self._lock_path):
             h = self._read(self.path)

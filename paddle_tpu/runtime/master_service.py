@@ -27,6 +27,8 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from .. import faults
+from ..utils.retry import RetryBudgetExceeded, RetryPolicy
 from .master import TaskMaster
 
 _HDR = struct.Struct("<I")
@@ -35,14 +37,26 @@ _HDR = struct.Struct("<I")
 _MAX_FRAME = 64 << 20
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
+def _send_msg(sock: socket.socket, obj, *, chaos: bool = False) -> None:
     payload = json.dumps(obj).encode()
     if len(payload) > _MAX_FRAME:
         raise ValueError(f"frame too large ({len(payload)} bytes)")
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+    # chaos plane (client edges only — ``chaos=True``; a server handler
+    # sharing this framing must not double-count the site): rpc.send can
+    # raise (dropped request), delay, or mangle the frame. The header is
+    # packed BEFORE the hook so a truncate fault produces a genuinely torn
+    # frame (header promises more bytes than arrive — the receiver blocks,
+    # the sender's call timeout fires), and a corrupt fault turns into a
+    # parse failure at the receiver
+    hdr = _HDR.pack(len(payload))
+    if chaos:
+        payload = faults.filter_bytes("rpc.send", payload)
+    sock.sendall(hdr + payload)
 
 
-def _recv_msg(sock: socket.socket):
+def _recv_msg(sock: socket.socket, *, chaos: bool = False):
+    if chaos:
+        faults.fire("rpc.recv")
     hdr = _recv_exact(sock, _HDR.size)
     if hdr is None:
         return None
@@ -52,7 +66,13 @@ def _recv_msg(sock: socket.socket):
     body = _recv_exact(sock, n)
     if body is None:
         return None
-    return json.loads(body.decode())
+    try:
+        return json.loads(body.decode())
+    except (UnicodeDecodeError, ValueError):
+        # a frame that fails to parse means the stream is desynchronized or
+        # corrupt: sever the connection (the retry layer reconnects) rather
+        # than propagate garbage into the caller
+        raise ConnectionError("corrupt frame from peer (json parse failed)")
 
 
 def _recv_exact(sock, n) -> Optional[bytes]:
@@ -298,26 +318,36 @@ class MasterServer:
         return {"ok": False, "error": f"unknown op {op!r}"}
 
 
-class MasterClient:
-    """Auto-reconnecting client (go/connection/conn.go semantics).
-
-    Accepts either one address or a failover list of candidate master
-    endpoints (active + standbys); reconnection rotates through them, so a
-    master failover is transparent to the trainer — the role etcd master
-    discovery plays for go/master/client.go.
+class _RpcClient:
+    """Reconnecting JSON-frame RPC plumbing shared by every client in the
+    runtime (master + coordinator): one socket under a lock, a per-call
+    deadline, the shared :class:`RetryPolicy`, endpoint-failover rotation,
+    and drop-the-socket-on-any-error discipline (a stream in an unknown
+    state is never reused). Subclasses add their service API on top of
+    :meth:`_call` and set ``_rpc_name`` for error messages.
     """
+
+    _rpc_name = "rpc"
 
     def __init__(self, host=None, port: Optional[int] = None, *,
                  endpoints: Optional[List[Tuple[str, int]]] = None,
-                 retries: int = 5, retry_delay: float = 0.2):
+                 retries: int = 5, retry_delay: float = 0.2,
+                 call_timeout: float = 10.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         if endpoints is None:
             if host is None or port is None:
                 raise ValueError("pass (host, port) or endpoints=[...]")
             endpoints = [(host, port)]
         self.endpoints = list(endpoints)
         self._ep_idx = 0
-        self.retries = retries
-        self.retry_delay = retry_delay
+        #: per-call socket deadline: a wedged master surfaces as a timeout
+        #: (retried against the next endpoint), never an indefinite hang
+        self.call_timeout = call_timeout
+        # capped exponential backoff with jitter, replacing the old
+        # retry_delay * (attempt + 1) linear sleep (ISSUE 2 satellite)
+        self.policy = retry_policy or RetryPolicy(
+            max_attempts=retries, base_delay=retry_delay, multiplier=2.0,
+            max_delay=2.0, jitter=0.25)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -329,39 +359,75 @@ class MasterClient:
         last = None
         for _ in range(len(self.endpoints)):
             try:
-                s = socket.create_connection(self.addr, timeout=10.0)
+                s = socket.create_connection(self.addr,
+                                             timeout=self.call_timeout)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)  # LightNetwork
                 self._sock = s
                 return
             except OSError as e:
                 last = e
                 self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
-        raise ConnectionError(f"no master endpoint reachable: {last}")
+        raise ConnectionError(
+            f"no {self._rpc_name} endpoint reachable: {last}")
+
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call_once(self, req):
+        try:
+            if self._sock is None:
+                self._connect()
+            self._sock.settimeout(self.call_timeout)
+            _send_msg(self._sock, req, chaos=True)
+            resp = _recv_msg(self._sock, chaos=True)
+        except (OSError, ConnectionError):
+            # the stream is in an unknown state: never reuse the socket
+            self._drop_sock()
+            raise
+        if resp is None:
+            self._drop_sock()
+            raise ConnectionError("server closed connection")
+        if not resp.get("ok") and \
+                str(resp.get("error", "")).startswith("fenced"):
+            # deposed server: rotate to the standby and retry
+            self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
+            self._drop_sock()
+            raise ConnectionError(resp["error"])
+        return resp
 
     def _call(self, req):
         with self._lock:
-            last_err = None
-            for attempt in range(self.retries):
-                try:
-                    if self._sock is None:
-                        self._connect()
-                    _send_msg(self._sock, req)
-                    resp = _recv_msg(self._sock)
-                    if resp is None:
-                        raise ConnectionError("server closed connection")
-                    if not resp.get("ok") and \
-                            str(resp.get("error", "")).startswith("fenced"):
-                        # deposed master: rotate to the standby and retry
-                        self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
-                        raise ConnectionError(resp["error"])
-                    return resp
-                except (OSError, ConnectionError) as e:
-                    last_err = e
-                    self._sock = None
-                    time.sleep(self.retry_delay * (attempt + 1))
-            raise ConnectionError(f"master unreachable: {last_err}")
+            try:
+                return self.policy.call(
+                    self._call_once, req,
+                    describe=f"{self._rpc_name} {req.get('op')!r}")
+            except RetryBudgetExceeded as e:
+                raise ConnectionError(
+                    f"{self._rpc_name} server unreachable after "
+                    f"{e.attempts} attempt(s): {e.last_error}") \
+                    from e.last_error
 
-    # -- API ---------------------------------------------------------------
+    def close(self):
+        with self._lock:
+            self._drop_sock()
+
+
+class MasterClient(_RpcClient):
+    """Auto-reconnecting master client (go/connection/conn.go semantics).
+
+    Accepts either one address or a failover list of candidate master
+    endpoints (active + standbys); reconnection rotates through them, so a
+    master failover is transparent to the trainer — the role etcd master
+    discovery plays for go/master/client.go.
+    """
+
+    _rpc_name = "master rpc"
+
     def set_dataset(self, payloads: List[str]):
         self._call({"op": "set_dataset", "payloads": payloads})
 
@@ -384,8 +450,3 @@ class MasterClient:
     def stats(self):
         r = self._call({"op": "stats"})
         return (r["todo"], r["pending"], r["done"], r["discarded"], r["epoch"])
-
-    def close(self):
-        if self._sock is not None:
-            self._sock.close()
-            self._sock = None

@@ -5,8 +5,9 @@ The merged analog of paddle/trainer (C++ driver) and python/paddle/v2/trainer.py
 """
 
 from . import event
-from .checkpoint import (from_tar, latest_pass, load_checkpoint, pass_dir,
-                         save_checkpoint, to_tar)
+from .checkpoint import (COMPLETE_MANIFEST, from_tar, latest_pass,
+                         load_checkpoint, pass_dir, publish_members,
+                         save_checkpoint, to_tar, verify_checkpoint)
 from .evaluator import (AucEvaluator, ChunkEvaluator,
                         ClassificationErrorEvaluator, CTCErrorEvaluator,
                         DetectionMAPEvaluator, Evaluator, EvaluatorGroup,
@@ -21,4 +22,5 @@ __all__ = ["Trainer", "event",
            "ChunkEvaluator", "CTCErrorEvaluator", "DetectionMAPEvaluator",
            "PnpairEvaluator", "ValuePrinterEvaluator", "MaxIdPrinterEvaluator",
            "to_tar", "from_tar", "save_checkpoint", "load_checkpoint",
-           "latest_pass", "pass_dir"]
+           "latest_pass", "pass_dir", "publish_members",
+           "verify_checkpoint", "COMPLETE_MANIFEST"]

@@ -11,6 +11,15 @@ Capability parity with the reference (SURVEY.md §5 'Checkpoint / resume'):
 Format: a real tarfile, one ``.npy`` member per parameter path plus a JSON
 ``__meta__`` member carrying {version, crc32 per member, pytree paths}; works for
 any params/optimizer-state pytree.
+
+Crash safety (ISSUE 2): a pass directory is written as ``pass-%05d.tmp`` —
+every member fsynced, per-member CRCs recorded in a ``_COMPLETE`` manifest
+written last — then atomically renamed into place. A crash (or ``kill -9``)
+at ANY point leaves either the previous durable state or a ``.tmp`` dir that
+:func:`latest_pass` never considers. :func:`load_checkpoint` falls back to
+the newest *verifiable* pass when the latest fails CRC validation, so a
+torn or bit-rotted checkpoint degrades resume by one pass instead of
+wedging the job.
 """
 
 from __future__ import annotations
@@ -19,17 +28,24 @@ import io
 import json
 import os
 import re
+import shutil
 import tarfile
 import zlib
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from .. import faults
 from ..core.pytree import flatten_path_tree, tree_spec, unflatten_path_tree
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
 
 FORMAT_VERSION = 1
 _META = "__meta__.json"
+#: completion manifest filename — a pass dir without it is not a checkpoint
+COMPLETE_MANIFEST = "_COMPLETE"
 
 
 def _flatten(tree) -> Dict[str, np.ndarray]:
@@ -94,31 +110,229 @@ def pass_dir(output_dir: str, pass_id: int) -> str:
     return os.path.join(output_dir, f"pass-{pass_id:05d}")
 
 
-def save_checkpoint(output_dir: str, pass_id: int, params,
-                    opt_state=None, extra: Optional[Dict[str, Any]] = None) -> str:
+def _fsync_file(f) -> None:
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """Durability of a rename/create requires fsyncing the containing dir;
+    best-effort on filesystems that refuse directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_member(d: str, name: str, payload: bytes) -> Dict[str, int]:
+    """Write one checkpoint member, fsynced; returns its manifest entry.
+
+    The CRC is computed on the *intended* payload BEFORE the ``ckpt.write``
+    fault hook, so an injected torn/corrupt write is exactly what manifest
+    verification later catches — same property as a real partial write.
+    """
+    entry = {"crc32": zlib.crc32(payload) & 0xFFFFFFFF, "size": len(payload)}
+    written = faults.filter_bytes("ckpt.write", payload)
+    with open(os.path.join(d, name), "wb") as f:
+        f.write(written)
+        _fsync_file(f)
+    return entry
+
+
+def _recover_torn_swap(output_dir: str) -> None:
+    """Finish or roll back a pass publication interrupted mid-swap.
+
+    Re-publishing an existing pass moves it aside (``.old``) before renaming
+    the ``.tmp`` into place; a crash between those renames leaves the pass
+    visible only under suffixed names. Recovery, for each pass whose final
+    dir is missing: a ``.tmp`` that carries a *verified* manifest was
+    complete — roll it forward; otherwise an ``.old`` is restored. A ``.tmp``
+    without a valid manifest is an ordinary torn write and stays ignored.
+    Idempotent; called before every discovery scan and publication.
+    """
+    if not os.path.isdir(output_dir):
+        return
+    suffixed: Dict[str, Dict[str, str]] = {}
+    for name in os.listdir(output_dir):
+        m = re.fullmatch(r"(pass-\d{5})\.(old|tmp)", name)
+        if m:
+            suffixed.setdefault(m.group(1), {})[m.group(2)] = \
+                os.path.join(output_dir, name)
+    mutated = False
+    for base, found in suffixed.items():
+        d = os.path.join(output_dir, base)
+        try:
+            if os.path.exists(d):
+                # published pass present: an .old is post-publish garbage
+                # from a crash before its rmtree — reclaim it (a .tmp is
+                # left to the writer path, which owns the mid-write
+                # lifecycle)
+                if "old" in found:
+                    shutil.rmtree(found["old"], ignore_errors=True)
+                    mutated = True
+                continue
+            tmp, old = found.get("tmp"), found.get("old")
+            if tmp is not None and verify_checkpoint(tmp):
+                os.rename(tmp, d)
+                mutated = True
+                log.warning("recovered torn swap: published %s", d)
+                if old is not None:
+                    shutil.rmtree(old, ignore_errors=True)
+            elif old is not None:
+                os.rename(old, d)
+                mutated = True
+                log.warning("recovered torn swap: restored %s", d)
+        except OSError as e:
+            # lost a race with the writer's own swap, or a read-only
+            # mount: discovery must degrade to a pure read, not crash
+            log.warning("torn-swap recovery for %s skipped: %s", base, e)
+    if mutated:       # keep discovery a pure read in the common case
+        _fsync_dir(output_dir)
+
+
+def publish_members(output_dir: str, pass_id: int,
+                    members: Iterable[Tuple[str, bytes]]) -> str:
+    """Atomically publish ``output_dir/pass-%05d`` from (name, payload) pairs.
+
+    Write order (each step durable before the next): members into a ``.tmp``
+    dir -> ``_COMPLETE`` manifest (per-member CRC32 + size) -> fsync dir ->
+    rename to the final name -> fsync parent. A crash anywhere before the
+    rename leaves only a ``.tmp`` dir :func:`latest_pass` ignores; a crash
+    inside the re-publish swap is healed by :func:`_recover_torn_swap`.
+    ``members`` is consumed lazily — one payload in host memory at a time.
+
+    Shared by :func:`save_checkpoint` and the CLI's v2-parameters pass dump,
+    so there is exactly one implementation of the durability protocol.
+    """
+    _recover_torn_swap(output_dir)
     d = pass_dir(output_dir, pass_id)
-    os.makedirs(d, exist_ok=True)
-    with open(os.path.join(d, "params.tar"), "wb") as f:
-        to_tar(f, params)
-    if opt_state is not None:
-        with open(os.path.join(d, "opt_state.tar"), "wb") as f:
-            to_tar(f, opt_state)
-    state = {"pass_id": pass_id, "version": FORMAT_VERSION}
-    if extra:
-        state.update(extra)
-    with open(os.path.join(d, "state.json"), "w") as f:
-        json.dump(state, f)
+    tmp = d + ".tmp"
+    if os.path.exists(tmp):            # leftover from a crashed writer
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    manifest = {"version": FORMAT_VERSION, "pass_id": pass_id, "members": {}}
+    for name, payload in members:
+        manifest["members"][name] = _write_member(tmp, name, payload)
+    with open(os.path.join(tmp, COMPLETE_MANIFEST), "w") as f:
+        json.dump(manifest, f)
+        _fsync_file(f)
+    _fsync_dir(tmp)
+
+    try:
+        if os.path.exists(d):
+            # re-saving a pass (e.g. completing one previously preempted):
+            # move the old dir aside so the rename stays atomic, then drop it
+            old = d + ".old"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(d, old)
+            os.rename(tmp, d)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, d)
+    except FileNotFoundError:
+        # a concurrent discovery scan's torn-swap recovery can publish our
+        # .tmp itself; depending on the interleaving our bytes sit at the
+        # final name (scanner won the rename race) or were just moved
+        # aside as .old (scanner published BEFORE our exists-check, so we
+        # renamed our own fresh dir away). Either way the intended state
+        # exists — restore it and succeed rather than crash the save.
+        old = d + ".old"
+        if not verify_checkpoint(d):
+            if os.path.exists(old) and verify_checkpoint(old):
+                os.rename(old, d)
+            else:
+                raise
+        else:
+            shutil.rmtree(old, ignore_errors=True)
+    _fsync_dir(output_dir)
     return d
 
 
-def load_checkpoint(output_dir: str, pass_id: Optional[int] = None
-                    ) -> Tuple[Any, Optional[Any], Dict[str, Any]]:
-    """Load (params, opt_state_or_None, state). pass_id None -> latest."""
-    if pass_id is None:
-        pass_id = latest_pass(output_dir)
-        if pass_id is None:
-            raise FileNotFoundError(f"no checkpoints under {output_dir}")
-    d = pass_dir(output_dir, pass_id)
+def save_checkpoint(output_dir: str, pass_id: int, params,
+                    opt_state=None, extra: Optional[Dict[str, Any]] = None) -> str:
+    """Atomically publish ``output_dir/pass-%05d`` (protocol:
+    :func:`publish_members`)."""
+
+    def members():
+        buf = io.BytesIO()
+        to_tar(buf, params)
+        yield "params.tar", buf.getvalue()
+        if opt_state is not None:
+            buf = io.BytesIO()
+            to_tar(buf, opt_state)
+            yield "opt_state.tar", buf.getvalue()
+        state = {"pass_id": pass_id, "version": FORMAT_VERSION,
+                 "pass_complete": True}
+        if extra:
+            state.update(extra)
+        yield "state.json", json.dumps(state).encode()
+
+    return publish_members(output_dir, pass_id, members())
+
+
+def verify_checkpoint(d: str) -> bool:
+    """True iff ``d`` has a ``_COMPLETE`` manifest and every member matches
+    its recorded size and CRC32 — the resume-safety gate. Members are
+    CRC'd in fixed-size chunks: verification of a multi-GB checkpoint must
+    not spike host memory by the largest member."""
+    try:
+        with open(os.path.join(d, COMPLETE_MANIFEST)) as f:
+            manifest = json.load(f)
+        if manifest.get("version") != FORMAT_VERSION:
+            return False
+        for name, entry in manifest["members"].items():
+            crc, size = 0, 0
+            with open(os.path.join(d, name), "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    size += len(chunk)
+                    crc = zlib.crc32(chunk, crc)
+            if size != entry["size"] or (crc & 0xFFFFFFFF) != entry["crc32"]:
+                return False
+        return True
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+
+
+def _complete_passes(output_dir: str) -> List[int]:
+    """pass ids carrying a ``_COMPLETE`` manifest, ascending."""
+    if not os.path.isdir(output_dir):
+        return []
+    _recover_torn_swap(output_dir)
+    out, legacy = [], []
+    for name in os.listdir(output_dir):
+        m = re.fullmatch(r"pass-(\d{5})", name)
+        if not m:
+            continue
+        if os.path.exists(os.path.join(output_dir, name, COMPLETE_MANIFEST)):
+            out.append(int(m.group(1)))
+        elif os.path.exists(os.path.join(output_dir, name, "params.tar")):
+            legacy.append(name)
+    if legacy and not out:
+        # an upgraded job pointed at a pre-manifest output_dir: those dirs
+        # are indistinguishable from torn writes, so resume ignores them —
+        # say so LOUDLY, because the first new save of the same pass id
+        # will replace them
+        log.warning(
+            "%s holds %d pass dir(s) without a %s manifest (%s …): "
+            "written before crash-safe checkpointing or torn mid-write; "
+            "they are ignored for resume and will be replaced when their "
+            "pass id is saved again", output_dir, len(legacy),
+            COMPLETE_MANIFEST, sorted(legacy)[-1])
+    return sorted(out)
+
+
+def _load_dir(d: str) -> Tuple[Any, Optional[Any], Dict[str, Any]]:
     with open(os.path.join(d, "params.tar"), "rb") as f:
         params = from_tar(f)
     opt_state = None
@@ -131,14 +345,41 @@ def load_checkpoint(output_dir: str, pass_id: Optional[int] = None
     return params, opt_state, state
 
 
-def latest_pass(output_dir: str) -> Optional[int]:
-    """Largest pass-%05d with a complete params.tar (resume point — the
-    --start_pass discovery, ParamUtil.h:108-111)."""
-    if not os.path.isdir(output_dir):
-        return None
-    best = None
-    for name in os.listdir(output_dir):
-        m = re.fullmatch(r"pass-(\d{5})", name)
-        if m and os.path.exists(os.path.join(output_dir, name, "params.tar")):
-            best = max(best if best is not None else -1, int(m.group(1)))
-    return best
+def load_checkpoint(output_dir: str, pass_id: Optional[int] = None
+                    ) -> Tuple[Any, Optional[Any], Dict[str, Any]]:
+    """Load (params, opt_state_or_None, state). pass_id None -> the newest
+    pass that passes full manifest verification: an unverifiable latest pass
+    (torn write that survived the crash window, later bit rot) is skipped
+    with a warning and the previous good pass is used instead. An explicit
+    pass_id is gated by the same verification — it names a pass, not an
+    escape hatch around the safety contract."""
+    if pass_id is not None:
+        d = pass_dir(output_dir, pass_id)
+        if not verify_checkpoint(d):
+            raise ValueError(
+                f"checkpoint {d} fails manifest/CRC verification")
+        return _load_dir(d)
+    candidates = _complete_passes(output_dir)
+    for pid in reversed(candidates):
+        d = pass_dir(output_dir, pid)
+        if not verify_checkpoint(d):
+            log.warning("checkpoint %s fails CRC/manifest verification; "
+                        "falling back to the previous pass", d)
+            continue
+        try:
+            return _load_dir(d)
+        except (OSError, ValueError) as e:
+            log.warning("checkpoint %s unreadable (%s); falling back", d, e)
+    raise FileNotFoundError(f"no verifiable checkpoints under {output_dir}")
+
+
+def latest_pass(output_dir: str, *, verify: bool = False) -> Optional[int]:
+    """Largest pass-%05d carrying a ``_COMPLETE`` manifest (resume point —
+    the --start_pass discovery, ParamUtil.h:108-111). Mere existence of
+    ``params.tar`` is NOT enough: a dir without the manifest is a torn
+    write. ``verify=True`` additionally demands CRC validation."""
+    candidates = _complete_passes(output_dir)
+    for pid in reversed(candidates):
+        if not verify or verify_checkpoint(pass_dir(output_dir, pid)):
+            return pid
+    return None

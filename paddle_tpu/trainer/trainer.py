@@ -17,6 +17,9 @@ host-side prep overlaps via DoubleBuffer.
 
 from __future__ import annotations
 
+import itertools
+import signal
+import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
@@ -24,15 +27,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults
 from ..data.prefetch import DoubleBuffer
 from ..parallel.data_parallel import DataParallel
 from ..utils.logging import get_logger
 from ..utils.stats import StatSet
 from . import event as EV
-from .checkpoint import latest_pass, load_checkpoint, save_checkpoint
+from .checkpoint import load_checkpoint, save_checkpoint
 from .evaluator import EvaluatorGroup
 
 log = get_logger(__name__)
+
+_NONFINITE_POLICIES = ("raise", "skip", "halt", "off")
 
 
 class Trainer:
@@ -50,6 +56,16 @@ class Trainer:
         than a separate post-update pass.
       evaluators: EvaluatorGroup or list of Evaluators.
       output_dir: if set, save pass-%05d checkpoints (ParamUtil semantics).
+      nan_guard: legacy on/off switch for the non-finite-loss check.
+      on_nonfinite: what a non-finite loss does — "raise" (fail fast, the
+        feenableexcept analog), "skip" (drop the batch's update, count it,
+        warn), "halt" (drop the update, checkpoint the last finite state,
+        then raise), or "off". Defaults to "raise" when nan_guard else
+        "off".
+      prefetch_timeout: watchdog on the prefetch DoubleBuffer — if no batch
+        arrives within this many seconds, raise TimeoutError instead of
+        hanging the pod (a stalled data source on a TPU slice otherwise
+        wedges every chip behind the collective).
     """
 
     def __init__(self, loss_fn: Callable, optimizer, *, mesh=None,
@@ -57,7 +73,9 @@ class Trainer:
                  evaluators=None, output_dir: Optional[str] = None,
                  prefetch: int = 2, log_period: int = 0,
                  param_stats_period: int = 0,
-                 nan_guard: bool = True):
+                 nan_guard: bool = True,
+                 on_nonfinite: Optional[str] = None,
+                 prefetch_timeout: Optional[float] = None):
         self.loss_fn = loss_fn
         self.opt = optimizer
         self.outputs_fn = jax.jit(outputs_fn) if outputs_fn is not None else None
@@ -76,12 +94,32 @@ class Trainer:
             from ..utils.flags import FLAGS
             param_stats_period = FLAGS.show_parameter_stats_period
         self.param_stats_period = param_stats_period
-        self.nan_guard = nan_guard
+        if on_nonfinite is None:
+            on_nonfinite = "raise" if nan_guard else "off"
+        if on_nonfinite not in _NONFINITE_POLICIES:
+            raise ValueError(f"on_nonfinite must be one of "
+                             f"{_NONFINITE_POLICIES}, got {on_nonfinite!r}")
+        self.on_nonfinite = on_nonfinite
+        self.nan_guard = on_nonfinite != "off"
+        self.prefetch_timeout = prefetch_timeout
         self.stats = StatSet()
+        #: robustness counters surfaced alongside timer stats
+        self.train_stats: Dict[str, int] = {"nonfinite_batches": 0,
+                                            "skipped_batches": 0,
+                                            "preemptions": 0}
+        self._preempt = threading.Event()
+        self.preempted = False
+        # skip AND halt both need the update dropped on a non-finite loss:
+        # skip to continue from the last finite state, halt to checkpoint it
+        # (checkpointing the NaN-poisoned trees would make resume start from
+        # garbage — worse than no checkpoint at all)
+        guard_mode = on_nonfinite in ("skip", "halt")
         self.mesh = mesh
         if mesh is not None:
+            # the revert needs the pre-update trees alive after the step,
+            # so buffer donation is off on that path
             self._dp = DataParallel(loss_fn, optimizer, mesh=mesh,
-                                    aux_fn=outputs_fn)
+                                    aux_fn=outputs_fn, donate=not guard_mode)
             self._step = None
         else:
             self._dp = None
@@ -91,10 +129,20 @@ class Trainer:
                 # eval outputs computed inside the SAME jitted step (XLA
                 # shares the forward) — no second per-batch forward dispatch
                 outs = outputs_fn(params, *batch) if outputs_fn else None
-                params, opt_state = optimizer.update(grads, opt_state, params)
+                new_params, new_opt = optimizer.update(grads, opt_state,
+                                                       params)
+                if guard_mode:
+                    # drop-the-batch INSIDE the jitted step: select the
+                    # pre-update trees when the loss is non-finite — donation
+                    # stays legal because the select reads both operands
+                    ok = jnp.isfinite(loss)
+                    new_params = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(ok, n, o), new_params, params)
+                    new_opt = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(ok, n, o), new_opt, opt_state)
                 if outputs_fn is not None:
-                    return params, opt_state, loss, outs
-                return params, opt_state, loss
+                    return new_params, new_opt, loss, outs
+                return new_params, new_opt, loss
 
             self._step = jax.jit(_step, donate_argnums=(0, 1))
         self._loss_jit = jax.jit(loss_fn)
@@ -111,23 +159,123 @@ class Trainer:
                      name, str(tuple(a.shape)), float(a.max(initial=0.0)),
                      float(a.mean()) if a.size else 0.0)
 
+    # -- preemption --------------------------------------------------------
+    def request_preemption(self):
+        """Ask the train loop to checkpoint and exit after the current batch
+        — what the SIGTERM/SIGINT handlers call; safe from any thread."""
+        self._preempt.set()
+
+    def _install_preemption_handlers(self):
+        """SIGTERM/SIGINT -> checkpoint-then-exit. On a TPU pod preemption
+        is the COMMON case (maintenance events deliver SIGTERM), not the
+        exception. A SECOND SIGINT raises KeyboardInterrupt — a batch hung
+        inside a wedged step/collective never reaches the between-batch
+        preemption check, and Ctrl-C must still offer an escape. Returns
+        the previous handlers for restoration; no-op off the main thread
+        (signal.signal would raise)."""
+
+        def handler(signum, frame):
+            if signum == signal.SIGINT and self._preempt.is_set():
+                raise KeyboardInterrupt
+            self.request_preemption()
+
+        prev = {}
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev[sig] = signal.signal(sig, handler)
+        except ValueError:
+            pass
+        return prev
+
+    def _checkpoint_preempted(self, pass_id, batch_id, params, opt_state):
+        if self.output_dir:
+            save_checkpoint(self.output_dir, pass_id, params, opt_state,
+                            extra={"pass_complete": False,
+                                   "batch_id": batch_id})
+            log.warning("preempted at pass %d batch %d: checkpoint saved; "
+                        "resume re-runs this pass", pass_id, batch_id)
+        else:
+            log.warning("preempted at pass %d batch %d with no output_dir: "
+                        "nothing durable to save", pass_id, batch_id)
+        self.train_stats["preemptions"] += 1
+        self.preempted = True
+
+    def _handle_nonfinite(self, cost_f, pass_id, batch_id, params, opt_state):
+        self.train_stats["nonfinite_batches"] += 1
+        if self.on_nonfinite == "skip":
+            # the jitted step (or the host-side revert on the mesh path)
+            # already dropped the update; account for it and move on
+            self.train_stats["skipped_batches"] += 1
+            log.warning("non-finite loss %s at pass %d batch %d: batch "
+                        "skipped (%d skipped so far)", cost_f, pass_id,
+                        batch_id, self.train_stats["skipped_batches"])
+            return
+        if self.on_nonfinite == "halt" and self.output_dir:
+            # durable state first, then fail: params/opt_state were reverted
+            # to the pre-update (last finite) trees, so the operator restarts
+            # from the last finite step instead of losing the pass
+            save_checkpoint(self.output_dir, pass_id, params, opt_state,
+                            extra={"pass_complete": False,
+                                   "batch_id": batch_id, "halted": True})
+            log.error("non-finite loss at pass %d batch %d: state "
+                      "checkpointed before halting", pass_id, batch_id)
+        # the feenableexcept(FE_INVALID|DIVBYZERO|OVERFLOW) analog
+        # (TrainerMain.cpp:49): fail fast, don't train on garbage
+        raise FloatingPointError(
+            f"non-finite loss {cost_f} at pass {pass_id} batch "
+            f"{batch_id}; re-run with "
+            f"jax.config.update('jax_debug_nans', True) to locate "
+            f"the producing op")
+
     def train(self, reader: Callable[[], Iterable], params, *,
               num_passes: int = 1, event_handler: Optional[Callable] = None,
               feeder: Optional[Callable] = None,
               test_reader: Optional[Callable] = None,
-              resume: bool = False):
+              resume: bool = False, checkpoint_every: int = 1,
+              handle_signals: bool = True):
         """Run the pass/batch loop; returns (params, opt_state).
 
         reader yields raw row-batches; ``feeder`` converts one row-batch to the
         loss_fn's *batch arrays (identity if None).
+
+        ``resume=True`` restarts from the newest verifiable checkpoint. A
+        pass checkpointed as incomplete (preemption/halt) resumes at its
+        next batch: the checkpoint holds post-batch state, so the first
+        ``batch_id + 1`` reader batches are skipped rather than re-applied —
+        with a deterministic reader the continuation is byte-identical to an
+        uninterrupted run. ``checkpoint_every=N`` saves every Nth pass (the
+        final pass always saves); preemption checkpoints ignore the cadence.
+        ``handle_signals`` installs SIGTERM/SIGINT checkpoint-then-exit
+        handlers for the duration of the call (main thread only).
         """
         event_handler = event_handler or (lambda e: None)
+        if checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
         start_pass = 0
+        skip_batches = 0
         opt_state = None
-        if resume and self.output_dir and latest_pass(self.output_dir) is not None:
-            params, opt_state, st = load_checkpoint(self.output_dir)
-            start_pass = st["pass_id"] + 1
-            log.info("resumed from pass %d", st["pass_id"])
+        self.preempted = False
+        self._preempt.clear()
+        if resume and self.output_dir:
+            # one load_checkpoint call does discovery + verification + read
+            # in a single pass over the members; a dir with no verifiable
+            # checkpoint falls through to fresh init
+            try:
+                params, opt_state, st = load_checkpoint(self.output_dir)
+            except FileNotFoundError:
+                st = None
+                log.info("resume requested but no verifiable checkpoint "
+                         "under %s; starting fresh", self.output_dir)
+            if st is not None and st.get("pass_complete", True):
+                start_pass = st["pass_id"] + 1
+                log.info("resumed from completed pass %d", st["pass_id"])
+            elif st is not None:
+                # the preemption checkpoint holds state AFTER batch_id, so
+                # the interrupted pass continues at batch_id + 1
+                start_pass = st["pass_id"]
+                skip_batches = st.get("batch_id", -1) + 1
+                log.info("resumed preempted pass %d at batch %d",
+                         st["pass_id"], skip_batches)
         if opt_state is None:
             if self._dp is not None:
                 params, opt_state = self._dp.init(params)
@@ -136,59 +284,97 @@ class Trainer:
         elif self._dp is not None:
             params, opt_state = self._dp.init(params, opt_state)
 
-        for pass_id in range(start_pass, start_pass + num_passes):
-            event_handler(EV.BeginPass(pass_id))
-            self.evaluators.start()
-            batches = self._batches(reader, feeder)
-            for batch_id, batch in enumerate(batches):
-                event_handler(EV.BeginIteration(pass_id, batch_id))
-                with self.stats.timer("TrainBatch"):
-                    if self._dp is not None:
-                        batch = self._dp.shard_batch(batch)
-                        res = self._dp.step(params, opt_state, *batch)
+        prev_handlers = (self._install_preemption_handlers()
+                         if handle_signals else {})
+        try:
+            last_pass = start_pass + num_passes - 1
+            for pass_id in range(start_pass, start_pass + num_passes):
+                event_handler(EV.BeginPass(pass_id))
+                self.evaluators.start()
+                first_batch = skip_batches if pass_id == start_pass else 0
+                batches = self._batches(reader, feeder, skip=first_batch)
+                for batch_id, batch in enumerate(batches, start=first_batch):
+                    event_handler(EV.BeginIteration(pass_id, batch_id))
+                    if (self.on_nonfinite in ("skip", "halt")
+                            and self._dp is not None):
+                        # mesh path: revert host-side (donation disabled)
+                        prev_params, prev_opt = params, opt_state
+                    with self.stats.timer("TrainBatch"):
+                        if self._dp is not None:
+                            batch = self._dp.shard_batch(batch)
+                            res = self._dp.step(params, opt_state, *batch)
+                        else:
+                            res = self._step(params, opt_state, *batch)
+                    if self.outputs_fn is not None:
+                        params, opt_state, cost, outs = res
                     else:
-                        res = self._step(params, opt_state, *batch)
-                if self.outputs_fn is not None:
-                    params, opt_state, cost, outs = res
-                else:
-                    params, opt_state, cost = res
-                    outs = None
-                cost_f = float(cost)
-                if self.nan_guard and not np.isfinite(cost_f):
-                    # the feenableexcept(FE_INVALID|DIVBYZERO|OVERFLOW) analog
-                    # (TrainerMain.cpp:49): fail fast, don't train on garbage
-                    raise FloatingPointError(
-                        f"non-finite loss {cost_f} at pass {pass_id} batch "
-                        f"{batch_id}; re-run with "
-                        f"jax.config.update('jax_debug_nans', True) to locate "
-                        f"the producing op")
-                ev_result = None
-                if outs is not None:
-                    with self.stats.timer("Eval"):
-                        self.evaluators.update(cost=cost_f, **outs)
-                        ev_result = self.evaluators.result()
-                if self.log_period and (batch_id + 1) % self.log_period == 0:
-                    log.info("pass %d batch %d cost %.6f", pass_id, batch_id, cost_f)
-                if (self.param_stats_period and
-                        (batch_id + 1) % self.param_stats_period == 0):
-                    self._log_param_stats(params)
-                event_handler(EV.EndIteration(pass_id, batch_id, cost_f,
-                                              ev_result))
-            pass_result = (self.evaluators.result()
-                           if self.outputs_fn is not None else None)
-            if test_reader is not None:
-                tr = self.test(test_reader, params, feeder=feeder)
-                event_handler(EV.TestResult(pass_id, tr["cost"],
-                                            tr.get("evaluator_result")))
-            if self.output_dir:
-                save_checkpoint(self.output_dir, pass_id, params, opt_state)
-            event_handler(EV.EndPass(pass_id, pass_result))
+                        params, opt_state, cost = res
+                        outs = None
+                    cost_f = faults.filter_value("step.grad", float(cost))
+                    if self.nan_guard and not np.isfinite(cost_f):
+                        if (self.on_nonfinite in ("skip", "halt")
+                                and self._dp is not None):
+                            params, opt_state = prev_params, prev_opt
+                        self._handle_nonfinite(cost_f, pass_id, batch_id,
+                                               params, opt_state)
+                        event_handler(EV.EndIteration(pass_id, batch_id,
+                                                      cost_f, None))
+                        if self._preempt.is_set():
+                            self._checkpoint_preempted(pass_id, batch_id,
+                                                       params, opt_state)
+                            return params, opt_state
+                        continue
+                    ev_result = None
+                    if outs is not None:
+                        with self.stats.timer("Eval"):
+                            self.evaluators.update(cost=cost_f, **outs)
+                            ev_result = self.evaluators.result()
+                    if self.log_period and (batch_id + 1) % self.log_period == 0:
+                        log.info("pass %d batch %d cost %.6f", pass_id,
+                                 batch_id, cost_f)
+                    if (self.param_stats_period and
+                            (batch_id + 1) % self.param_stats_period == 0):
+                        self._log_param_stats(params)
+                    event_handler(EV.EndIteration(pass_id, batch_id, cost_f,
+                                                  ev_result))
+                    if self._preempt.is_set():
+                        self._checkpoint_preempted(pass_id, batch_id,
+                                                   params, opt_state)
+                        return params, opt_state
+                pass_result = (self.evaluators.result()
+                               if self.outputs_fn is not None else None)
+                if test_reader is not None:
+                    tr = self.test(test_reader, params, feeder=feeder)
+                    event_handler(EV.TestResult(pass_id, tr["cost"],
+                                                tr.get("evaluator_result")))
+                if self.output_dir and (
+                        (pass_id - start_pass + 1) % checkpoint_every == 0
+                        or pass_id == last_pass):
+                    save_checkpoint(self.output_dir, pass_id, params,
+                                    opt_state)
+                event_handler(EV.EndPass(pass_id, pass_result))
+        finally:
+            for sig, handler in prev_handlers.items():
+                try:
+                    signal.signal(sig, handler)
+                except (ValueError, TypeError):
+                    pass
         return params, opt_state
 
-    def _batches(self, reader, feeder):
-        if feeder is None:
+    def _batches(self, reader, feeder, skip: int = 0):
+        if skip:
+            # resume: slice the RAW reader, before the feeder transform —
+            # re-running host-side conversion on thousands of about-to-be-
+            # discarded batches would delay the restart by their full cost
+            raw, reader = reader, (lambda: itertools.islice(raw(), skip,
+                                                            None))
+        if feeder is None and self.prefetch_timeout is None:
             return iter(reader())
-        return iter(DoubleBuffer(reader, depth=self.prefetch, transform=feeder))
+        # a feeder wants the prefetch thread for overlap; a prefetch_timeout
+        # needs it too — the watchdog only works with a producer thread to
+        # watch, so the timeout must not be silently ignored without one
+        return iter(DoubleBuffer(reader, depth=self.prefetch, transform=feeder,
+                                 timeout=self.prefetch_timeout))
 
     # ------------------------------------------------------------------- test
     def test(self, reader, params, *, feeder=None) -> Dict[str, Any]:

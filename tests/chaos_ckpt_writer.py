@@ -1,0 +1,50 @@
+"""Subprocess victim for the kill-9-mid-checkpoint chaos test.
+
+Writes a complete pass-0 checkpoint, then starts the pass-1 checkpoint with
+a fault rule whose exception *factory* touches a sentinel file and stalls —
+the parent waits for the sentinel and delivers SIGKILL while the pass-1
+``.tmp`` directory holds partially written members and no ``_COMPLETE``
+manifest: a real torn-write crash window, not a simulation of one.
+
+Usage: python tests/chaos_ckpt_writer.py OUTPUT_DIR SENTINEL_PATH
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+# ``python tests/chaos_ckpt_writer.py`` puts tests/ on sys.path, not the
+# repo root — add it so ``paddle_tpu`` imports without an installed package.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu import faults
+from paddle_tpu.trainer.checkpoint import save_checkpoint
+
+PARAMS = {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+          "b": np.ones(8, dtype=np.float32)}
+
+
+def main():
+    out, sentinel = sys.argv[1], sys.argv[2]
+    save_checkpoint(out, 0, PARAMS)
+
+    def stall_then_die():
+        # signal the parent we are inside the pass-1 write, then hang until
+        # it SIGKILLs us (the timeout is only a safety net)
+        with open(sentinel, "w"):
+            pass
+        time.sleep(60)
+        return RuntimeError("parent never killed us")
+
+    plan = faults.FaultPlan()
+    # nth=2: params.tar is fully written, state.json + _COMPLETE are not —
+    # the nastiest torn state (a plausible-looking tar with no manifest)
+    plan.add("ckpt.write", "raise", nth=2, exc=stall_then_die)
+    with plan.installed():
+        save_checkpoint(out, 1, PARAMS)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests "
+        "(tests/test_faults.py); tier-1, no real sleeps, <60s total")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)
