@@ -11,12 +11,12 @@ over ICI/DCN (replacing the reference's pserver/RDMA/NCCL paths), and the host r
 
 __version__ = "0.1.0"
 
-from . import (analysis, core, data, faults, fluid, models, nn, ops,
+from . import (analysis, core, data, faults, fluid, models, nn, obs, ops,
                optimizer, parallel, trainer, utils, v2)
 from .core import CPUPlace, Place, SeqBatch, TPUPlace, sequence_mask
 from .trainer import Trainer
 
-__all__ = ["analysis", "core", "data", "faults", "fluid", "nn", "ops",
+__all__ = ["analysis", "core", "data", "faults", "fluid", "nn", "obs", "ops",
            "optimizer",
            "parallel", "trainer", "utils", "models", "v2", "Trainer",
            "Place", "TPUPlace", "CPUPlace", "SeqBatch", "sequence_mask",

@@ -26,7 +26,7 @@ import numpy as np
 
 from .diagnostics import (Diagnostic, ProgramVerificationError, Severity,
                           errors, format_diagnostics, max_severity, op_site)
-from .lints import LINT_CATALOGUE, lint_program
+from .lints import LINT_CATALOGUE, lint_metric_names, lint_program
 from .shape_infer import (UNKNOWN, ShapeInferRegistry, infer_program_shapes,
                           register_shape_infer)
 from .verify import verify_program
@@ -35,7 +35,8 @@ __all__ = [
     "Diagnostic", "Severity", "ProgramVerificationError",
     "errors", "format_diagnostics", "max_severity", "op_site",
     "verify_program", "infer_program_shapes", "register_shape_infer",
-    "ShapeInferRegistry", "UNKNOWN", "lint_program", "LINT_CATALOGUE",
+    "ShapeInferRegistry", "UNKNOWN", "lint_program", "lint_metric_names",
+    "LINT_CATALOGUE",
     "analyze_program", "check_or_raise",
 ]
 

@@ -23,6 +23,13 @@ Lints are advisory by default (WARNING/INFO); the CLI's ``--fail-on`` and
   default ``parallel.mesh.CANONICAL_ORDER`` (``make_mesh`` accepts custom
   axis names, so an unknown name may be a real custom axis).  A malformed
   spec (non-string entries, a non-sequence) is reported, never raised on.
+- **L005 metric-naming** (warning): an observability metric name that
+  breaks the public naming contract (docs/design/observability.md):
+  shape ``subsystem.noun_qualifier`` (one dot, snake_case), counters end
+  ``_total``, histograms end ``_seconds``/``_bytes``/``_total``, gauges
+  claim no reserved suffix.  Runs over :data:`paddle_tpu.obs.CATALOGUE`
+  in the ``paddle_tpu lint`` CLI (:func:`lint_metric_names`) — metric
+  names are API surface; a drive-by rename breaks dashboards silently.
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ LINT_CATALOGUE = {
     "L002": ("unused-variable", Severity.INFO),
     "L003": ("trace-safety", Severity.WARNING),
     "L004": ("sharding-consistency", Severity.ERROR),
+    "L005": ("metric-naming", Severity.WARNING),
 }
 
 # control-flow / executor-lowered ops act through sub-blocks, not outputs
@@ -166,6 +174,61 @@ def _lint_trace_safety(program, emit):
                          "(shape/data changes will not recompile)",
                          block_idx=block.idx, op_idx=idx, op_type=op.type,
                          hint="feed arrays through op inputs instead")
+
+
+#: kind -> allowed name suffixes (None entry = no suffix requirement)
+_METRIC_SUFFIXES = {
+    "counter": ("_total",),
+    "histogram": ("_seconds", "_bytes", "_total"),
+}
+_RESERVED_SUFFIXES = ("_total", "_seconds", "_bytes", "_bucket", "_sum",
+                      "_count")
+
+
+def lint_metric_names(catalogue,
+                      severity: Severity = None) -> List[Diagnostic]:
+    """L005: validate metric names against the ``subsystem.noun_qualifier``
+    contract (paddle_tpu.obs.metrics.METRIC_NAME_RE) plus the suffix-per-
+    kind conventions.
+
+    ``catalogue`` is a mapping ``name -> (kind, help)`` (the shape of
+    :data:`paddle_tpu.obs.CATALOGUE`), ``name -> kind``, or a plain
+    iterable of names (then only the shape is checked). Standalone on
+    purpose: metric names live in instrumented *code*, not Program IR, so
+    this lint is driven by the CLI and the obs test-suite rather than
+    ``lint_program``.
+    """
+    from ..obs.metrics import METRIC_NAME_RE   # lazy: keeps analysis light
+    sev = severity if severity is not None else LINT_CATALOGUE["L005"][1]
+    diags: List[Diagnostic] = []
+
+    def emit(msg: str, name: str, hint: str):
+        diags.append(Diagnostic("L005", sev, msg, var=name, hint=hint))
+
+    if isinstance(catalogue, dict):
+        items = []
+        for name, spec in catalogue.items():
+            kind = spec[0] if isinstance(spec, (tuple, list)) else spec
+            items.append((name, kind))
+    else:
+        items = [(name, None) for name in catalogue]
+    for name, kind in items:
+        if not METRIC_NAME_RE.match(name):
+            emit(f"metric name '{name}' is not subsystem.noun_qualifier "
+                 "(exactly one dot, snake_case atoms)", name,
+                 "rename to e.g. 'trainer.steps_total'")
+            continue
+        if kind in _METRIC_SUFFIXES:
+            if not name.endswith(_METRIC_SUFFIXES[kind]):
+                emit(f"{kind} '{name}' must end with one of "
+                     f"{'/'.join(_METRIC_SUFFIXES[kind])}", name,
+                     "counters count (suffix _total); histograms measure "
+                     "(suffix _seconds/_bytes)")
+        elif kind == "gauge" and name.endswith(_RESERVED_SUFFIXES):
+            emit(f"gauge '{name}' claims a suffix reserved for "
+                 "counters/histograms", name,
+                 "drop the suffix — a gauge is a point-in-time value")
+    return diags
 
 
 def _lint_sharding(program, mesh_axes, emit):

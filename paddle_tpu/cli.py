@@ -78,6 +78,10 @@ def cmd_train(args):
 
     train_reader = cfg["train_reader"]
     srv = None
+    obs_session = None
+    if getattr(args, "obs_out", None):
+        from . import obs as _obs
+        obs_session = _obs.ObsSession().install()
     if getattr(args, "local_master", False):
         # One-binary bring-up (TrainerMain.cpp:32-49 --start_pserver analog):
         # self-host the ENTIRE data-dispatch cluster in this process — the
@@ -106,6 +110,20 @@ def cmd_train(args):
         trainer.train(train_reader, num_passes=args.num_passes,
                       event_handler=handler, feeding=cfg.get("feeding"))
     finally:
+        # dump FIRST: a failed run is exactly the one whose telemetry the
+        # user asked for, and a server-teardown error must not discard it
+        if obs_session is not None:
+            obs_session.uninstall()
+            try:
+                obs_session.save(args.obs_out)
+            except Exception as e:
+                # telemetry loss must not mask the training outcome
+                print(f"warning: could not write obs dump {args.obs_out}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+            else:
+                print(f"observability dump written to {args.obs_out} "
+                      f"(inspect: paddle_tpu obs summary --input "
+                      f"{args.obs_out})")
         if srv is not None:
             srv.stop()
     if args.save_dir and "outputs" in cfg:
@@ -200,6 +218,12 @@ def cmd_lint(args):
         for d in diags:
             d.program = label
         all_diags.extend(diags)
+    # L005: the obs metric catalogue is part of the lint surface — a PR
+    # adding an off-contract metric name fails here, not on a dashboard
+    from . import obs as _obs
+    for d in analysis.lint_metric_names(_obs.CATALOGUE):
+        d.program = "obs"
+        all_diags.append(d)
     n_err = len(analysis.errors(all_diags))
     n_warn = sum(1 for d in all_diags
                  if d.severity == analysis.Severity.WARNING)
@@ -589,6 +613,50 @@ def cmd_make_diagram(args):
     return 0
 
 
+def cmd_obs(args):
+    """``paddle_tpu obs`` — inspect/convert an observability dump (the
+    JSONL written by ``ObsSession.save`` / ``train --obs_out``):
+
+    * ``summary``: the human table (counters, gauges, histograms with
+      p50/p99, span totals) — the ``StatSet.report()`` successor.
+    * ``export --format=chrome``: Chrome ``trace_event`` JSON; load the
+      file in Perfetto (ui.perfetto.dev) or chrome://tracing to see the
+      nested trainer -> checkpoint/rpc span timeline.
+    * ``export --format=prom``: Prometheus text exposition — serve it or
+      drop it where a textfile collector scrapes.
+    * ``export --format=jsonl``: normalized event stream (re-emits the
+      dump; useful to strip a corrupt tail).
+    """
+    from . import obs
+    try:
+        dump = obs.read_jsonl(args.input)
+    except (OSError, ValueError) as e:
+        print(f"obs: cannot read dump {args.input!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if args.obs_cmd == "summary":
+        print(obs.summary(dump))
+        return 0
+    if args.format == "chrome":
+        out = json.dumps(obs.chrome_trace(dump), indent=1)
+    elif args.format == "prom":
+        out = obs.prometheus_text(dump)
+    else:                                  # jsonl: normalized re-emit
+        if args.output:
+            obs.write_jsonl(args.output, dump)
+            print(f"wrote {args.output}")
+            return 0
+        from .obs.export import jsonl_lines
+        out = "\n".join(jsonl_lines(dump)) + "\n"
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+        print(f"wrote {args.output}")
+    else:
+        print(out, end="" if out.endswith("\n") else "\n")
+    return 0
+
+
 def cmd_version(args):
     from . import __version__
     import jax
@@ -616,6 +684,10 @@ def main(argv=None) -> int:
                         "plane, train as its first consumer")
     t.add_argument("--samples_per_chunk", type=int, default=64,
                    help="reader items per dispatched chunk (--local_master)")
+    t.add_argument("--obs_out", default=None,
+                   help="install an observability session for the run and "
+                        "write its JSONL dump here (inspect with "
+                        "'paddle_tpu obs summary/export')")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test")
@@ -705,6 +777,25 @@ def main(argv=None) -> int:
                     help="print the rendered per-host commands and exit "
                          "(for inspection or external schedulers)")
     ct.set_defaults(fn=cmd_cluster_train)
+
+    ob = sub.add_parser("obs", help="inspect/convert an observability dump "
+                                    "(JSONL from ObsSession.save / "
+                                    "train --obs_out)")
+    obsub = ob.add_subparsers(dest="obs_cmd", required=True)
+    os_ = obsub.add_parser("summary", help="human metric/span table "
+                                           "(subsumes StatSet.report)")
+    os_.add_argument("--input", required=True,
+                     help="JSONL dump to summarize")
+    os_.set_defaults(fn=cmd_obs)
+    oe = obsub.add_parser("export", help="convert the dump for other tools")
+    oe.add_argument("--input", required=True, help="JSONL dump to convert")
+    oe.add_argument("--format", choices=["chrome", "prom", "jsonl"],
+                    default="chrome",
+                    help="chrome: trace_event JSON for Perfetto; prom: "
+                         "Prometheus text; jsonl: normalized stream")
+    oe.add_argument("--output", default=None,
+                    help="output path (default: stdout)")
+    oe.set_defaults(fn=cmd_obs)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=cmd_version)

@@ -14,7 +14,7 @@ import os
 import pickle
 from typing import Any, Callable, Iterable, List, Optional
 
-from .. import faults
+from .. import faults, obs
 from ..utils.retry import RetryBudgetExceeded, RetryPolicy
 from .reader import Reader
 
@@ -100,6 +100,9 @@ def cloud_reader(master_client, *, pass_end_sentinel: bool = False,
                 max_delay=max(poll_interval * 10, poll_interval),
                 deadline=max_idle_polls * poll_interval,
                 jitter=0.1, retryable=_Starved)
+        if policy.observer is None:
+            # idle-poll telemetry: data.retries_total / giveups / backoff
+            policy.observer = obs.retry_observer("data")
 
         def poll_once():
             task = master_client.get_task()
@@ -122,6 +125,7 @@ def cloud_reader(master_client, *, pass_end_sentinel: bool = False,
                     master_client.new_pass()
                 return
             task_id, path = task
+            obs.count("data.tasks_total")
             try:
                 faults.fire("reader.next")      # chaos: per-task failure
                 yield from chunk_reader([path])()
@@ -129,6 +133,7 @@ def cloud_reader(master_client, *, pass_end_sentinel: bool = False,
                 # the elastic contract (go/master re-dispatch): report the
                 # task failed and let the master hand it to a healthy
                 # consumer (or discard after failure_max strikes)
+                obs.count("data.task_failures_total")
                 master_client.task_failed(task_id)
                 continue
             master_client.task_finished(task_id)

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from .. import obs
+
 
 class DoubleBuffer:
     """Wrap a batch iterable; a worker thread keeps ``depth`` batches ready.
@@ -33,6 +35,10 @@ class DoubleBuffer:
 
     def __iter__(self) -> Iterator[Any]:
         from .reader import buffered, map_readers
+        # queue health (data.queue_depth / data.starved_total) is reported
+        # by the underlying buffered() consumer loop — one implementation
+        # for both the per-reader decorator and this trainer-facing wrapper
+        obs.count("data.prefetch_iters_total")
         creator = self.batches
         if self.transform is not None:
             # transform runs on the worker thread, overlapping host conversion

@@ -13,6 +13,8 @@ import random as _random
 import threading
 from typing import Any, Callable, Iterable, List, Sequence
 
+from .. import obs
+
 Reader = Callable[[], Iterable[Any]]
 
 
@@ -101,10 +103,25 @@ def buffered(reader_creator: Reader, size: int,
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
+        warmed = False
         while True:
+            if obs.is_active() and warmed:
+                # consumer-side queue health: depth at consume (peak rides
+                # the gauge's high-water) and how often the producer was
+                # behind — the starvation signal that says "the input
+                # pipeline, not the device, is the bottleneck". The first
+                # get is skipped: the worker thread just started, so an
+                # empty queue there is startup, not starvation (counting
+                # it would report ~1 phantom starve per stream).
+                depth = q.qsize()
+                obs.gauge_set("data.queue_depth", depth)
+                if depth == 0:
+                    obs.count("data.starved_total")
+            warmed = True
             try:
                 s = q.get(timeout=timeout)
             except queue.Empty:
+                obs.count("data.timeouts_total")
                 raise TimeoutError(
                     f"prefetch watchdog: no batch within {timeout}s "
                     "(data source wedged?)") from None

@@ -56,6 +56,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .. import obs
+
 SITES = ("ckpt.write", "rpc.send", "rpc.recv", "lease.renew",
          "reader.next", "step.grad")
 
@@ -167,7 +169,12 @@ class FaultPlan:
             due = [f for f in self.faults if f.site == site and f.matches(n)]
             for f in due:
                 self.fired.append((site, n, f.action))
-            return n, due
+        # outside the plan lock (obs has its own): per-site injected-fault
+        # counters make a chaos run self-describing — the exported metrics
+        # say exactly which failures the run was subjected to
+        for f in due:
+            obs.count("faults.injected_total", site=site, action=f.action)
+        return n, due
 
     def fire(self, site: str):
         """Side-effect-only hook: raise or delay. Truncation/corruption of

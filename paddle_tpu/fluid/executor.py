@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from .framework import Block, Program, Variable
 from .registry import OpRegistry
 
@@ -342,6 +343,10 @@ class Executor:
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None,
             use_cache: bool = True, verify: bool = False) -> List[np.ndarray]:
+        with obs.span("fluid.run", metric="fluid.run_seconds"):
+            return self._run(program, feed, fetch_list, use_cache, verify)
+
+    def _run(self, program, feed, fetch_list, use_cache, verify):
         from .framework import default_main_program
         program = program or default_main_program()
         feed = {k: jnp.asarray(v) for k, v in (feed or {}).items()}
@@ -362,7 +367,9 @@ class Executor:
                     tuple((k, v.shape, str(v.dtype))
                           for k, v in sorted(feed.items())))
             if vkey not in self._verified:
-                analysis.check_or_raise(program, feed=feed, fetch=fetch_names)
+                with obs.span("fluid.verify", metric="fluid.verify_seconds"):
+                    analysis.check_or_raise(program, feed=feed,
+                                            fetch=fetch_names)
                 self._verified.add(vkey)
 
         # vars the block reads from the scope (persistables created earlier)
@@ -390,11 +397,16 @@ class Executor:
                tuple(persist_in),
                tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed.items())))
         fn = self._cache.get(key) if use_cache else None
+        obs.count("fluid.runs_total")
         if fn is None:
+            # a miss pays the trace (+ XLA compile on first call)
+            obs.count("fluid.cache_misses_total")
             fn = self._build(program, block, list(feed), persist_in,
                              fetch_names, written)
             if use_cache:
                 self._cache[key] = fn
+        else:
+            obs.count("fluid.cache_hits_total")
         persist_vals = [self.scope.get(n) for n in persist_in]
         fetches, new_persist = fn(feed, persist_vals)
         for n, v in zip(written, new_persist):

@@ -1,0 +1,146 @@
+"""paddle_tpu.obs — the unified observability plane.
+
+PR 2 built the chaos plane (:mod:`paddle_tpu.faults`); this is its twin:
+typed metrics (:class:`Counter`/:class:`Gauge`/:class:`Histogram` behind a
+:class:`MetricsRegistry`), a span :class:`Tracer` with parent/child nesting
+and an injectable clock, and exporters (Chrome ``trace_event`` for
+Perfetto, Prometheus text, JSONL, a human summary that subsumes
+``StatSet.report()``). See docs/design/observability.md for the metric and
+span catalogue — the names are a public contract.
+
+Zero cost when off — the ``faults`` no-op discipline: instrumented code
+calls the module-level hooks below (``obs.count(...)``, ``obs.span(...)``)
+which first check ``_SESSION is None``. With no session installed that is
+one attribute load and a branch; production never pays for telemetry it
+did not ask for.
+
+Usage::
+
+    from paddle_tpu import obs
+    with obs.ObsSession().installed() as s:
+        trainer.train(reader, params, num_passes=2)
+        print(s.summary())
+        s.save("run.jsonl")          # -> paddle_tpu obs export/summary
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .catalogue import CATALOGUE, SPANS
+from .export import (chrome_trace, prometheus_text, read_jsonl, summary,
+                     write_jsonl)
+from .metrics import (DEFAULT_BUCKETS, METRIC_NAME_RE, Counter, Gauge,
+                      Histogram, MetricsRegistry)
+from .session import ObsSession
+from .trace import NULL_SPAN, NullSpan, Tracer
+
+__all__ = [
+    "ObsSession", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "Tracer", "REGISTRY", "CATALOGUE", "SPANS", "METRIC_NAME_RE",
+    "DEFAULT_BUCKETS", "chrome_trace", "prometheus_text", "summary",
+    "read_jsonl", "write_jsonl", "is_active", "session", "install",
+    "uninstall", "count", "gauge_set", "observe", "span", "instant",
+    "retry_observer", "NullSpan", "NULL_SPAN",
+]
+
+#: process-global default registry — what an installed session reports into
+#: unless the test injected its own
+REGISTRY = MetricsRegistry()
+
+#: the installed session; None = plane disabled (the fast path)
+_SESSION: Optional[ObsSession] = None
+
+
+def _install(s: ObsSession) -> None:
+    global _SESSION
+    if _SESSION is not None and _SESSION is not s:
+        raise RuntimeError("another ObsSession is already installed")
+    _SESSION = s
+    from . import jaxhooks
+    jaxhooks.ensure_registered()
+
+
+def _uninstall(s: ObsSession) -> None:
+    global _SESSION
+    if _SESSION is s:
+        _SESSION = None
+
+
+def install(registry: Optional[MetricsRegistry] = None, **kw) -> ObsSession:
+    """Convenience: build + install a session in one call."""
+    return ObsSession(registry=registry, **kw).install()
+
+
+def uninstall() -> None:
+    global _SESSION
+    _SESSION = None
+
+
+def is_active() -> bool:
+    return _SESSION is not None
+
+
+def session() -> Optional[ObsSession]:
+    return _SESSION
+
+
+# -- module-level hooks (what instrumented code calls) --------------------------
+# Each first checks `_SESSION is None`: one load + branch when the plane is
+# off — the same contract as faults.fire/filter_* (faults/inject.py).
+
+def count(name: str, n: float = 1, **labels) -> None:
+    s = _SESSION
+    if s is None:
+        return
+    s.registry.counter(name).inc(n, **labels)
+
+
+def gauge_set(name: str, value: float, **labels) -> None:
+    s = _SESSION
+    if s is None:
+        return
+    s.registry.gauge(name).set(value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    s = _SESSION
+    if s is None:
+        return
+    s.registry.histogram(name).observe(value, **labels)
+
+
+def span(name: str, metric: Optional[str] = None, metric_labels=None,
+         **attrs):
+    """Trace span context manager; the shared :data:`NULL_SPAN` when off."""
+    s = _SESSION
+    if s is None:
+        return NULL_SPAN
+    return s.span(name, metric=metric, metric_labels=metric_labels, **attrs)
+
+
+def instant(name: str, **attrs) -> None:
+    s = _SESSION
+    if s is None:
+        return
+    s.tracer.instant(name, **attrs)
+
+
+def retry_observer(subsystem: str):
+    """A :class:`paddle_tpu.utils.retry.RetryPolicy` ``observer`` callback
+    counting into ``<subsystem>.retries_total`` / ``.giveups_total`` /
+    ``.backoff_seconds_total``. The policy stays obs-agnostic (no import
+    cycle): it calls a plain callable; the callable checks the session."""
+
+    def observer(event: str, **info) -> None:
+        s = _SESSION
+        if s is None:
+            return
+        if event == "attempt":
+            s.registry.counter(f"{subsystem}.retries_total").inc()
+            s.registry.counter(f"{subsystem}.backoff_seconds_total").inc(
+                max(0.0, float(info.get("delay", 0.0))))
+        elif event == "giveup":
+            s.registry.counter(f"{subsystem}.giveups_total").inc()
+
+    return observer

@@ -1,0 +1,105 @@
+"""The metric & span catalogue — the observability plane's public contract.
+
+Every metric the built-in instrumentation emits is declared here with its
+kind and meaning. Names are API: dashboards, alerts and tests key on them,
+so renaming one is a breaking change. ``paddle_tpu lint`` runs the ``L005``
+metric-naming lint (analysis/lints.py) over this table, and
+tests/test_obs.py asserts the table itself stays convention-clean.
+
+Kinds: ``counter`` (monotonic, suffix ``_total``), ``gauge`` (point-in-time,
+no reserved suffix), ``histogram`` (distributions, suffix ``_seconds`` /
+``_bytes``). Labels are listed where the emitter attaches any.
+
+Span names (exported to Chrome trace_event; nesting by same-thread
+containment) are catalogued in :data:`SPANS`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+#: name -> (kind, help). Keep sorted by subsystem; docs/design/observability.md
+#: renders this table verbatim.
+CATALOGUE: Dict[str, Tuple[str, str]] = {
+    # -- ckpt: trainer/checkpoint.py ------------------------------------
+    "ckpt.saves_total": ("counter", "checkpoint pass dirs published"),
+    "ckpt.bytes_total": ("counter", "member payload bytes written"),
+    "ckpt.write_seconds": ("histogram", "per-member write (incl. fsync)"),
+    "ckpt.fsync_seconds": ("histogram", "per-fsync (file + dir) duration"),
+    "ckpt.rename_seconds": ("histogram", "atomic publish rename duration"),
+    # -- data: data/reader.py, data/prefetch.py, data/chunks.py ---------
+    "data.queue_depth": ("gauge", "prefetch queue occupancy at consume; "
+                                  "with several concurrent streams the "
+                                  "value is the last-sampled stream's and "
+                                  "high_water is the process-wide peak"),
+    "data.starved_total": ("counter", "consumer found the prefetch queue "
+                                      "empty after warm-up (producer "
+                                      "behind)"),
+    "data.timeouts_total": ("counter", "prefetch watchdog timeouts raised"),
+    "data.prefetch_iters_total": ("counter", "DoubleBuffer iterations "
+                                            "started"),
+    "data.tasks_total": ("counter", "cloud_reader chunk tasks streamed"),
+    "data.task_failures_total": ("counter", "chunk tasks reported failed "
+                                            "to the master"),
+    "data.retries_total": ("counter", "cloud_reader idle-poll retries"),
+    "data.giveups_total": ("counter", "cloud_reader starvation deadlines"),
+    "data.backoff_seconds_total": ("counter", "total poll backoff slept"),
+    # -- faults: faults/inject.py ---------------------------------------
+    "faults.injected_total": ("counter", "faults fired, labels: site, "
+                                         "action — a chaos run is "
+                                         "self-describing"),
+    # -- fluid: fluid/executor.py ---------------------------------------
+    "fluid.runs_total": ("counter", "Executor.run invocations"),
+    "fluid.cache_hits_total": ("counter", "compiled-fn cache hits"),
+    "fluid.cache_misses_total": ("counter", "compiled-fn cache misses "
+                                            "(trace+compile paid)"),
+    "fluid.run_seconds": ("histogram", "whole Executor.run duration"),
+    "fluid.verify_seconds": ("histogram", "static pre-flight "
+                                          "(analysis.check_or_raise)"),
+    # -- jax: obs/jaxhooks.py (jax.monitoring bridge) -------------------
+    "jax.compiles_total": ("counter", "XLA backend compiles observed "
+                                      "(one per executable built)"),
+    "jax.compile_seconds": ("histogram", "XLA backend-compile durations"),
+    # -- lease: runtime/coord.py, runtime/lease.py ----------------------
+    "lease.renews_total": ("counter", "lease renewals attempted"),
+    "lease.renew_failures_total": ("counter", "renewals the server "
+                                              "refused (lost lease)"),
+    # -- rpc: runtime/master_service.py (_RpcClient, shared by coord) ---
+    "rpc.calls_total": ("counter", "RPC calls issued, labels: rpc, op"),
+    "rpc.call_seconds": ("histogram", "end-to-end call latency incl. "
+                                      "retries, labels: rpc"),
+    "rpc.retries_total": ("counter", "retry attempts across clients"),
+    "rpc.giveups_total": ("counter", "retry budgets exhausted"),
+    "rpc.backoff_seconds_total": ("counter", "total backoff delay slept"),
+    # -- trainer: trainer/trainer.py ------------------------------------
+    "trainer.steps_total": ("counter", "train batches executed"),
+    "trainer.examples_total": ("counter", "samples consumed (leading dim "
+                                          "of the first batch array)"),
+    "trainer.step_seconds": ("histogram", "batch step: device dispatch + "
+                                          "host block on the result"),
+    "trainer.sync_seconds": ("histogram", "host block on the step result "
+                                          "(device time shows up here "
+                                          "under async dispatch)"),
+    "trainer.nonfinite_total": ("counter", "non-finite losses observed"),
+    "trainer.skipped_total": ("counter", "batches dropped by "
+                                         "on_nonfinite=skip"),
+    "trainer.preemptions_total": ("counter", "preemption checkpoints "
+                                             "taken (SIGTERM/SIGINT)"),
+}
+
+#: span names the built-in instrumentation emits (Chrome trace contract)
+SPANS: Dict[str, str] = {
+    "trainer.pass": "one pass of the train loop (args: pass_id)",
+    "trainer.step": "one batch step (device dispatch + host sync)",
+    "trainer.device_step": "the jitted step call (dispatch)",
+    "trainer.host_sync": "host block on the loss value",
+    "trainer.checkpoint": "pass/preemption/halt checkpoint save "
+                          "(args: pass_id, reason)",
+    "fluid.run": "Executor.run",
+    "fluid.verify": "static pre-flight over the Program",
+    "rpc.call": "one RPC incl. retries (args: rpc, op)",
+    "ckpt.publish": "atomic pass-dir publication (args: pass_id)",
+    "ckpt.member": "one member write+fsync (args: member, bytes)",
+    "ckpt.fsync": "file or directory fsync",
+    "ckpt.rename": "tmp -> final rename swap",
+}

@@ -1,0 +1,252 @@
+"""Exporters: Chrome trace_event JSON, Prometheus text, JSONL, summary table.
+
+All four read ONE shape — the ``dump`` dict produced by
+:meth:`ObsSession.dump` and round-tripped through the JSONL sink::
+
+    {"meta":    {...},
+     "metrics": [MetricsRegistry.collect() samples],
+     "events":  [Tracer events (spans + instants)]}
+
+so the in-process path (``session.export_chrome()``) and the offline path
+(``paddle_tpu obs export --input run.jsonl``) are the same code.
+
+* :func:`chrome_trace` — ``{"traceEvents": [...]}`` for Perfetto /
+  chrome://tracing: spans as complete (``ph:"X"``) events in µs, instants
+  as ``ph:"i"``, counters as ``ph:"C"`` counter tracks, thread metadata.
+* :func:`prometheus_text` — the text exposition format (``# TYPE`` lines,
+  ``_bucket{le=...}``/``_sum``/``_count`` for histograms); names mangled
+  ``subsystem.noun`` -> ``paddle_tpu_subsystem_noun``.
+* :func:`write_jsonl` / :func:`read_jsonl` — the durable event stream.
+* :func:`summary` — the human table; subsumes ``StatSet.report()`` by
+  accepting stat snapshots alongside typed metrics.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional
+
+JSONL_VERSION = 1
+
+
+# -- JSONL sink -----------------------------------------------------------------
+
+def jsonl_lines(dump: Dict[str, Any]):
+    """The dump as kind-tagged JSON lines (meta, then metrics, then
+    events) — the single serialization both :func:`write_jsonl` and the
+    CLI's stdout path emit."""
+    meta = {"kind": "meta", "version": JSONL_VERSION}
+    meta.update(dump.get("meta") or {})
+    yield json.dumps(meta)
+    for s in dump.get("metrics", ()):
+        yield json.dumps({"kind": "metric", **s})
+    for e in dump.get("events", ()):
+        yield json.dumps(e)
+
+
+def write_jsonl(path: str, dump: Dict[str, Any]) -> str:
+    """Persist a session dump as line-delimited JSON: one ``meta`` line,
+    one line per metric sample, one per trace event. Append-friendly and
+    greppable — the chaos/CI artifact format."""
+    with open(path, "w") as f:
+        for line in jsonl_lines(dump):
+            f.write(line + "\n")
+    return path
+
+
+def read_jsonl(path: str) -> Dict[str, Any]:
+    """Inverse of :func:`write_jsonl`; tolerant of missing meta AND of
+    torn/corrupt lines — a process killed mid-``save`` leaves a partial
+    final line, and the dump of exactly that crashed run must still
+    export whatever landed (malformed lines are skipped)."""
+    meta: Dict[str, Any] = {}
+    metrics: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue                 # torn tail / corrupt line
+            if not isinstance(rec, dict):
+                continue
+            kind = rec.pop("kind", None)
+            if kind == "meta":
+                meta = rec
+            elif kind == "metric":
+                metrics.append(rec)
+            elif kind in ("span", "instant"):
+                events.append({"kind": kind, **rec})
+    return {"meta": meta, "metrics": metrics, "events": events}
+
+
+# -- Chrome trace_event ---------------------------------------------------------
+
+def chrome_trace(dump: Dict[str, Any]) -> Dict[str, Any]:
+    """Convert a dump to Chrome's trace_event JSON object format.
+
+    Spans become ``ph:"X"`` complete events (ts/dur in µs); Perfetto nests
+    same-tid events by containment, which matches the tracer's per-thread
+    parent stacks. Counters ride as ``ph:"C"`` tracks stamped at the trace
+    end so the final tally is visible on the timeline.
+    """
+    events = dump.get("events", [])
+    pid = None
+    t_end = 0.0
+    out: List[Dict[str, Any]] = []
+    for e in events:
+        pid = e.get("pid", pid)
+        ts_us = e["ts"] * 1e6
+        if e["kind"] == "span":
+            dur_us = e.get("dur", 0.0) * 1e6
+            t_end = max(t_end, ts_us + dur_us)
+            out.append({"name": e["name"], "ph": "X", "ts": ts_us,
+                        "dur": dur_us, "pid": e.get("pid", 0),
+                        "tid": e.get("tid", 0),
+                        "cat": e["name"].split(".", 1)[0],
+                        "args": e.get("args") or {}})
+        else:
+            t_end = max(t_end, ts_us)
+            out.append({"name": e["name"], "ph": "i", "ts": ts_us, "s": "t",
+                        "pid": e.get("pid", 0), "tid": e.get("tid", 0),
+                        "cat": e["name"].split(".", 1)[0],
+                        "args": e.get("args") or {}})
+    pid = pid if pid is not None else 0
+    for s in dump.get("metrics", ()):
+        if s.get("type") != "counter":
+            continue
+        label = s["name"]
+        if s.get("labels"):
+            inner = ",".join(f"{k}={v}" for k, v in sorted(s["labels"].items()))
+            label += f"{{{inner}}}"
+        out.append({"name": label, "ph": "C", "ts": t_end, "pid": pid,
+                    "tid": 0, "args": {"value": s.get("value", 0)}})
+    out.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": "paddle_tpu"}})
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": dump.get("meta") or {}}
+
+
+# -- Prometheus text format -----------------------------------------------------
+
+def _prom_name(name: str) -> str:
+    return "paddle_tpu_" + name.replace(".", "_")
+
+
+def _prom_labels(labels: Dict[str, Any], extra: Optional[str] = None) -> str:
+    parts = [f'{k}="{v}"' for k, v in sorted((labels or {}).items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text(dump: Dict[str, Any]) -> str:
+    """Text exposition format — what a ``/metrics`` endpoint (or a node
+    textfile collector picking up the dump) serves."""
+    lines: List[str] = []
+    seen_type = set()
+    for s in dump.get("metrics", ()):
+        name = _prom_name(s["name"])
+        if name not in seen_type:
+            if s.get("help"):
+                lines.append(f"# HELP {name} {s['help']}")
+            lines.append(f"# TYPE {name} {s['type']}")
+            seen_type.add(name)
+        if s["type"] == "histogram":
+            for le, cum in s.get("buckets", ()):
+                le_s = "+Inf" if le == "+Inf" else repr(float(le))
+                labels = _prom_labels(s.get("labels"), f'le="{le_s}"')
+                lines.append(f"{name}_bucket{labels} {cum}")
+            lines.append(f"{name}_sum{_prom_labels(s.get('labels'))} "
+                         f"{s.get('sum', 0.0)}")
+            lines.append(f"{name}_count{_prom_labels(s.get('labels'))} "
+                         f"{s.get('count', 0)}")
+        else:
+            lines.append(f"{name}{_prom_labels(s.get('labels'))} "
+                         f"{s.get('value', 0)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- human summary --------------------------------------------------------------
+
+def _fmt_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def _hist_quantile(snap: Dict[str, Any], q: float) -> float:
+    """Upper-bound estimate of quantile ``q`` from cumulative buckets,
+    clamped to the observed max — a 0.03ms sample in the le=0.5ms bucket
+    must not report p50=0.5ms > max."""
+    count = snap.get("count", 0)
+    if not count:
+        return 0.0
+    mx = snap.get("max", 0.0)
+    rank = q * count
+    for le, cum in snap.get("buckets", ()):
+        if cum >= rank:
+            return mx if le == "+Inf" else min(float(le), mx)
+    return mx
+
+
+def summary(dump: Dict[str, Any],
+            stats: Optional[Iterable] = None) -> str:
+    """Render the dump as the operator-facing table. ``stats`` accepts
+    :class:`paddle_tpu.utils.stats.StatSnapshot` values (or any object
+    with name/total/avg/max/count) so one call subsumes the legacy
+    ``StatSet.report()`` output."""
+    counters, gauges, hists = [], [], []
+    for s in dump.get("metrics", ()):
+        {"counter": counters, "gauge": gauges,
+         "histogram": hists}.get(s["type"], []).append(s)
+    lines: List[str] = []
+    if counters:
+        lines.append("== counters ==")
+        for s in counters:
+            v = s.get("value", 0)
+            v = int(v) if float(v).is_integer() else v
+            lines.append(f"{s['name'] + _fmt_labels(s.get('labels')):<52} "
+                         f"{v:>12}")
+    if gauges:
+        lines.append("== gauges ==")
+        for s in gauges:
+            lines.append(f"{s['name'] + _fmt_labels(s.get('labels')):<52} "
+                         f"{s.get('value', 0):>12g}  "
+                         f"(peak {s.get('high_water', 0):g})")
+    if hists:
+        lines.append("== histograms ==")
+        lines.append(f"{'name':<44} {'count':>7} {'mean':>10} "
+                     f"{'p50':>10} {'p99':>10} {'max':>10}")
+        for s in hists:
+            n = s.get("count", 0)
+            mean = (s.get("sum", 0.0) / n) if n else 0.0
+            lines.append(
+                f"{s['name'] + _fmt_labels(s.get('labels')):<44} {n:>7} "
+                f"{mean * 1e3:>9.3f}ms {_hist_quantile(s, 0.5) * 1e3:>9.3f}ms "
+                f"{_hist_quantile(s, 0.99) * 1e3:>9.3f}ms "
+                f"{s.get('max', 0.0) * 1e3:>9.3f}ms")
+    if stats:
+        snaps = sorted(stats, key=lambda i: -i.total)
+        if snaps:
+            lines.append("== timers (StatSet) ==")
+            for i in snaps:
+                lines.append(
+                    f"{i.name:<44} total={i.total * 1e3:10.2f}ms "
+                    f"avg={i.avg * 1e3:8.3f}ms max={i.max * 1e3:8.3f}ms "
+                    f"count={i.count}")
+    spans = [e for e in dump.get("events", ()) if e.get("kind") == "span"]
+    if spans:
+        agg: Dict[str, List[float]] = {}
+        for e in spans:
+            agg.setdefault(e["name"], []).append(e.get("dur", 0.0))
+        lines.append("== spans ==")
+        for name in sorted(agg):
+            durs = agg[name]
+            lines.append(f"{name:<44} count={len(durs):>6} "
+                         f"total={sum(durs) * 1e3:10.2f}ms "
+                         f"max={max(durs) * 1e3:8.3f}ms")
+    return "\n".join(lines) if lines else "(no observability data)"

@@ -1,0 +1,65 @@
+"""Bridge jax.monitoring compilation events into the metrics plane.
+
+XLA compilation is the dominant hidden cost of a jit-first framework: a
+shape change in the train loop silently recompiles and a step that should
+take milliseconds takes seconds. jax reports these through
+``jax.monitoring`` duration events (e.g. ``.../backend_compile_time``);
+this module registers ONE process-wide listener that forwards any
+compilation-duration event into the installed session as
+``jax.compiles_total`` / ``jax.compile_seconds`` — the compile-vs-execute
+split the trainer's step histograms can't see from the host side.
+
+The listener is registered lazily on the first session install and checks
+``obs.is_active()`` per event, so an uninstalled process pays nothing and
+jax's listener list is never cleared (other packages may have their own).
+The jax.monitoring surface is semi-public and varies across versions, so
+registration is best-effort: on any API mismatch the bridge degrades to a
+no-op and the rest of the plane works unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_registered = False
+_lock = threading.Lock()
+
+#: event-name marker for "one XLA backend compile". One jit call emits
+#: SEVERAL duration events (jaxpr trace, mlir lowering, backend compile);
+#: counting anything broader than backend_compile would tally one compile
+#: 3x and mix unrelated distributions into one histogram.
+_COMPILE_MARKER = "backend_compile"
+
+
+def _on_duration(event: str, duration_secs: float = 0.0, **kw) -> None:
+    # late import: this module must stay importable before obs/__init__
+    # finishes (it registers us during _install)
+    from . import _SESSION
+    s = _SESSION
+    if s is None:
+        return
+    if _COMPILE_MARKER not in event:
+        return
+    try:
+        s.registry.counter("jax.compiles_total").inc()
+        s.registry.histogram("jax.compile_seconds").observe(duration_secs)
+        s.tracer.instant("jax.compile", event=event,
+                         duration_secs=duration_secs)
+    except Exception:
+        # a telemetry bridge must never take down a compile
+        pass
+
+
+def ensure_registered() -> bool:
+    """Idempotently hook jax.monitoring; True when the bridge is live."""
+    global _registered
+    with _lock:
+        if _registered:
+            return True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            return False
+        _registered = True
+        return True

@@ -1,0 +1,164 @@
+"""Span tracer: structured timing events with parent/child nesting.
+
+The tracing half of the observability plane. A :class:`Tracer` records
+*spans* — named intervals with monotonic timestamps, the recording thread's
+id, and the id of the enclosing span on the same thread — plus *instant*
+point events. The event stream exports to Chrome ``trace_event`` JSON
+(viewable in Perfetto / chrome://tracing, where same-thread containment
+renders the nesting) via :mod:`paddle_tpu.obs.export`.
+
+Two disciplines inherited from the rest of the runtime:
+
+* **injectable clock** — tests drive a fake counter so span durations are
+  exact and nothing sleeps (the utils/retry.py clock discipline);
+* **per-thread parent stack** — nesting is attributed by the *recording*
+  thread (checkpoint writers and prefetch workers each get their own
+  lane), matching how Perfetto lays tracks out.
+
+Unlike ``utils.profiler`` (which drives the XLA device profiler), these
+spans are host-side and structured: they survive as plain dicts, so the
+JSONL dump, the Chrome exporter and test assertions all read one format.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Tracer:
+    """Collects span/instant events; thread-safe; clock injectable.
+
+    ``max_events`` bounds host memory: a long training run records ~5
+    events per batch, and an unbounded list would eventually OOM the job
+    the tracer is observing. Past the cap new events are dropped and
+    tallied in :attr:`dropped` (surfaced in the dump meta) — the trace
+    keeps the run's beginning, the metrics registry keeps counting
+    everything."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 max_events: int = 250_000):
+        self.clock = clock or time.perf_counter
+        self.events: List[Dict[str, Any]] = []
+        self.max_events = max_events
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._next_id = 1
+        self.pid = os.getpid()
+
+    # -- internals ----------------------------------------------------------
+    def _stack(self) -> List[int]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _new_id(self) -> int:
+        with self._lock:
+            i = self._next_id
+            self._next_id += 1
+            return i
+
+    def _record(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self.events) >= self.max_events:
+                self.dropped += 1
+                return
+            self.events.append(ev)
+
+    # -- recording ----------------------------------------------------------
+    def span(self, name: str, **attrs) -> "_Span":
+        """Context manager recording one interval event on exit."""
+        return _Span(self, name, attrs)
+
+    def instant(self, name: str, **attrs) -> None:
+        """Point event (the trace analog of a log line)."""
+        stack = self._stack()
+        self._record({"kind": "instant", "name": name, "ts": self.clock(),
+                      "tid": threading.get_ident(), "pid": self.pid,
+                      "parent": stack[-1] if stack else None,
+                      "args": attrs or {}})
+
+    # -- reading ------------------------------------------------------------
+    def spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [e for e in self.events if e["kind"] == "span"]
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self.events)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.events.clear()
+            self.dropped = 0
+
+
+class _Span:
+    """One live span; records its event when the ``with`` block exits, so a
+    span that raises still lands in the trace (with ``error`` noted)."""
+
+    __slots__ = ("_tracer", "name", "attrs", "id", "parent", "_t0", "_dur")
+
+    def __init__(self, tracer: Tracer, name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.id = tracer._new_id()
+        self.parent: Optional[int] = None
+        self._t0 = 0.0
+        self._dur: Optional[float] = None
+
+    def __enter__(self) -> "_Span":
+        stack = self._tracer._stack()
+        self.parent = stack[-1] if stack else None
+        stack.append(self.id)
+        self._t0 = self._tracer.clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = self._tracer.clock()
+        self._dur = t1 - self._t0
+        stack = self._tracer._stack()
+        # tolerate a foreign unwind (a generator suspended mid-span): pop
+        # our own id wherever it sits instead of corrupting siblings
+        if stack and stack[-1] == self.id:
+            stack.pop()
+        elif self.id in stack:
+            stack.remove(self.id)
+        args = dict(self.attrs)
+        if exc_type is not None:
+            args["error"] = exc_type.__name__
+        self._tracer._record({
+            "kind": "span", "name": self.name, "ts": self._t0,
+            "dur": self._dur, "tid": threading.get_ident(),
+            "pid": self._tracer.pid, "id": self.id, "parent": self.parent,
+            "args": args})
+        return False
+
+    @property
+    def duration(self) -> float:
+        """Elapsed seconds so far; the recorded duration once exited."""
+        if self._dur is not None:
+            return self._dur
+        return self._tracer.clock() - self._t0
+
+
+class NullSpan:
+    """Shared no-op stand-in returned by the module hooks when no session
+    is installed — stateless, so ONE instance serves every call site
+    (the faults `_PLAN is None` zero-cost discipline)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NULL_SPAN = NullSpan()

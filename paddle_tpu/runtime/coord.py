@@ -41,7 +41,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from .. import faults
+from .. import faults, obs
 from .master_service import _recv_msg, _RpcClient, _send_msg
 
 
@@ -217,6 +217,7 @@ class NetworkLease:
         return False
 
     def renew(self, now: Optional[float] = None) -> bool:
+        obs.count("lease.renews_total")
         faults.fire("lease.renew")
         r = self._client.call({"op": "lease_renew", "name": self.name,
                                "owner": self.owner, "ttl": self.ttl})
@@ -224,6 +225,7 @@ class NetworkLease:
             if self.token is None:
                 self.token = r.get("token")   # recover after restart
             return True
+        obs.count("lease.renew_failures_total")
         return False
 
     def release(self):

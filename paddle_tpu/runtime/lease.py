@@ -41,7 +41,7 @@ import time
 import uuid
 from typing import Optional, Tuple
 
-from .. import faults
+from .. import faults, obs
 
 
 @contextlib.contextmanager
@@ -111,11 +111,13 @@ class FileLease:
 
     def renew(self, now: Optional[float] = None) -> bool:
         """Extend our lease; False (lease LOST) if someone else took it."""
+        obs.count("lease.renews_total")
         faults.fire("lease.renew")   # chaos: stall/FS-outage injection point
         now = time.time() if now is None else now
         with _flocked(self._lock_path):
             h = self._read(self.path)
             if h is None or h[0] != self.owner:
+                obs.count("lease.renew_failures_total")
                 return False
             if self.token is None:
                 self.token = h[2]            # recover after restart

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-from .. import faults
+from .. import faults, obs
 from ..utils.retry import RetryBudgetExceeded, RetryPolicy
 from .master import TaskMaster
 
@@ -348,6 +348,12 @@ class _RpcClient:
         self.policy = retry_policy or RetryPolicy(
             max_attempts=retries, base_delay=retry_delay, multiplier=2.0,
             max_delay=2.0, jitter=0.25)
+        if retry_policy is None:
+            # retry telemetry (rpc.retries_total / giveups / backoff) — a
+            # no-op callable until an ObsSession is installed. Only on OUR
+            # policy: a caller-supplied (possibly shared) instance is never
+            # mutated, and its observer choice is the caller's
+            self.policy.observer = obs.retry_observer("rpc")
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -401,7 +407,14 @@ class _RpcClient:
         return resp
 
     def _call(self, req):
-        with self._lock:
+        # span + latency histogram cover the WHOLE call incl. retries —
+        # what the caller experienced, not one socket round trip
+        with self._lock, \
+                obs.span("rpc.call", metric="rpc.call_seconds",
+                         metric_labels={"rpc": self._rpc_name},
+                         rpc=self._rpc_name, op=req.get("op")):
+            obs.count("rpc.calls_total", rpc=self._rpc_name,
+                      op=str(req.get("op")))
             try:
                 return self.policy.call(
                     self._call_once, req,

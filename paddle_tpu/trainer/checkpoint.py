@@ -36,7 +36,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 import jax
 import numpy as np
 
-from .. import faults
+from .. import faults, obs
 from ..core.pytree import flatten_path_tree, tree_spec, unflatten_path_tree
 from ..utils.logging import get_logger
 
@@ -111,19 +111,24 @@ def pass_dir(output_dir: str, pass_id: int) -> str:
 
 
 def _fsync_file(f) -> None:
-    f.flush()
-    os.fsync(f.fileno())
+    with obs.span("ckpt.fsync", metric="ckpt.fsync_seconds"):
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def _fsync_dir(path: str) -> None:
     """Durability of a rename/create requires fsyncing the containing dir;
-    best-effort on filesystems that refuse directory fds."""
+    best-effort on filesystems that refuse directory fds. Timed under the
+    same ``ckpt.fsync`` span/histogram as file fsyncs — on network
+    filesystems the directory fsync is often the slowest durability
+    step, and the contract says the metric covers both."""
     try:
         fd = os.open(path, os.O_RDONLY)
     except OSError:
         return
     try:
-        os.fsync(fd)
+        with obs.span("ckpt.fsync", metric="ckpt.fsync_seconds", dir=True):
+            os.fsync(fd)
     except OSError:
         pass
     finally:
@@ -139,9 +144,12 @@ def _write_member(d: str, name: str, payload: bytes) -> Dict[str, int]:
     """
     entry = {"crc32": zlib.crc32(payload) & 0xFFFFFFFF, "size": len(payload)}
     written = faults.filter_bytes("ckpt.write", payload)
-    with open(os.path.join(d, name), "wb") as f:
-        f.write(written)
-        _fsync_file(f)
+    with obs.span("ckpt.member", metric="ckpt.write_seconds", member=name,
+                  bytes=len(written)):
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(written)
+            _fsync_file(f)
+    obs.count("ckpt.bytes_total", len(written))
     return entry
 
 
@@ -210,6 +218,14 @@ def publish_members(output_dir: str, pass_id: int,
     Shared by :func:`save_checkpoint` and the CLI's v2-parameters pass dump,
     so there is exactly one implementation of the durability protocol.
     """
+    with obs.span("ckpt.publish", pass_id=pass_id):
+        d = _publish_members(output_dir, pass_id, members)
+    obs.count("ckpt.saves_total")
+    return d
+
+
+def _publish_members(output_dir: str, pass_id: int,
+                     members: Iterable[Tuple[str, bytes]]) -> str:
     _recover_torn_swap(output_dir)
     d = pass_dir(output_dir, pass_id)
     tmp = d + ".tmp"
@@ -226,17 +242,19 @@ def publish_members(output_dir: str, pass_id: int,
     _fsync_dir(tmp)
 
     try:
-        if os.path.exists(d):
-            # re-saving a pass (e.g. completing one previously preempted):
-            # move the old dir aside so the rename stays atomic, then drop it
-            old = d + ".old"
-            if os.path.exists(old):
-                shutil.rmtree(old)
-            os.rename(d, old)
-            os.rename(tmp, d)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.rename(tmp, d)
+        with obs.span("ckpt.rename", metric="ckpt.rename_seconds"):
+            if os.path.exists(d):
+                # re-saving a pass (e.g. completing one previously
+                # preempted): move the old dir aside so the rename stays
+                # atomic, then drop it
+                old = d + ".old"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(d, old)
+                os.rename(tmp, d)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.rename(tmp, d)
     except FileNotFoundError:
         # a concurrent discovery scan's torn-swap recovery can publish our
         # .tmp itself; depending on the interleaving our bytes sit at the

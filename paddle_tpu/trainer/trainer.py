@@ -21,13 +21,14 @@ import itertools
 import signal
 import threading
 import time
+from collections.abc import Mapping
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import faults
+from .. import faults, obs
 from ..data.prefetch import DoubleBuffer
 from ..parallel.data_parallel import DataParallel
 from ..utils.logging import get_logger
@@ -39,6 +40,34 @@ from .evaluator import EvaluatorGroup
 log = get_logger(__name__)
 
 _NONFINITE_POLICIES = ("raise", "skip", "halt", "off")
+
+
+class _TrainStatsView(Mapping):
+    """Read-only compatibility view of the legacy ``train_stats`` dict.
+
+    The robustness counters moved to typed obs counters on the trainer's
+    own registry (ISSUE 3); existing callers and tests keep reading the
+    old keys through this Mapping. It is intentionally not writable —
+    the counters are the single source of truth."""
+
+    _KEYS = {"nonfinite_batches": "trainer.nonfinite_total",
+             "skipped_batches": "trainer.skipped_total",
+             "preemptions": "trainer.preemptions_total"}
+
+    def __init__(self, registry: obs.MetricsRegistry):
+        self._registry = registry
+
+    def __getitem__(self, key: str) -> int:
+        return int(self._registry.counter(self._KEYS[key]).get())
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self) -> int:
+        return len(self._KEYS)
+
+    def __repr__(self):
+        return repr(dict(self))
 
 
 class Trainer:
@@ -66,6 +95,12 @@ class Trainer:
         arrives within this many seconds, raise TimeoutError instead of
         hanging the pod (a stalled data source on a TPU slice otherwise
         wedges every chip behind the collective).
+      metrics: injectable :class:`paddle_tpu.obs.MetricsRegistry` backing
+        the robustness counters (``trainer.nonfinite_total`` etc.) and the
+        ``train_stats`` compatibility view; a fresh per-trainer registry
+        by default so parallel trainers don't share counts. Hot-path step
+        metrics additionally flow to the installed obs session (zero-cost
+        when none is).
     """
 
     def __init__(self, loss_fn: Callable, optimizer, *, mesh=None,
@@ -75,7 +110,8 @@ class Trainer:
                  param_stats_period: int = 0,
                  nan_guard: bool = True,
                  on_nonfinite: Optional[str] = None,
-                 prefetch_timeout: Optional[float] = None):
+                 prefetch_timeout: Optional[float] = None,
+                 metrics: Optional[obs.MetricsRegistry] = None):
         self.loss_fn = loss_fn
         self.opt = optimizer
         self.outputs_fn = jax.jit(outputs_fn) if outputs_fn is not None else None
@@ -103,10 +139,16 @@ class Trainer:
         self.nan_guard = on_nonfinite != "off"
         self.prefetch_timeout = prefetch_timeout
         self.stats = StatSet()
-        #: robustness counters surfaced alongside timer stats
-        self.train_stats: Dict[str, int] = {"nonfinite_batches": 0,
-                                            "skipped_batches": 0,
-                                            "preemptions": 0}
+        #: typed robustness counters (trainer.* catalogue names)
+        self.metrics = metrics if metrics is not None else \
+            obs.MetricsRegistry()
+        #: legacy read-only view over the counters (ISSUE 3 compat)
+        self.train_stats: Mapping = _TrainStatsView(self.metrics)
+        # hot-path counters bound once: the per-batch cost is one locked
+        # float add on the trainer's own registry (the obs session mirror
+        # stays gated on is_active)
+        self._c_steps = self.metrics.counter("trainer.steps_total")
+        self._c_examples = self.metrics.counter("trainer.examples_total")
         self._preempt = threading.Event()
         self.preempted = False
         # skip AND halt both need the update dropped on a non-finite loss:
@@ -187,25 +229,43 @@ class Trainer:
             pass
         return prev
 
+    def _mirror(self, name: str, n: float = 1) -> None:
+        """Mirror a count into the installed obs session — unless the
+        session shares this trainer's registry (Trainer(metrics=
+        obs.REGISTRY) under a default session), where mirroring would
+        double-count."""
+        s = obs.session()
+        if s is not None and s.registry is not self.metrics:
+            s.registry.counter(name).inc(n)
+
+    def _count(self, name: str, n: float = 1) -> None:
+        """Robustness counter: the trainer's own registry is the always-on
+        source of truth (train_stats view); the session gets a mirror so
+        exports include it."""
+        self.metrics.counter(name).inc(n)
+        self._mirror(name, n)
+
     def _checkpoint_preempted(self, pass_id, batch_id, params, opt_state):
         if self.output_dir:
-            save_checkpoint(self.output_dir, pass_id, params, opt_state,
-                            extra={"pass_complete": False,
-                                   "batch_id": batch_id})
+            with obs.span("trainer.checkpoint", pass_id=pass_id,
+                          reason="preemption"):
+                save_checkpoint(self.output_dir, pass_id, params, opt_state,
+                                extra={"pass_complete": False,
+                                       "batch_id": batch_id})
             log.warning("preempted at pass %d batch %d: checkpoint saved; "
                         "resume re-runs this pass", pass_id, batch_id)
         else:
             log.warning("preempted at pass %d batch %d with no output_dir: "
                         "nothing durable to save", pass_id, batch_id)
-        self.train_stats["preemptions"] += 1
+        self._count("trainer.preemptions_total")
         self.preempted = True
 
     def _handle_nonfinite(self, cost_f, pass_id, batch_id, params, opt_state):
-        self.train_stats["nonfinite_batches"] += 1
+        self._count("trainer.nonfinite_total")
         if self.on_nonfinite == "skip":
             # the jitted step (or the host-side revert on the mesh path)
             # already dropped the update; account for it and move on
-            self.train_stats["skipped_batches"] += 1
+            self._count("trainer.skipped_total")
             log.warning("non-finite loss %s at pass %d batch %d: batch "
                         "skipped (%d skipped so far)", cost_f, pass_id,
                         batch_id, self.train_stats["skipped_batches"])
@@ -214,9 +274,11 @@ class Trainer:
             # durable state first, then fail: params/opt_state were reverted
             # to the pre-update (last finite) trees, so the operator restarts
             # from the last finite step instead of losing the pass
-            save_checkpoint(self.output_dir, pass_id, params, opt_state,
-                            extra={"pass_complete": False,
-                                   "batch_id": batch_id, "halted": True})
+            with obs.span("trainer.checkpoint", pass_id=pass_id,
+                          reason="halt"):
+                save_checkpoint(self.output_dir, pass_id, params, opt_state,
+                                extra={"pass_complete": False,
+                                       "batch_id": batch_id, "halted": True})
             log.error("non-finite loss at pass %d batch %d: state "
                       "checkpointed before halting", pass_id, batch_id)
         # the feenableexcept(FE_INVALID|DIVBYZERO|OVERFLOW) analog
@@ -289,6 +351,10 @@ class Trainer:
         try:
             last_pass = start_pass + num_passes - 1
             for pass_id in range(start_pass, start_pass + num_passes):
+              # pass-scoped trace span: reader RPC pulls, checkpoint saves
+              # and every step nest under it on this thread (the Perfetto
+              # trainer -> ckpt/rpc containment of docs/design/observability)
+              with obs.span("trainer.pass", pass_id=pass_id):
                 event_handler(EV.BeginPass(pass_id))
                 self.evaluators.start()
                 first_batch = skip_batches if pass_id == start_pass else 0
@@ -299,18 +365,35 @@ class Trainer:
                             and self._dp is not None):
                         # mesh path: revert host-side (donation disabled)
                         prev_params, prev_opt = params, opt_state
-                    with self.stats.timer("TrainBatch"):
-                        if self._dp is not None:
-                            batch = self._dp.shard_batch(batch)
-                            res = self._dp.step(params, opt_state, *batch)
+                    with obs.span("trainer.step",
+                                  metric="trainer.step_seconds"):
+                        with self.stats.timer("TrainBatch"), \
+                                obs.span("trainer.device_step"):
+                            if self._dp is not None:
+                                batch = self._dp.shard_batch(batch)
+                                res = self._dp.step(params, opt_state,
+                                                    *batch)
+                            else:
+                                res = self._step(params, opt_state, *batch)
+                        if self.outputs_fn is not None:
+                            params, opt_state, cost, outs = res
                         else:
-                            res = self._step(params, opt_state, *batch)
-                    if self.outputs_fn is not None:
-                        params, opt_state, cost, outs = res
-                    else:
-                        params, opt_state, cost = res
-                        outs = None
-                    cost_f = faults.filter_value("step.grad", float(cost))
+                            params, opt_state, cost = res
+                            outs = None
+                        # float(cost) is the host block on the async step —
+                        # under async dispatch the device time lands here
+                        with obs.span("trainer.host_sync",
+                                      metric="trainer.sync_seconds"):
+                            cost_f = faults.filter_value("step.grad",
+                                                         float(cost))
+                    self._c_steps.inc()
+                    self._mirror("trainer.steps_total")
+                    lead = (getattr(batch[0], "shape", None)
+                            if isinstance(batch, (tuple, list)) and batch
+                            else None)
+                    if lead:
+                        self._c_examples.inc(lead[0])
+                        self._mirror("trainer.examples_total", lead[0])
                     if self.nan_guard and not np.isfinite(cost_f):
                         if (self.on_nonfinite in ("skip", "halt")
                                 and self._dp is not None):
@@ -350,8 +433,10 @@ class Trainer:
                 if self.output_dir and (
                         (pass_id - start_pass + 1) % checkpoint_every == 0
                         or pass_id == last_pass):
-                    save_checkpoint(self.output_dir, pass_id, params,
-                                    opt_state)
+                    with obs.span("trainer.checkpoint", pass_id=pass_id,
+                                  reason="pass_end"):
+                        save_checkpoint(self.output_dir, pass_id, params,
+                                        opt_state)
                 event_handler(EV.EndPass(pass_id, pass_result))
         finally:
             for sig, handler in prev_handlers.items():
@@ -375,6 +460,14 @@ class Trainer:
         # watch, so the timeout must not be silently ignored without one
         return iter(DoubleBuffer(reader, depth=self.prefetch, transform=feeder,
                                  timeout=self.prefetch_timeout))
+
+    # ---------------------------------------------------------------- summary
+    def summary(self) -> str:
+        """Operator-facing report: the trainer's typed counters plus
+        immutable :class:`~paddle_tpu.utils.stats.StatSnapshot` rows —
+        ``obs.summary`` subsumes the old ``StatSet.report()`` table."""
+        return obs.summary({"metrics": self.metrics.collect()},
+                           stats=self.stats.items().values())
 
     # ------------------------------------------------------------------- test
     def test(self, reader, params, *, feeder=None) -> Dict[str, Any]:

@@ -2,7 +2,8 @@ from .flags import FLAGS, Flags
 from .logging import get_logger, logger
 from .registry import Registry
 from .retry import RetryBudgetExceeded, RetryPolicy
-from .stats import GLOBAL_STATS, StatSet, timer
+from .stats import GLOBAL_STATS, StatSet, StatSnapshot, timer
 
-__all__ = ["FLAGS", "Flags", "Registry", "StatSet", "GLOBAL_STATS", "timer",
+__all__ = ["FLAGS", "Flags", "Registry", "StatSet", "StatSnapshot",
+           "GLOBAL_STATS", "timer",
            "get_logger", "logger", "RetryPolicy", "RetryBudgetExceeded"]

@@ -53,6 +53,14 @@ class RetryPolicy:
       retryable: exception class(es) or predicate deciding what to retry.
       sleep/clock: injectable time functions (fake clock in tests).
       seed: seeds the jitter RNG for reproducible schedules.
+      observer: optional stats callback ``observer(event, **info)`` —
+        ``"attempt"`` (kw: attempt, delay, error) before each backoff
+        sleep, ``"giveup"`` (kw: attempts, error) when the budget is
+        spent, ``"success"`` (kw: attempts) on a retried call that then
+        succeeded. This is how the observability plane subscribes
+        (``paddle_tpu.obs.retry_observer``) without this module importing
+        ``obs`` — the policy stays dependency-free and the callback is
+        plain data out.
     """
 
     def __init__(self, *, max_attempts: Optional[int] = 5,
@@ -62,7 +70,8 @@ class RetryPolicy:
                  retryable: RetryableSpec = (OSError, ConnectionError),
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 observer: Optional[Callable[..., None]] = None):
         if max_attempts is None and deadline is None:
             raise ValueError("unbounded policy: set max_attempts or deadline")
         if max_attempts is not None and max_attempts < 1:
@@ -78,7 +87,12 @@ class RetryPolicy:
         self.retryable = retryable
         self.sleep = sleep
         self.clock = clock
+        self.observer = observer
         self._rng = random.Random(seed)
+
+    def _observe(self, event: str, **info) -> None:
+        if self.observer is not None:
+            self.observer(event, **info)
 
     def delay_for(self, attempt: int) -> float:
         """Pre-jitter delay after failed attempt ``attempt`` (0-based)."""
@@ -110,7 +124,10 @@ class RetryPolicy:
         last: Optional[BaseException] = None
         while True:
             try:
-                return fn(*args, **kw)
+                result = fn(*args, **kw)
+                if attempt:
+                    self._observe("success", attempts=attempt + 1)
+                return result
             except BaseException as e:
                 if not self.is_retryable(e):
                     raise
@@ -124,8 +141,11 @@ class RetryPolicy:
                 break
             if on_retry is not None:
                 on_retry(attempt, last)
+            self._observe("attempt", attempt=attempt, delay=delay,
+                          error=last)
             if delay > 0:
                 self.sleep(delay)
+        self._observe("giveup", attempts=attempt, error=last)
         raise RetryBudgetExceeded(
             f"{describe} failed after {attempt} attempt(s): {last}",
             attempts=attempt, last_error=last) from last

@@ -12,7 +12,20 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict
+from typing import Dict, NamedTuple
+
+
+class StatSnapshot(NamedTuple):
+    """Immutable point-in-time view of one timer — what :meth:`StatSet.items`
+    hands out. The live :class:`StatItem` never leaves the lock: returning
+    it let callers read ``total``/``count`` mid-update from another thread
+    (torn averages) or mutate accumulator state they don't own."""
+
+    name: str
+    total: float
+    count: int
+    max: float
+    avg: float
 
 
 class StatItem:
@@ -46,12 +59,14 @@ class StatSet:
         self._items: Dict[str, StatItem] = {}
         self._lock = threading.Lock()
 
-    def get(self, name: str) -> StatItem:
+    def add(self, name: str, seconds: float) -> None:
+        """Accumulate one sample under the lock — the only mutation path,
+        so concurrent timers never race on a shared StatItem."""
         with self._lock:
             item = self._items.get(name)
             if item is None:
                 item = self._items[name] = StatItem(name)
-            return item
+            item.add(seconds)
 
     @contextmanager
     def timer(self, name: str):
@@ -65,7 +80,7 @@ class StatSet:
             try:
                 yield
             finally:
-                self.get(name).add(time.perf_counter() - t0)
+                self.add(name, time.perf_counter() - t0)
 
     def reset(self):
         with self._lock:
@@ -76,9 +91,11 @@ class StatSet:
             lines = [repr(i) for i in sorted(self._items.values(), key=lambda i: -i.total)]
         return "\n".join(lines)
 
-    def items(self):
+    def items(self) -> Dict[str, StatSnapshot]:
+        """Immutable snapshots keyed by name (see :class:`StatSnapshot`)."""
         with self._lock:
-            return dict(self._items)
+            return {n: StatSnapshot(i.name, i.total, i.count, i.max, i.avg)
+                    for n, i in self._items.items()}
 
 
 GLOBAL_STATS = StatSet()

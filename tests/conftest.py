@@ -28,6 +28,9 @@ def pytest_configure(config):
         "(tests/test_faults.py); tier-1, no real sleeps, <60s total")
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers", "obs: observability-plane tests (tests/test_obs.py); "
+        "tier-1, fake clocks, no real sleeps")
 
 
 @pytest.fixture
