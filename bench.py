@@ -56,14 +56,61 @@ BUDGET_S = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "1350"))
 # open after bench.py reports a clean run and the next round blocks on it
 _current_child = None
 
+# span evidence riding along with the numbers: every row's subprocess runs
+# under an ObsSession + flight recorder and saves its JSONL dump here, so a
+# future perf trajectory can ask "where did the time go" of any past
+# BENCH_*.json row (inspect: paddle_tpu obs summary --input <file>).
+# Set PADDLE_TPU_BENCH_OBS_DIR="" to disable.
+#
+# Measurement-conditions note (rows from PR 4 on): the session is live
+# DURING the timed loops, so instrumented paths (obs.span/obs.count call
+# sites) pay the recording path — a few µs per event against multi-ms
+# batches, and zero for the raw-jax device loops most rows time. When
+# comparing against pre-PR-4 BENCH_*.json rows, treat sub-percent deltas
+# on instrumented paths as noise from this change, not a regression.
+OBS_DIR = os.environ.get("PADDLE_TPU_BENCH_OBS_DIR", ROOT)
+
+
+def _slug(expr: str) -> str:
+    """Stable filesystem tag for a row expression. The short expr digest
+    keeps parameterized rows (bench_row('alexnet', 256) vs ('googlenet',
+    128)) from overwriting each other's span-evidence dumps."""
+    import hashlib
+    import re
+    m = re.findall(r"benchmarks\.(\w+)|\.(\w+)\(", expr)
+    parts = [a or b for a, b in m]
+    digest = hashlib.md5(expr.encode()).hexdigest()[:6]
+    return ("_".join(parts) or "row") + "_" + digest
+
 
 def _capture_row(expr: str, timeout: float = ROW_TIMEOUT,
                  tries: int = 2) -> list:
     """Run one bench row in a watchdog subprocess; return its JSON lines."""
     global _current_child
+    obs_prelude = obs_coda = ""
+    if OBS_DIR:
+        obs_path = os.path.join(OBS_DIR, f"BENCH_OBS_{_slug(expr)}.jsonl")
+        # flight recorder armed first: a row the watchdog SIGKILLs mid-
+        # compile still can't dump (nothing survives SIGKILL), but a row
+        # that dies on an exception leaves its span ring behind
+        obs_prelude = (
+            "from paddle_tpu import obs as _obs\n"
+            "_s = _obs.ObsSession(registry=_obs.MetricsRegistry())"
+            ".install()\n"
+            f"_fr = _obs.FlightRecorder(_s, {obs_path!r}).arm()\n")
+        # never let a telemetry write discard a completed measurement: the
+        # JSON result lines print even if the dump path is unwritable
+        obs_coda = ("_fr.disarm()\n_s.uninstall()\n"
+                    "try:\n"
+                    f"    _s.save({obs_path!r})\n"
+                    "except Exception as _e:\n"
+                    "    print('bench: obs dump failed:', _e, "
+                    "file=sys.stderr)\n")
     code = (f"import sys, json\nsys.path.insert(0, {ROOT!r})\n"
-            f"_r = {expr}\n"
-            "for _d in (_r if isinstance(_r, list) else [_r]):\n"
+            + obs_prelude
+            + f"_r = {expr}\n"
+            + obs_coda
+            + "for _d in (_r if isinstance(_r, list) else [_r]):\n"
             "    print(json.dumps(_d), flush=True)\n")
     for attempt in range(tries):
         p = subprocess.Popen([sys.executable, "-c", code],

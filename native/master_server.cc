@@ -153,7 +153,35 @@ struct Parser {
     return true;
   }
 
-  // skip any JSON value (for unknown keys)
+  // scan (and discard) one JSON number, fraction/exponent included —
+  // hand-rolled rather than strtod() because strtod honours LC_NUMERIC
+  // (a comma-decimal locale would stop at the '.') while JSON does not
+  bool skip_number() {
+    const char* q = p;
+    if (q < end && *q == '-') q++;
+    bool digits = false;
+    while (q < end && *q >= '0' && *q <= '9') { q++; digits = true; }
+    if (!digits) return false;
+    if (q < end && *q == '.') {
+      q++;
+      bool frac = false;
+      while (q < end && *q >= '0' && *q <= '9') { q++; frac = true; }
+      if (!frac) return false;
+    }
+    if (q < end && (*q == 'e' || *q == 'E')) {
+      q++;
+      if (q < end && (*q == '+' || *q == '-')) q++;
+      bool exp = false;
+      while (q < end && *q >= '0' && *q <= '9') { q++; exp = true; }
+      if (!exp) return false;
+    }
+    p = q;
+    return true;
+  }
+
+  // skip any JSON value (for unknown keys). Numbers may be doubles here:
+  // skipped values include metric samples (obs_push) whose floats the
+  // integer() path would choke on mid-frame.
   bool skip() {
     ws();
     if (p >= end) return false;
@@ -179,8 +207,7 @@ struct Parser {
       }
     }
     if (lit("true") || lit("false") || lit("null")) return true;
-    long long v;
-    return integer(&v);
+    return skip_number();
   }
 };
 
@@ -261,19 +288,31 @@ void json_escape(const std::string& s, std::string* out) {
 
 // ---------------------------------------------------------------- server --
 
+// Python fallback for ops this dispatch does not know (obs_push/obs_stats
+// and anything future): receives the RAW request frame (the native Request
+// struct drops unknown keys) and must answer via ptms_reply before
+// returning. ctypes acquires the GIL for the call, so handler threads may
+// invoke it concurrently with the Python control plane.
+typedef void (*ptms_fallback_fn)(const char* req, int len, void* reply);
+
+struct Reply {
+  std::string body;
+};
+
 struct Server {
   void* master = nullptr;
   int listen_fd = -1;
   int port = 0;
   std::atomic<bool> stop{false};
   std::atomic<bool> fenced{false};
+  std::atomic<ptms_fallback_fn> fallback{nullptr};
   std::thread accept_thread;
   std::mutex mu;                 // guards conns + active
   std::condition_variable cv;    // signals active reaching 0
   std::set<int> conns;
   int active = 0;                // live (detached) handler threads
 
-  std::string dispatch(const Request& req) {
+  std::string dispatch(const Request& req, const std::string& body) {
     static const char* kMutating[] = {"set_dataset", "get_task",
                                       "task_finished", "task_failed",
                                       "new_pass"};
@@ -333,6 +372,15 @@ struct Server {
       out += ", \"epoch\": " + std::to_string(epoch) + "}";
       return out;
     }
+    // unknown op: give the Python control plane a chance before erroring —
+    // this is how obs_push/obs_stats (and future control ops) are served
+    // without teaching the C++ data plane their payloads
+    ptms_fallback_fn fb = fallback.load();
+    if (fb != nullptr) {
+      Reply r;
+      fb(body.data(), (int)body.size(), &r);
+      if (!r.body.empty()) return r.body;
+    }
     std::string out = "{\"ok\": false, \"error\": \"unknown op '";
     json_escape(req.op, &out);
     out += "'\"}";
@@ -371,7 +419,7 @@ struct Server {
       if (n && !recv_exact(fd, &body[0], n)) break;
       Request req = parse_request(body);
       std::string resp =
-          req.ok ? dispatch(req)
+          req.ok ? dispatch(req, body)
                  : std::string("{\"ok\": false, \"error\": \"bad request\"}");
       uint32_t out_le = htole32((uint32_t)resp.size());
       char hdr[4];
@@ -455,6 +503,20 @@ int ptms_port(void* h) { return static_cast<Server*>(h)->port; }
 // failover logic matches on; reads (stats) still serve.
 void ptms_set_fenced(void* h, int fenced) {
   static_cast<Server*>(h)->fenced.store(fenced != 0);
+}
+
+// Unknown-op fallback into the Python control plane. The callback must
+// stay callable until after ptms_stop returns (ptms_stop drains every
+// handler thread before returning, so releasing it afterwards is safe).
+void ptms_set_fallback(void* h, ptms_fallback_fn fn) {
+  static_cast<Server*>(h)->fallback.store(fn);
+}
+
+// Called by the fallback (from inside its invocation) to publish the
+// response frame for the request it was handed.
+void ptms_reply(void* reply, const char* data, int n) {
+  if (reply == nullptr || data == nullptr || n < 0) return;
+  static_cast<Reply*>(reply)->body.assign(data, (size_t)n);
 }
 
 void ptms_stop(void* h) {

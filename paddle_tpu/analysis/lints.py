@@ -184,19 +184,44 @@ _METRIC_SUFFIXES = {
 _RESERVED_SUFFIXES = ("_total", "_seconds", "_bytes", "_bucket", "_sum",
                       "_count")
 
+# label keys whose values are, in practice, unbounded identifier spaces: a
+# per-path / per-payload / per-uuid label mints a new time series per value
+# and melts whatever stores the metrics (the Prometheus cardinality
+# failure mode). Bounded enums — op/type/site/action/rpc/worker — are fine.
+_UNBOUNDED_LABEL_KEYS = frozenset((
+    "path", "file", "filename", "dir", "payload", "task", "task_id",
+    "id", "uuid", "trace", "trace_id", "span", "span_id", "addr",
+    "address", "url", "host", "endpoint", "user", "query"))
 
-def lint_metric_names(catalogue,
-                      severity: Severity = None) -> List[Diagnostic]:
+#: value-shape heuristics (applied when live samples are linted): a label
+#: value longer than this, or containing a path separator, is almost
+#: certainly a raw identifier rather than a bounded enum
+_MAX_LABEL_VALUE_LEN = 64
+#: distinct values per (metric, label key) before the series space is
+#: called unbounded
+_MAX_LABEL_CARDINALITY = 32
+
+
+def lint_metric_names(catalogue, severity: Severity = None,
+                      samples=None) -> List[Diagnostic]:
     """L005: validate metric names against the ``subsystem.noun_qualifier``
     contract (paddle_tpu.obs.metrics.METRIC_NAME_RE) plus the suffix-per-
-    kind conventions.
+    kind conventions, and flag unbounded-cardinality labels.
 
-    ``catalogue`` is a mapping ``name -> (kind, help)`` (the shape of
-    :data:`paddle_tpu.obs.CATALOGUE`), ``name -> kind``, or a plain
-    iterable of names (then only the shape is checked). Standalone on
-    purpose: metric names live in instrumented *code*, not Program IR, so
-    this lint is driven by the CLI and the obs test-suite rather than
-    ``lint_program``.
+    ``catalogue`` is a mapping ``name -> (kind, help[, labels])`` (the
+    shape of :data:`paddle_tpu.obs.CATALOGUE`), ``name -> kind``, or a
+    plain iterable of names (then only the shape is checked). Declared
+    label *keys* are checked against the known-unbounded set (a raw path
+    or task payload as a label value explodes the series space).
+
+    ``samples`` optionally takes live ``MetricsRegistry.collect()``
+    output; label *values* are then also checked — path-like or very long
+    values, and per-key cardinality beyond a bounded-enum's plausible
+    size, are flagged even when the key name looks innocent.
+
+    Standalone on purpose: metric names live in instrumented *code*, not
+    Program IR, so this lint is driven by the CLI and the obs test-suite
+    rather than ``lint_program``.
     """
     from ..obs.metrics import METRIC_NAME_RE   # lazy: keeps analysis light
     sev = severity if severity is not None else LINT_CATALOGUE["L005"][1]
@@ -209,10 +234,19 @@ def lint_metric_names(catalogue,
         items = []
         for name, spec in catalogue.items():
             kind = spec[0] if isinstance(spec, (tuple, list)) else spec
-            items.append((name, kind))
+            labels = (tuple(spec[2]) if isinstance(spec, (tuple, list))
+                      and len(spec) > 2 else ())
+            items.append((name, kind, labels))
     else:
-        items = [(name, None) for name in catalogue]
-    for name, kind in items:
+        items = [(name, None, ()) for name in catalogue]
+    for name, kind, labels in items:
+        for key in labels:
+            if key in _UNBOUNDED_LABEL_KEYS:
+                emit(f"label '{key}' on '{name}' is an unbounded-"
+                     "cardinality key (each distinct value mints a new "
+                     "series)", name,
+                     "put identifiers in span args/logs; keep labels to "
+                     "bounded enums (op, type, site, worker, ...)")
         if not METRIC_NAME_RE.match(name):
             emit(f"metric name '{name}' is not subsystem.noun_qualifier "
                  "(exactly one dot, snake_case atoms)", name,
@@ -228,6 +262,33 @@ def lint_metric_names(catalogue,
             emit(f"gauge '{name}' claims a suffix reserved for "
                  "counters/histograms", name,
                  "drop the suffix — a gauge is a point-in-time value")
+    if samples:
+        # live-sample pass: catch unbounded label VALUES the static
+        # catalogue can't see (a bounded-sounding key fed raw paths)
+        seen: Dict[tuple, Set[str]] = {}
+        flagged_val: Set[tuple] = set()
+        for s in samples:
+            if not isinstance(s, dict):
+                continue
+            mname = s.get("name", "?")
+            for key, value in (s.get("labels") or {}).items():
+                v = str(value)
+                if (key, mname) not in flagged_val and (
+                        len(v) > _MAX_LABEL_VALUE_LEN or "/" in v
+                        or "\\" in v):
+                    flagged_val.add((key, mname))
+                    emit(f"label '{key}' on '{mname}' carries a path-like "
+                         f"or oversized value ({v[:40]!r}...): unbounded "
+                         "cardinality", mname,
+                         "record the identifier in span args or logs, not "
+                         "a metric label")
+                seen.setdefault((mname, key), set()).add(v)
+        for (mname, key), values in sorted(seen.items()):
+            if len(values) > _MAX_LABEL_CARDINALITY:
+                emit(f"label '{key}' on '{mname}' has {len(values)} "
+                     f"distinct values (> {_MAX_LABEL_CARDINALITY}): "
+                     "series space looks unbounded", mname,
+                     "bucket the value or move it out of labels")
     return diags
 
 

@@ -79,9 +79,15 @@ def cmd_train(args):
     train_reader = cfg["train_reader"]
     srv = None
     obs_session = None
+    flight = None
+    pusher = None
     if getattr(args, "obs_out", None):
         from . import obs as _obs
         obs_session = _obs.ObsSession().install()
+        # crash flight recorder: until the clean save below runs, any
+        # death mode (SIGTERM, injected fault, uncaught exception) leaves
+        # the span ring + counter deltas at --obs_out for post-mortem
+        flight = _obs.FlightRecorder(obs_session, args.obs_out).arm()
     if getattr(args, "local_master", False):
         # One-binary bring-up (TrainerMain.cpp:32-49 --start_pserver analog):
         # self-host the ENTIRE data-dispatch cluster in this process — the
@@ -106,13 +112,31 @@ def cmd_train(args):
         print(f"local master: {len(paths)} chunks on "
               f"{srv.address[0]}:{srv.address[1]}")
         train_reader = cloud_reader(client, new_pass_at_end=True)
+        if obs_session is not None:
+            # exercise the real cluster-telemetry path even in the one-
+            # binary mode: this consumer obs_pushes its snapshots to the
+            # in-process master exactly as a remote worker would. Own
+            # fail-fast client: _call holds a per-client lock across its
+            # retry budget, so sharing the data-plane client would let a
+            # slow push stall the trainer's get_task behind it
+            from .obs.aggregate import ObsPusher, telemetry_client
+            pusher = ObsPusher(telemetry_client(*srv.address),
+                               worker=f"local-{os.getpid()}",
+                               interval=2.0).start()
     try:
         trainer.train(train_reader, num_passes=args.num_passes,
                       event_handler=handler, feeding=cfg.get("feeding"))
     finally:
         # dump FIRST: a failed run is exactly the one whose telemetry the
         # user asked for, and a server-teardown error must not discard it
+        if pusher is not None:
+            pusher.stop()
+            pusher.client.close()
         if obs_session is not None:
+            if flight is not None:
+                # clean(ish) exit: the full session dump below supersedes
+                # the ring; disarm so atexit can't overwrite it later
+                flight.disarm()
             obs_session.uninstall()
             try:
                 obs_session.save(args.obs_out)
@@ -613,26 +637,42 @@ def cmd_make_diagram(args):
     return 0
 
 
+def _read_obs_inputs(inputs):
+    """Load one or more JSONL dumps; several merge into the stitched
+    cluster view (per-process events keep their pids, metric series get
+    worker labels — obs.merge_dumps). Errors name the failing file."""
+    from . import obs
+    dumps = []
+    for p in inputs:
+        try:
+            dumps.append(obs.read_jsonl(p))
+        except (OSError, ValueError) as e:
+            raise OSError(f"{p}: {e}") from e
+    return dumps[0] if len(dumps) == 1 else obs.merge_dumps(dumps)
+
+
 def cmd_obs(args):
-    """``paddle_tpu obs`` — inspect/convert an observability dump (the
-    JSONL written by ``ObsSession.save`` / ``train --obs_out``):
+    """``paddle_tpu obs`` — inspect/convert observability dumps (the JSONL
+    written by ``ObsSession.save`` / ``train --obs_out`` / the flight
+    recorder). ``--input`` may repeat: several dumps merge into one
+    cluster view (distributed-trace stitching).
 
     * ``summary``: the human table (counters, gauges, histograms with
       p50/p99, span totals) — the ``StatSet.report()`` successor.
     * ``export --format=chrome``: Chrome ``trace_event`` JSON; load the
       file in Perfetto (ui.perfetto.dev) or chrome://tracing to see the
-      nested trainer -> checkpoint/rpc span timeline.
+      nested trainer -> checkpoint/rpc span timeline — with several
+      inputs, one lane per process plus client->server flow arrows.
     * ``export --format=prom``: Prometheus text exposition — serve it or
       drop it where a textfile collector scrapes.
     * ``export --format=jsonl``: normalized event stream (re-emits the
-      dump; useful to strip a corrupt tail).
+      dump; useful to strip a corrupt tail or persist a merge).
     """
     from . import obs
     try:
-        dump = obs.read_jsonl(args.input)
+        dump = _read_obs_inputs(args.input)
     except (OSError, ValueError) as e:
-        print(f"obs: cannot read dump {args.input!r}: "
-              f"{type(e).__name__}: {e}", file=sys.stderr)
+        print(f"obs: cannot read dump: {e}", file=sys.stderr)
         return 2
     if args.obs_cmd == "summary":
         print(obs.summary(dump))
@@ -654,6 +694,78 @@ def cmd_obs(args):
         print(f"wrote {args.output}")
     else:
         print(out, end="" if out.endswith("\n") else "\n")
+    return 0
+
+
+def cmd_obs_serve(args):
+    """``paddle_tpu obs serve`` — read-only HTTP view over dumps and/or a
+    live master's merged fleet metrics:
+
+    * ``/metrics`` — Prometheus text exposition (point a scraper here)
+    * ``/trace``   — Chrome trace_event JSON (load in Perfetto)
+    * ``/summary`` (and ``/``) — the human table
+
+    Sources re-read per request, so a dump being appended to (or a live
+    master) always serves its current state. ``--master host:port`` polls
+    ``MasterClient.obs_stats()`` — the worker-tagged merged registry the
+    ``obs_push`` RPC accumulates.
+    """
+    from . import obs
+    from .obs.aggregate import ObsHttpServer
+    inputs = list(args.input or ())
+    master = getattr(args, "master", None)
+    if not inputs and not master:
+        print("obs serve: pass --input dump.jsonl (repeatable) and/or "
+              "--master host:port", file=sys.stderr)
+        return 2
+    master_addr = None
+    if master:
+        # validate ONCE at startup: a malformed flag must be a clear exit-2
+        # here, not a ValueError 500ing every later scrape inside provider
+        host, _, port = master.rpartition(":")
+        try:
+            master_addr = (host.strip("[]") or "127.0.0.1", int(port))
+        except ValueError:
+            print(f"obs serve: --master must be host:port, got {master!r}",
+                  file=sys.stderr)
+            return 2
+
+    def provider():
+        dumps = [obs.read_jsonl(p) for p in inputs]
+        if master_addr is not None:
+            # fail-fast telemetry client — a down master must not wedge
+            # every scrape for the data plane's full retry budget
+            from .obs.aggregate import telemetry_client
+            client = telemetry_client(*master_addr)
+            try:
+                workers, samples = client.obs_stats()
+                dumps.append({"meta": {"process": "master",
+                                       "obs_workers": workers},
+                              "metrics": samples, "events": []})
+            except (OSError, ConnectionError) as e:
+                # keep serving whatever dumps we do have; a master-only
+                # serve surfaces the outage as a 500 with the cause
+                if not dumps:
+                    raise
+                print(f"obs serve: master {master} unreachable: {e}",
+                      file=sys.stderr)
+            finally:
+                client.close()
+        return dumps[0] if len(dumps) == 1 else obs.merge_dumps(dumps)
+
+    srv = ObsHttpServer(provider, host=args.host, port=args.port).start()
+    # machine-parseable address line first (port 0 binds an ephemeral one)
+    print(f"SERVING {srv.address[0]} {srv.address[1]}", flush=True)
+    print(f"  http://{srv.address[0]}:{srv.address[1]}/metrics  (prometheus)")
+    print(f"  http://{srv.address[0]}:{srv.address[1]}/trace    (chrome json)")
+    print(f"  http://{srv.address[0]}:{srv.address[1]}/summary")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
     return 0
 
 
@@ -778,17 +890,22 @@ def main(argv=None) -> int:
                          "(for inspection or external schedulers)")
     ct.set_defaults(fn=cmd_cluster_train)
 
-    ob = sub.add_parser("obs", help="inspect/convert an observability dump "
-                                    "(JSONL from ObsSession.save / "
-                                    "train --obs_out)")
+    ob = sub.add_parser("obs", help="inspect/convert/serve observability "
+                                    "dumps (JSONL from ObsSession.save / "
+                                    "train --obs_out / flight recorder)")
     obsub = ob.add_subparsers(dest="obs_cmd", required=True)
     os_ = obsub.add_parser("summary", help="human metric/span table "
                                            "(subsumes StatSet.report)")
-    os_.add_argument("--input", required=True,
-                     help="JSONL dump to summarize")
+    os_.add_argument("--input", required=True, action="append",
+                     help="JSONL dump to summarize (repeat to merge a "
+                          "multi-process run into one cluster view)")
     os_.set_defaults(fn=cmd_obs)
-    oe = obsub.add_parser("export", help="convert the dump for other tools")
-    oe.add_argument("--input", required=True, help="JSONL dump to convert")
+    oe = obsub.add_parser("export", help="convert the dump(s) for other "
+                                         "tools")
+    oe.add_argument("--input", required=True, action="append",
+                    help="JSONL dump to convert (repeat to merge: one "
+                         "Chrome lane per process + client->server flow "
+                         "arrows)")
     oe.add_argument("--format", choices=["chrome", "prom", "jsonl"],
                     default="chrome",
                     help="chrome: trace_event JSON for Perfetto; prom: "
@@ -796,6 +913,18 @@ def main(argv=None) -> int:
     oe.add_argument("--output", default=None,
                     help="output path (default: stdout)")
     oe.set_defaults(fn=cmd_obs)
+    osv = obsub.add_parser("serve", help="read-only HTTP endpoint: /metrics "
+                                         "(prometheus), /trace (chrome "
+                                         "json), /summary")
+    osv.add_argument("--input", action="append",
+                     help="JSONL dump(s) to serve (re-read per request)")
+    osv.add_argument("--master", default=None,
+                     help="host:port of a live MasterServer — serve its "
+                          "merged obs_push fleet view")
+    osv.add_argument("--host", default="127.0.0.1")
+    osv.add_argument("--port", type=int, default=0,
+                     help="0 binds an ephemeral port (printed on start)")
+    osv.set_defaults(fn=cmd_obs_serve)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=cmd_version)

@@ -184,6 +184,11 @@ class FaultPlan:
             if f.action == "delay":
                 time.sleep(f.delay_s)
             elif f.action == "raise":
+                # flight recorder (obs/flight.py): persist the span ring
+                # BEFORE the injected exception starts unwinding — even if
+                # a retry layer later swallows it and the process is then
+                # SIGKILLed, the chaos run's tail is already on disk
+                obs.flight_dump(f"fault:{site}")
                 raise self._make_exc(f, site)
         # truncate/corrupt rules at a fire-only site are authoring errors we
         # surface loudly instead of silently ignoring
@@ -200,6 +205,7 @@ class FaultPlan:
             if f.action == "delay":
                 time.sleep(f.delay_s)
             elif f.action == "raise":
+                obs.flight_dump(f"fault:{site}")
                 raise self._make_exc(f, site)
             elif f.action == "truncate":
                 cut = (f.truncate_to if f.truncate_to is not None
@@ -221,6 +227,7 @@ class FaultPlan:
             if f.action == "delay":
                 time.sleep(f.delay_s)
             elif f.action == "raise":
+                obs.flight_dump(f"fault:{site}")
                 raise self._make_exc(f, site)
             elif f.action == "corrupt":
                 value = (f.mutate(value) if f.mutate is not None

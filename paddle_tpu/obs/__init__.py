@@ -27,9 +27,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+from . import context
 from .catalogue import CATALOGUE, SPANS
-from .export import (chrome_trace, prometheus_text, read_jsonl, summary,
-                     write_jsonl)
+from .export import (chrome_trace, merge_dumps, prometheus_text, read_jsonl,
+                     summary, write_jsonl)
+from .flight import FlightRecorder
 from .metrics import (DEFAULT_BUCKETS, METRIC_NAME_RE, Counter, Gauge,
                       Histogram, MetricsRegistry)
 from .session import ObsSession
@@ -39,9 +41,11 @@ __all__ = [
     "ObsSession", "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "Tracer", "REGISTRY", "CATALOGUE", "SPANS", "METRIC_NAME_RE",
     "DEFAULT_BUCKETS", "chrome_trace", "prometheus_text", "summary",
-    "read_jsonl", "write_jsonl", "is_active", "session", "install",
-    "uninstall", "count", "gauge_set", "observe", "span", "instant",
-    "retry_observer", "NullSpan", "NULL_SPAN",
+    "read_jsonl", "write_jsonl", "merge_dumps", "is_active", "session",
+    "install", "uninstall", "count", "gauge_set", "observe", "span",
+    "instant", "server_span", "wire_context", "retry_observer",
+    "FlightRecorder", "flight_recorder", "flight_dump", "NullSpan",
+    "NULL_SPAN", "context",
 ]
 
 #: process-global default registry — what an installed session reports into
@@ -119,11 +123,57 @@ def span(name: str, metric: Optional[str] = None, metric_labels=None,
     return s.span(name, metric=metric, metric_labels=metric_labels, **attrs)
 
 
+def server_span(name: str, ctx, **attrs):
+    """Server-side handler span parented on a wire context (the ``trace``
+    key of an RPC envelope — obs/context.py). A malformed/absent context
+    degrades to a plain span; :data:`NULL_SPAN` when the plane is off."""
+    s = _SESSION
+    if s is None:
+        return NULL_SPAN
+    return s.span(name, remote=context.sanitize(ctx), **attrs)
+
+
+def wire_context(sp) -> Optional[dict]:
+    """The ``trace`` envelope value for a request issued inside span ``sp``
+    (as returned by :func:`span`); None when the plane is off — requests
+    then stay byte-identical to un-instrumented ones."""
+    if _SESSION is None:
+        return None
+    return context.wire_context(sp)
+
+
 def instant(name: str, **attrs) -> None:
     s = _SESSION
     if s is None:
         return
     s.tracer.instant(name, **attrs)
+
+
+# -- flight recorder plumbing ---------------------------------------------------
+
+#: the armed FlightRecorder; None = no tail capture (the fast path)
+_FLIGHT: Optional[FlightRecorder] = None
+
+
+def _set_flight(rec: Optional[FlightRecorder]) -> None:
+    global _FLIGHT
+    _FLIGHT = rec
+
+
+# named flight_recorder, NOT flight: the bare name would shadow the
+# paddle_tpu.obs.flight submodule attribute this package also exposes
+def flight_recorder() -> Optional[FlightRecorder]:
+    return _FLIGHT
+
+
+def flight_dump(reason: str, final: bool = False) -> Optional[str]:
+    """Dump the armed flight recorder's ring (no-op when none is armed) —
+    what :func:`paddle_tpu.faults.fire` calls just before an injected
+    raise and the trainer calls on preemption. Never raises."""
+    f = _FLIGHT
+    if f is None:
+        return None
+    return f.dump(reason, final=final)
 
 
 def retry_observer(subsystem: str):

@@ -1,0 +1,310 @@
+"""Cluster metrics aggregation: workers push, the master merges and serves.
+
+The missing multi-process half of PR 3: each process had its own registry
+and dump, but the system the paper describes is a trainer fleet plus a
+master — fleet-level telemetry (the Ascend field-study lesson, PAPERS.md
+arXiv 2607.08215) needs ONE merged view. Three pieces:
+
+* :class:`ClusterAggregator` — the master-side store. Workers push their
+  registry snapshots over the new ``obs_push`` RPC
+  (:meth:`MasterClient.obs_push`); the aggregator keeps the latest
+  snapshot per worker and serves the merged sample list with every series
+  label-tagged ``worker=<id>`` (the merged-registry label contract:
+  same-named series from different workers stay distinct series).
+* :class:`ObsPusher` — the worker-side background thread: every
+  ``interval`` seconds (and once at stop) it pushes the current registry
+  snapshot. Push failures are counted, never raised — telemetry must not
+  take down the training loop it observes.
+* :class:`ObsHttpServer` — a read-only HTTP endpoint (``paddle_tpu obs
+  serve``) exposing ``/metrics`` (Prometheus text), ``/trace`` (Chrome
+  JSON) and ``/summary`` over any dump provider — merged files on disk or
+  a live master's ``obs_stats``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from . import count as _count
+from . import gauge_set as _gauge_set
+
+#: sample fields the aggregator accepts off the wire — anything else is
+#: dropped (a worker running newer code must not smuggle unbounded junk
+#: into the master's memory)
+_SAMPLE_KEYS = frozenset((
+    "type", "name", "help", "labels", "value", "high_water",
+    "buckets", "sum", "count", "max", "delta"))
+_MAX_SAMPLES_PER_PUSH = 10_000
+
+
+def _clean_sample(s: Any) -> Optional[Dict[str, Any]]:
+    if not isinstance(s, dict) or not isinstance(s.get("name"), str):
+        return None
+    # every exporter keys on "type" and does arithmetic on the numeric
+    # fields — a sample that would crash a later /metrics render is
+    # dropped HERE, not stored (one bad push must not poison every scrape)
+    if s.get("type") not in ("counter", "gauge", "histogram"):
+        return None
+    out = {k: v for k, v in s.items() if k in _SAMPLE_KEYS}
+    for k in ("value", "high_water", "sum", "max", "delta"):
+        if k in out:
+            try:
+                out[k] = float(out[k])
+            except (TypeError, ValueError):
+                return None
+    if "count" in out:
+        try:
+            out["count"] = int(out["count"])
+        except (TypeError, ValueError):
+            return None
+    if "buckets" in out:
+        # exporters iterate [le, cumulative] pairs and do arithmetic on
+        # both; anything else would 500 every later scrape
+        try:
+            out["buckets"] = [
+                [le if le == "+Inf" else float(le), int(cum)]
+                for le, cum in out["buckets"]]
+        except (TypeError, ValueError):
+            return None
+    labels = out.get("labels")
+    out["labels"] = ({str(k): str(v) for k, v in labels.items()}
+                     if isinstance(labels, dict) else {})
+    return out
+
+
+def telemetry_client(host: str, port: int):
+    """Fail-fast MasterClient for telemetry traffic (pushes and scrapes):
+    ONE attempt, short socket deadline. Telemetry must never inherit the
+    data plane's 5-attempt backoff budget — a down master should cost a
+    scrape a few seconds, not wedge it (or a lock-sharing caller) for the
+    full retry window."""
+    from ..runtime.master_service import MasterClient
+    return MasterClient(host, int(port), retries=1, call_timeout=3.0)
+
+
+def wire_safe_samples(samples: Any) -> List[Any]:
+    """JSON-frame-safe copy of collect() samples: nonfinite floats become
+    the strings ``"NaN"``/``"+Inf"``/``"-Inf"`` — ``json.dumps`` would
+    otherwise emit bare ``NaN``/``Infinity`` tokens, which are not legal
+    JSON and which the native frame parser rejects (one inf gauge would
+    permanently fail a worker's pushes). The strings round-trip on the
+    receiving side: ``float("+Inf")``/``float("NaN")`` in
+    :func:`_clean_sample` restore the values."""
+    import math
+
+    def fix(v):
+        if isinstance(v, float) and not math.isfinite(v):
+            return "NaN" if math.isnan(v) else ("+Inf" if v > 0 else "-Inf")
+        return v
+
+    out: List[Any] = []
+    for s in samples:
+        if not isinstance(s, dict):
+            out.append(s)
+            continue
+        s = {k: fix(v) for k, v in s.items()}
+        try:
+            if isinstance(s.get("buckets"), list):
+                s["buckets"] = [[fix(le), cum] for le, cum in s["buckets"]]
+        except (TypeError, ValueError):
+            pass                      # malformed: the server will drop it
+        out.append(s)
+    return out
+
+
+class ClusterAggregator:
+    """Latest-snapshot-per-worker store behind the master's ``obs_push``.
+
+    ``ttl`` bounds both memory and staleness: worker ids embed pids, so a
+    chaos-churned fleet (preempt, restart, repeat for days) would
+    otherwise accumulate one frozen snapshot per dead incarnation forever.
+    A worker that stops pushing for ``ttl`` seconds ages out of the
+    merged view (and out of memory) on the next push or read.
+    """
+
+    def __init__(self, ttl: float = 900.0,
+                 clock: Optional[Callable[[], float]] = None):
+        import time
+        self.ttl = ttl
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        # worker -> (last_push_monotonic, cleaned samples)
+        self._snaps: Dict[str, Any] = {}
+
+    def _prune_locked(self) -> None:
+        cutoff = self._clock() - self.ttl
+        for wid in [w for w, (ts, _) in self._snaps.items() if ts < cutoff]:
+            del self._snaps[wid]
+
+    def push(self, worker: str, samples: Any) -> int:
+        """Replace ``worker``'s snapshot; returns the accepted count."""
+        if not isinstance(samples, (list, tuple)):
+            samples = []
+        cleaned = []
+        for s in samples[:_MAX_SAMPLES_PER_PUSH]:
+            c = _clean_sample(s)
+            if c is not None:
+                cleaned.append(c)
+        with self._lock:
+            self._snaps[str(worker)] = (self._clock(), cleaned)
+            self._prune_locked()
+            n_workers = len(self._snaps)
+        _gauge_set("master.obs_workers", n_workers)
+        return len(cleaned)
+
+    def workers(self) -> List[str]:
+        with self._lock:
+            self._prune_locked()
+            return sorted(self._snaps)
+
+    def merged_samples(self) -> List[Dict[str, Any]]:
+        """Live workers' samples, each tagged ``worker=<id>`` (an existing
+        worker label — a relayed merge — wins)."""
+        with self._lock:
+            self._prune_locked()
+            items = sorted((w, s) for w, (_, s) in self._snaps.items())
+        out: List[Dict[str, Any]] = []
+        for wid, samples in items:
+            for s in samples:
+                s = dict(s)
+                labels = dict(s.get("labels") or {})
+                labels.setdefault("worker", wid)
+                s["labels"] = labels
+                out.append(s)
+        return out
+
+
+class ObsPusher:
+    """Background worker->master snapshot pusher.
+
+    Args:
+      client: a :class:`~paddle_tpu.runtime.master_service.MasterClient`
+        (or anything with ``obs_push(worker, samples)``).
+      worker: this worker's id in the merged view.
+      registry: snapshot source; defaults to the installed session's
+        registry at each push (so a late-installed session still reports).
+      interval: seconds between pushes; the stop path pushes once more so
+        short runs still land their final counts.
+    """
+
+    def __init__(self, client, worker: str, registry=None,
+                 interval: float = 2.0):
+        self.client = client
+        self.worker = str(worker)
+        self.registry = registry
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _samples(self) -> Optional[List[Dict[str, Any]]]:
+        reg = self.registry
+        if reg is None:
+            from . import _SESSION   # read the live value at call time
+            reg = _SESSION.registry if _SESSION is not None else None
+        return reg.collect() if reg is not None else None
+
+    def push_once(self) -> bool:
+        samples = self._samples()
+        if samples is None:
+            return False
+        try:
+            self.client.obs_push(self.worker, samples)
+        except (OSError, ConnectionError):
+            # the master being down is a data-plane problem the retry
+            # layers already surface; telemetry just counts and moves on
+            _count("obs.push_failures_total")
+            return False
+        _count("obs.pushes_total")
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.push_once()
+
+    def start(self) -> "ObsPusher":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="obs-pusher")
+            self._thread.start()
+        return self
+
+    def stop(self, final_push: bool = True) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        if final_push:
+            self.push_once()
+
+
+class ObsHttpServer:
+    """Read-only HTTP view over a dump provider (``paddle_tpu obs serve``).
+
+    ``provider`` is called per request so the served view is always
+    current (re-reading dump files, or re-polling a live master). GET
+    only; any other method is 405; unknown paths 404.
+    """
+
+    ROUTES = ("/metrics", "/trace", "/summary", "/")
+
+    def __init__(self, provider: Callable[[], Dict[str, Any]],
+                 host: str = "127.0.0.1", port: int = 0):
+        import http.server
+
+        from .export import chrome_trace, prometheus_text, summary
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # tests stay quiet
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        body = prometheus_text(outer.provider()).encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif path == "/trace":
+                        body = json.dumps(
+                            chrome_trace(outer.provider())).encode()
+                        ctype = "application/json"
+                    elif path in ("/summary", "/"):
+                        body = (summary(outer.provider()) + "\n").encode()
+                        ctype = "text/plain"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:    # a torn dump must not kill serve
+                    # control chars stripped: the message lands in the
+                    # HTTP status line, and a hostile upstream error
+                    # string with CRLF would otherwise inject headers
+                    detail = "".join(
+                        ch for ch in f"{type(e).__name__}: {e}"[:200]
+                        if ch.isprintable())
+                    self.send_error(500, detail)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+
+        self.provider = provider
+        self._server = Server((host, port), Handler)
+        self.address = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ObsHttpServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="obs-serve")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -8,19 +8,24 @@ tests/test_obs.py asserts the table itself stays convention-clean.
 
 Kinds: ``counter`` (monotonic, suffix ``_total``), ``gauge`` (point-in-time,
 no reserved suffix), ``histogram`` (distributions, suffix ``_seconds`` /
-``_bytes``). Labels are listed where the emitter attaches any.
+``_bytes``). Metrics whose emitter attaches labels declare them as a third
+tuple element — the ``L005`` lint checks those label keys for unbounded
+cardinality (a raw path or task payload as a label value would explode the
+series space); the merged cluster view additionally tags every pushed
+series ``worker=<id>``.
 
 Span names (exported to Chrome trace_event; nesting by same-thread
-containment) are catalogued in :data:`SPANS`.
+containment, cross-process parenting by the spans' ``remote`` wire
+context) are catalogued in :data:`SPANS`.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Tuple
 
-#: name -> (kind, help). Keep sorted by subsystem; docs/design/observability.md
-#: renders this table verbatim.
-CATALOGUE: Dict[str, Tuple[str, str]] = {
+#: name -> (kind, help[, labels]). Keep sorted by subsystem;
+#: docs/design/observability.md renders this table verbatim.
+CATALOGUE: Dict[str, Tuple[str, ...]] = {
     # -- ckpt: trainer/checkpoint.py ------------------------------------
     "ckpt.saves_total": ("counter", "checkpoint pass dirs published"),
     "ckpt.bytes_total": ("counter", "member payload bytes written"),
@@ -47,7 +52,8 @@ CATALOGUE: Dict[str, Tuple[str, str]] = {
     # -- faults: faults/inject.py ---------------------------------------
     "faults.injected_total": ("counter", "faults fired, labels: site, "
                                          "action — a chaos run is "
-                                         "self-describing"),
+                                         "self-describing",
+                              ("site", "action")),
     # -- fluid: fluid/executor.py ---------------------------------------
     "fluid.runs_total": ("counter", "Executor.run invocations"),
     "fluid.cache_hits_total": ("counter", "compiled-fn cache hits"),
@@ -64,10 +70,36 @@ CATALOGUE: Dict[str, Tuple[str, str]] = {
     "lease.renews_total": ("counter", "lease renewals attempted"),
     "lease.renew_failures_total": ("counter", "renewals the server "
                                               "refused (lost lease)"),
+    # -- master: runtime/master_service.py (MasterServer._dispatch) -----
+    "master.requests_total": ("counter", "master RPCs dispatched through "
+                                         "the PYTHON control plane (obs "
+                                         "ops via the native fallback + "
+                                         "in-process calls; the C++ data "
+                                         "plane serves get_task et al. "
+                                         "uncounted), labels: type",
+                              ("type",)),
+    "master.request_errors_total": ("counter", "Python-dispatched master "
+                                               "RPCs answered with an "
+                                               "error (or raising), "
+                                               "labels: type", ("type",)),
+    "master.obs_workers": ("gauge", "distinct workers whose metric "
+                                    "snapshots the master currently holds"),
+    # -- coord: runtime/coord.py (CoordServer._dispatch) ----------------
+    "coord.requests_total": ("counter", "coord RPCs dispatched, "
+                                        "labels: type", ("type",)),
+    "coord.request_errors_total": ("counter", "coord RPCs answered with "
+                                              "an error (or raising), "
+                                              "labels: type", ("type",)),
+    # -- obs: obs/aggregate.py (worker-side pusher) ---------------------
+    "obs.pushes_total": ("counter", "registry snapshots pushed to the "
+                                    "master (obs_push RPC)"),
+    "obs.push_failures_total": ("counter", "obs_push RPCs that failed "
+                                           "(master unreachable)"),
     # -- rpc: runtime/master_service.py (_RpcClient, shared by coord) ---
-    "rpc.calls_total": ("counter", "RPC calls issued, labels: rpc, op"),
+    "rpc.calls_total": ("counter", "RPC calls issued, labels: rpc, op",
+                        ("rpc", "op")),
     "rpc.call_seconds": ("histogram", "end-to-end call latency incl. "
-                                      "retries, labels: rpc"),
+                                      "retries, labels: rpc", ("rpc",)),
     "rpc.retries_total": ("counter", "retry attempts across clients"),
     "rpc.giveups_total": ("counter", "retry budgets exhausted"),
     "rpc.backoff_seconds_total": ("counter", "total backoff delay slept"),
@@ -97,7 +129,12 @@ SPANS: Dict[str, str] = {
                           "(args: pass_id, reason)",
     "fluid.run": "Executor.run",
     "fluid.verify": "static pre-flight over the Program",
-    "rpc.call": "one RPC incl. retries (args: rpc, op)",
+    "rpc.call": "one RPC incl. retries (args: rpc, op); its (trace_id, "
+                "span_id) rides the request envelope as wire context",
+    "master.dispatch": "server-side handling of one master RPC (args: op; "
+                       "remote = the client's rpc.call span)",
+    "coord.dispatch": "server-side handling of one coord RPC (args: op; "
+                      "remote = the client's rpc.call span)",
     "ckpt.publish": "atomic pass-dir publication (args: pass_id)",
     "ckpt.member": "one member write+fsync (args: member, bytes)",
     "ckpt.fsync": "file or directory fsync",

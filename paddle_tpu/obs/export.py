@@ -83,6 +83,83 @@ def read_jsonl(path: str) -> Dict[str, Any]:
     return {"meta": meta, "metrics": metrics, "events": events}
 
 
+# -- multi-process merge --------------------------------------------------------
+
+def merge_dumps(dumps: Iterable[Dict[str, Any]],
+                workers: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Stitch per-process dumps into one cluster dump.
+
+    * events concatenate unchanged — each already carries its pid, and
+      cross-process edges ride the spans' ``remote`` fields;
+    * metric samples get a ``worker=<id>`` label (the merged-registry
+      label contract, docs/design/observability.md) so same-named series
+      from different processes stay distinct series. A sample that already
+      carries a ``worker`` label (e.g. the master re-exporting pushed
+      snapshots) keeps it.
+    * meta records the per-pid process names the Chrome exporter renders
+      as ``process_name`` lanes.
+
+    ``workers`` overrides the per-dump worker ids (default: the dump's
+    ``meta.process``, falling back to ``proc<N>``).
+
+    Known limitation: processes are keyed by OS pid (events and the wire
+    context's ``remote`` edges both carry bare pids), so merging dumps
+    from DIFFERENT HOSTS whose pids collide conflates those two lanes and
+    can mis-resolve a remote edge. Single-host jobs (and any set of dumps
+    with distinct pids) are unaffected; a cross-host deployment should
+    launch workers with distinct pid namespaces or merge per host.
+    """
+    dumps = list(dumps)
+    meta: Dict[str, Any] = {"merged": len(dumps), "processes": {}}
+    metrics: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    # per-process tracer clocks have private epochs; when EVERY dump maps
+    # its epoch to the wall clock (meta.clock_origin_unix), shift events
+    # onto one shared timeline so the stitched trace interleaves
+    # correctly. If any dump lacks the field (pre-ISSUE-4 artifact), no
+    # dump is shifted — mixing shifted and raw-epoch timestamps would
+    # interleave incomparable timebases — and the meta says so.
+    origins = [(d.get("meta") or {}).get("clock_origin_unix") for d in dumps]
+    if any(o is None for o in origins):
+        base = None
+        if len(dumps) > 1:
+            meta["clocks_unaligned"] = True
+    else:
+        base = min(origins)
+    for i, d in enumerate(dumps):
+        m = d.get("meta") or {}
+        shift = (origins[i] - base
+                 if base is not None and origins[i] is not None else 0.0)
+        wid = (workers[i] if workers is not None and i < len(workers)
+               else None) or m.get("process") or f"proc{i}"
+        wid = str(wid)
+        # a dump that is ITSELF a merge carries a processes map — keep
+        # those identities so re-merging a persisted merge (export
+        # --format=jsonl) doesn't collapse its lanes to "proc<N>"
+        inner = m.get("processes") or {}
+        for k, v in inner.items():
+            meta["processes"].setdefault(str(k), str(v))
+        if m.get("pid") is not None:
+            meta["processes"].setdefault(str(m["pid"]), wid)
+        if m.get("trace_id") and "trace_id" not in meta:
+            meta["trace_id"] = m["trace_id"]
+        for s in d.get("metrics", ()):
+            s = dict(s)
+            labels = dict(s.get("labels") or {})
+            labels.setdefault("worker", wid)
+            s["labels"] = labels
+            metrics.append(s)
+        for e in d.get("events", ()):
+            if shift:
+                e = dict(e, ts=e.get("ts", 0.0) + shift)
+            events.append(e)
+            p = e.get("pid")
+            if p is not None and str(p) not in meta["processes"]:
+                meta["processes"][str(p)] = wid
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"meta": meta, "metrics": metrics, "events": events}
+
+
 # -- Chrome trace_event ---------------------------------------------------------
 
 def chrome_trace(dump: Dict[str, Any]) -> Dict[str, Any]:
@@ -92,29 +169,71 @@ def chrome_trace(dump: Dict[str, Any]) -> Dict[str, Any]:
     same-tid events by containment, which matches the tracer's per-thread
     parent stacks. Counters ride as ``ph:"C"`` tracks stamped at the trace
     end so the final tally is visible on the timeline.
+
+    Multi-process dumps (see :func:`merge_dumps`) get one ``process_name``
+    metadata row per distinct pid (named from ``meta.processes`` /
+    ``meta.process``) and a flow arrow (``ph:"s"``/``"f"``) for every span
+    carrying a ``remote`` cross-process parent whose client span is also
+    in the dump — the trainer→wire→master stitch in Perfetto.
     """
     events = dump.get("events", [])
+    meta = dump.get("meta") or {}
     pid = None
     t_end = 0.0
     out: List[Dict[str, Any]] = []
+    seen_pids: List[int] = []
+    # (pid, span id) -> span event, for resolving remote parent edges
+    by_id: Dict[Any, Dict[str, Any]] = {}
+    flows: List[Dict[str, Any]] = []
     for e in events:
         pid = e.get("pid", pid)
+        if e.get("pid") is not None and e["pid"] not in seen_pids:
+            seen_pids.append(e["pid"])
         ts_us = e["ts"] * 1e6
         if e["kind"] == "span":
             dur_us = e.get("dur", 0.0) * 1e6
             t_end = max(t_end, ts_us + dur_us)
+            args = dict(e.get("args") or {})
+            if e.get("remote"):
+                args["remote_parent"] = e["remote"]
+                flows.append(e)
+            if e.get("id") is not None:
+                by_id[(e.get("pid", 0), e["id"])] = e
             out.append({"name": e["name"], "ph": "X", "ts": ts_us,
                         "dur": dur_us, "pid": e.get("pid", 0),
                         "tid": e.get("tid", 0),
                         "cat": e["name"].split(".", 1)[0],
-                        "args": e.get("args") or {}})
+                        "args": args})
         else:
             t_end = max(t_end, ts_us)
             out.append({"name": e["name"], "ph": "i", "ts": ts_us, "s": "t",
                         "pid": e.get("pid", 0), "tid": e.get("tid", 0),
                         "cat": e["name"].split(".", 1)[0],
                         "args": e.get("args") or {}})
-    pid = pid if pid is not None else 0
+    # flow arrows: client rpc.call slice -> server dispatch slice. Emitted
+    # only when BOTH ends are present (a single-process dump has no arrow
+    # to draw; the remote_parent arg above still names the edge).
+    for e in flows:
+        r = e["remote"]
+        src = by_id.get((r.get("pid"), r.get("span")))
+        if src is None:
+            continue
+        fid = f"{r.get('pid')}:{r.get('span')}:{e.get('pid', 0)}:{e['id']}"
+        # bind the start step just inside the client slice so Chrome
+        # attaches it to that slice, and the finish to the server slice
+        flow_common = {"name": "rpc", "cat": "rpc", "id": fid}
+        flows_ts = src["ts"] * 1e6 + min(1.0, src.get("dur", 0.0) * 1e6 / 2)
+        out.append({**flow_common, "ph": "s", "ts": flows_ts,
+                    "pid": src.get("pid", 0), "tid": src.get("tid", 0)})
+        out.append({**flow_common, "ph": "f", "bp": "e",
+                    "ts": e["ts"] * 1e6 + min(1.0, e.get("dur", 0.0) * 1e6 / 2),
+                    "pid": e.get("pid", 0), "tid": e.get("tid", 0)})
+    pid = pid if pid is not None else meta.get("pid", 0)
+    # merged dumps: land each worker's counter tracks in that worker's OWN
+    # process lane (meta.processes maps pid -> worker name; invert it)
+    worker_pid = {str(v): int(k)
+                  for k, v in (meta.get("processes") or {}).items()
+                  if str(k).isdigit()}
     for s in dump.get("metrics", ()):
         if s.get("type") != "counter":
             continue
@@ -122,12 +241,23 @@ def chrome_trace(dump: Dict[str, Any]) -> Dict[str, Any]:
         if s.get("labels"):
             inner = ",".join(f"{k}={v}" for k, v in sorted(s["labels"].items()))
             label += f"{{{inner}}}"
-        out.append({"name": label, "ph": "C", "ts": t_end, "pid": pid,
+        c_pid = worker_pid.get(str((s.get("labels") or {}).get("worker")),
+                               pid)
+        out.append({"name": label, "ph": "C", "ts": t_end, "pid": c_pid,
                     "tid": 0, "args": {"value": s.get("value", 0)}})
-    out.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-                "args": {"name": "paddle_tpu"}})
+    # one process_name lane per pid — the single-pid case keeps its row too
+    names = {str(k): str(v)
+             for k, v in (meta.get("processes") or {}).items()}
+    if not seen_pids:
+        seen_pids = [pid]
+    for p in seen_pids:
+        name = names.get(str(p)) or (
+            meta.get("process") if len(seen_pids) == 1 else None) or \
+            f"paddle_tpu pid {p}"
+        out.append({"name": "process_name", "ph": "M", "pid": p, "tid": 0,
+                    "args": {"name": name}})
     return {"traceEvents": out, "displayTimeUnit": "ms",
-            "otherData": dump.get("meta") or {}}
+            "otherData": meta}
 
 
 # -- Prometheus text format -----------------------------------------------------
@@ -136,8 +266,18 @@ def _prom_name(name: str) -> str:
     return "paddle_tpu_" + name.replace(".", "_")
 
 
+def _prom_escape(value: Any) -> str:
+    """Label-value escaping per the Prometheus exposition spec: backslash,
+    double-quote and newline must be escaped or the line is unparseable
+    (a label value holding a path with a quote silently corrupted the
+    whole scrape before this)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(labels: Dict[str, Any], extra: Optional[str] = None) -> str:
-    parts = [f'{k}="{v}"' for k, v in sorted((labels or {}).items())]
+    parts = [f'{k}="{_prom_escape(v)}"'
+             for k, v in sorted((labels or {}).items())]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""

@@ -52,7 +52,11 @@ class Metric:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        # RLock (not Lock): the flight recorder's signal-handler dump
+        # collects these on the main thread, which may itself be paused
+        # inside a mutation's critical section — re-entry must not
+        # deadlock the dying process (see obs/flight.py)
+        self._lock = threading.RLock()
 
 
 class Counter(Metric):
@@ -226,7 +230,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()   # signal-safe: see Metric._lock
 
     def _get(self, cls, name: str, help: str, **kw) -> Metric:
         if not METRIC_NAME_RE.match(name):

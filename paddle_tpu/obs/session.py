@@ -28,16 +28,28 @@ class ObsSession:
       tracer: span collector; defaults to a new :class:`Tracer`.
       clock: convenience — forwarded to a default-constructed tracer so
         ``ObsSession(clock=fake)`` is enough for deterministic spans.
+      process: human name for this process in merged multi-process views
+        (the Chrome ``process_name`` lane, the merged-registry ``worker``
+        label default). Defaults to ``PADDLE_TPU_OBS_PROCESS`` or a
+        ``<script>:<pid>`` tag.
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  tracer: Optional[Tracer] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 process: Optional[str] = None):
         if registry is None:
             from . import REGISTRY
             registry = REGISTRY
         self.registry = registry
         self.tracer = tracer or Tracer(clock=clock)
+        if process is None:
+            import os
+            import sys
+            process = os.environ.get("PADDLE_TPU_OBS_PROCESS") or (
+                f"{os.path.basename(sys.argv[0] or 'python')}:"
+                f"{self.tracer.pid}")
+        self.process = process
 
     # -- lifecycle ----------------------------------------------------------
     def install(self) -> "ObsSession":
@@ -59,22 +71,36 @@ class ObsSession:
 
     # -- recording ----------------------------------------------------------
     def span(self, name: str, metric: Optional[str] = None,
-             metric_labels: Optional[Dict[str, Any]] = None, **attrs):
+             metric_labels: Optional[Dict[str, Any]] = None,
+             remote: Optional[Dict[str, Any]] = None, **attrs):
         """Trace span; ``metric=`` additionally lands the duration in that
-        histogram (one timing source for both views, same clock)."""
-        sp = self.tracer.span(name, **attrs)
+        histogram (one timing source for both views, same clock);
+        ``remote=`` records a cross-process parent (obs/context.py)."""
+        sp = self.tracer.span(name, remote=remote, **attrs)
         if metric is None:
             return sp
         return _MeteredSpan(sp, self.registry, metric, metric_labels)
 
     # -- output -------------------------------------------------------------
-    def dump(self) -> Dict[str, Any]:
-        """The canonical export shape (see obs/export.py)."""
-        meta = {"created_unix": time.time(), "pid": self.tracer.pid}
+    def meta(self) -> Dict[str, Any]:
+        """The dump's identity block — ONE implementation shared by
+        :meth:`dump` and the flight recorder so the two artifact schemas
+        cannot drift."""
+        from .context import trace_id
+        meta = {"created_unix": time.time(), "pid": self.tracer.pid,
+                "process": self.process, "trace_id": trace_id(),
+                # maps this tracer's (monotonic) span timestamps onto the
+                # wall clock so merge_dumps can align processes: a span at
+                # ts T happened at unix time clock_origin_unix + T
+                "clock_origin_unix": time.time() - self.tracer.clock()}
         if self.tracer.dropped:
             # the trace is truncated at max_events; say so in the artifact
             meta["events_dropped"] = self.tracer.dropped
-        return {"meta": meta,
+        return meta
+
+    def dump(self) -> Dict[str, Any]:
+        """The canonical export shape (see obs/export.py)."""
+        return {"meta": self.meta(),
                 "metrics": self.registry.collect(),
                 "events": self.tracer.snapshot()}
 
@@ -109,3 +135,8 @@ class _MeteredSpan:
         self._registry.histogram(self._metric).observe(
             self._span.duration, **self._labels)
         return out
+
+    @property
+    def id(self):
+        """Underlying span id — what wire context stamps into requests."""
+        return self._span.id

@@ -22,10 +22,11 @@ JSONL dump, the Chrome exporter and test assertions all read one format.
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 
 class Tracer:
@@ -39,15 +40,25 @@ class Tracer:
     everything."""
 
     def __init__(self, clock: Optional[Callable[[], float]] = None,
-                 max_events: int = 250_000):
+                 max_events: int = 250_000, ring_size: int = 0):
         self.clock = clock or time.perf_counter
         self.events: List[Dict[str, Any]] = []
         self.max_events = max_events
         self.dropped = 0
-        self._lock = threading.Lock()
+        # RLock, not Lock: the flight recorder's SIGTERM handler runs on
+        # the main thread and snapshots this tracer — if the signal lands
+        # while that same thread is inside _record's critical section, a
+        # plain Lock would deadlock the dying process instead of dumping
+        self._lock = threading.RLock()
         self._local = threading.local()
         self._next_id = 1
         self.pid = os.getpid()
+        #: flight-recorder tail (obs/flight.py): a bounded deque of the LAST
+        #: ring_size events — the main list keeps the run's *beginning* when
+        #: it fills, the ring keeps its *end*, which is what a post-mortem
+        #: wants. None (the default) costs one is-None check per record.
+        self.ring: Optional[Deque[Dict[str, Any]]] = (
+            collections.deque(maxlen=ring_size) if ring_size else None)
 
     # -- internals ----------------------------------------------------------
     def _stack(self) -> List[int]:
@@ -64,15 +75,24 @@ class Tracer:
 
     def _record(self, ev: Dict[str, Any]) -> None:
         with self._lock:
+            if self.ring is not None:
+                # the ring ALWAYS appends (evicting its oldest) — a crash
+                # after max_events must still leave the final spans behind
+                self.ring.append(ev)
             if len(self.events) >= self.max_events:
                 self.dropped += 1
                 return
             self.events.append(ev)
 
     # -- recording ----------------------------------------------------------
-    def span(self, name: str, **attrs) -> "_Span":
-        """Context manager recording one interval event on exit."""
-        return _Span(self, name, attrs)
+    def span(self, name: str, remote: Optional[Dict[str, Any]] = None,
+             **attrs) -> "_Span":
+        """Context manager recording one interval event on exit.
+
+        ``remote`` is a sanitized wire context (obs/context.py): the span
+        event then carries a ``remote`` field naming its cross-process
+        parent — the client span the request travelled in."""
+        return _Span(self, name, attrs, remote=remote)
 
     def instant(self, name: str, **attrs) -> None:
         """Point event (the trace analog of a log line)."""
@@ -81,6 +101,13 @@ class Tracer:
                       "tid": threading.get_ident(), "pid": self.pid,
                       "parent": stack[-1] if stack else None,
                       "args": attrs or {}})
+
+    def enable_ring(self, ring_size: int) -> None:
+        """(Re)size the flight-recorder tail; 0 disables it."""
+        with self._lock:
+            self.ring = (collections.deque(self.ring or (),
+                                           maxlen=ring_size)
+                         if ring_size else None)
 
     # -- reading ------------------------------------------------------------
     def spans(self) -> List[Dict[str, Any]]:
@@ -91,9 +118,15 @@ class Tracer:
         with self._lock:
             return list(self.events)
 
+    def ring_snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self.ring) if self.ring is not None else []
+
     def reset(self) -> None:
         with self._lock:
             self.events.clear()
+            if self.ring is not None:
+                self.ring.clear()
             self.dropped = 0
 
 
@@ -101,14 +134,17 @@ class _Span:
     """One live span; records its event when the ``with`` block exits, so a
     span that raises still lands in the trace (with ``error`` noted)."""
 
-    __slots__ = ("_tracer", "name", "attrs", "id", "parent", "_t0", "_dur")
+    __slots__ = ("_tracer", "name", "attrs", "id", "parent", "remote",
+                 "_t0", "_dur")
 
-    def __init__(self, tracer: Tracer, name: str, attrs: Dict[str, Any]):
+    def __init__(self, tracer: Tracer, name: str, attrs: Dict[str, Any],
+                 remote: Optional[Dict[str, Any]] = None):
         self._tracer = tracer
         self.name = name
         self.attrs = attrs
         self.id = tracer._new_id()
         self.parent: Optional[int] = None
+        self.remote = remote
         self._t0 = 0.0
         self._dur: Optional[float] = None
 
@@ -132,11 +168,14 @@ class _Span:
         args = dict(self.attrs)
         if exc_type is not None:
             args["error"] = exc_type.__name__
-        self._tracer._record({
+        ev = {
             "kind": "span", "name": self.name, "ts": self._t0,
             "dur": self._dur, "tid": threading.get_ident(),
             "pid": self._tracer.pid, "id": self.id, "parent": self.parent,
-            "args": args})
+            "args": args}
+        if self.remote is not None:
+            ev["remote"] = self.remote
+        self._tracer._record(ev)
         return False
 
     @property

@@ -48,6 +48,12 @@ from .master_service import _recv_msg, _RpcClient, _send_msg
 class CoordServer:
     """In-memory lease/fence/blob coordination service (etcd stand-in)."""
 
+    #: requests_total `type` label values — arbitrary op strings off the
+    #: wire clamp to "unknown" so a peer cannot mint unbounded series
+    _KNOWN_OPS = frozenset({
+        "lease_acquire", "lease_renew", "lease_release", "lease_holder",
+        "fence_claim", "blob_put", "blob_get", "fence_recorded", "ping"})
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._lock = threading.Lock()
         # name -> (owner, expires_at_monotonic, token)
@@ -85,6 +91,26 @@ class CoordServer:
 
     # -- ops (all under one lock: every read-check-write is atomic) ---------
     def _dispatch(self, req):
+        op = str(req.get("op"))
+        label = op if op in self._KNOWN_OPS else "unknown"
+        obs.count("coord.requests_total", type=label)
+        # server-side span parented on the client's rpc.call wire context —
+        # the same cross-process edge MasterServer._dispatch records
+        try:
+            with obs.server_span("coord.dispatch", req.get("trace"), op=op):
+                resp = self._dispatch_op(req)
+        except Exception:
+            # malformed requests (missing field, bad type) must land in
+            # the error counter even though the exception severs the conn
+            obs.count("coord.request_errors_total", type=label)
+            raise
+        # key on the error FIELD (the master-dispatch rule): an ok=true
+        # answer with renewed/acquired/claimed=false is a normal outcome
+        if resp.get("error") is not None:
+            obs.count("coord.request_errors_total", type=label)
+        return resp
+
+    def _dispatch_op(self, req):
         op = req.get("op")
         with self._lock:
             if op == "lease_acquire":

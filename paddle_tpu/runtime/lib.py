@@ -12,6 +12,13 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
+#: signature of the unknown-op fallback (master_server.cc ptms_set_fallback):
+#: (request bytes, length, opaque reply handle) -> None; the callback
+#: answers via ptms_reply(handle, data, len) before returning. Callers must
+#: keep the CFUNCTYPE instance alive while the server runs.
+PTMS_FALLBACK_FN = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_char),
+                                    ctypes.c_int, ctypes.c_void_p)
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SO = os.path.join(_NATIVE_DIR, "libpaddle_tpu_host.so")
 # wheel installs ship the .so inside the package (setup.py copies it here;
@@ -96,6 +103,8 @@ def _configure(lib: ctypes.CDLL):
     lib.ptms_port.restype = c.c_int
     lib.ptms_port.argtypes = [c.c_void_p]
     lib.ptms_set_fenced.argtypes = [c.c_void_p, c.c_int]
+    lib.ptms_set_fallback.argtypes = [c.c_void_p, PTMS_FALLBACK_FN]
+    lib.ptms_reply.argtypes = [c.c_void_p, c.POINTER(c.c_char), c.c_int]
     lib.ptms_stop.argtypes = [c.c_void_p]
     # recordio
     lib.ptr_writer_open.restype = c.c_void_p

@@ -142,6 +142,11 @@ class MasterServer:
         self.address: Tuple[str, int] = (host, port)
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # cluster telemetry home: workers obs_push their registry
+        # snapshots here; obs_stats serves the merged, worker-tagged view
+        from ..obs.aggregate import ClusterAggregator
+        self.aggregator = ClusterAggregator()
+        self._fallback_cb = None  # keepalive for the ctypes callback
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -192,6 +197,32 @@ class MasterServer:
         self._srv_h = h
         self._lib = lib
         self.address = (self._host, out_port.value)
+        # ops the native dispatch does not know (obs_push/obs_stats and
+        # anything future) fall back into Python's _dispatch: the C++
+        # handler hands us the raw frame, we reply via ptms_reply. The
+        # CFUNCTYPE object must outlive the server (ctypes keepalive).
+        # Registration happens a few lines after ptms_start begins
+        # accepting; in-repo clients only learn the port after start()
+        # returns, and a fixed-port client racing the window just gets one
+        # "unknown op" answer (raised by obs_push, retried by ObsPusher).
+        from .lib import PTMS_FALLBACK_FN
+
+        def _fallback(buf, n, reply):
+            try:
+                req = json.loads(ctypes.string_at(buf, n).decode())
+                resp = self._dispatch(req) if isinstance(req, dict) else \
+                    {"ok": False, "error": "bad request"}
+            except Exception as e:   # never let an exception cross into C++
+                resp = {"ok": False,
+                        "error": f"{type(e).__name__}: {e}"}
+            try:
+                data = json.dumps(resp).encode()
+            except (TypeError, ValueError):
+                data = b'{"ok": false, "error": "unserializable response"}'
+            lib.ptms_reply(reply, data, len(data))
+
+        self._fallback_cb = PTMS_FALLBACK_FN(_fallback)
+        lib.ptms_set_fallback(h, self._fallback_cb)
         # push the initial fencing state before any request can mutate
         lib.ptms_set_fenced(h, 1 if self._fenced_out() else 0)
         hk = threading.Thread(target=self._housekeeping, daemon=True)
@@ -284,16 +315,56 @@ class MasterServer:
     _MUTATING_OPS = frozenset(
         {"set_dataset", "get_task", "task_finished", "task_failed",
          "new_pass"})
+    #: ops allowed as the requests_total `type` label value — anything
+    #: else (arbitrary strings off the wire, since the native server
+    #: forwards every unknown op here) is clamped to "unknown" so a
+    #: hostile/buggy peer cannot mint unbounded counter series (the
+    #: failure mode our own L005 cardinality lint flags)
+    _KNOWN_OPS = _MUTATING_OPS | frozenset({"stats", "obs_push",
+                                            "obs_stats"})
 
     # -- dispatch ----------------------------------------------------------
     # The network path dispatches in C++ (master_server.cc, byte-identical
-    # protocol); this Python twin is the readable protocol reference and the
-    # in-process entry the fencing tests drive directly.
+    # protocol) for the hot data-plane ops; unknown ops (obs_push,
+    # obs_stats) fall back here via ptms_set_fallback. This Python twin is
+    # also the readable protocol reference and the in-process entry the
+    # fencing tests drive directly.
     def _dispatch(self, req):
+        op = str(req.get("op"))
+        label = op if op in self._KNOWN_OPS else "unknown"
+        obs.count("master.requests_total", type=label)
+        # server-side span parented on the client's rpc.call via the wire
+        # context — the cross-process edge the merged Chrome trace stitches
+        try:
+            with obs.server_span("master.dispatch", req.get("trace"), op=op):
+                resp = self._dispatch_op(req)
+        except Exception:
+            # a malformed request (missing field, bad type) is exactly
+            # what the error counter exists to surface
+            obs.count("master.request_errors_total", type=label)
+            raise
+        # key on the error FIELD, not ok alone: new_pass answers
+        # {"ok": false} with no error when the pass simply isn't finished
+        # — routine polling must not read as an error stream
+        if resp.get("error") is not None:
+            obs.count("master.request_errors_total", type=label)
+        return resp
+
+    def _dispatch_op(self, req):
         op = req.get("op")
         if op in self._MUTATING_OPS and self._fenced_out():
             return {"ok": False,
                     "error": f"fenced: stale master token {self.fence_token}"}
+        if op == "obs_push":
+            # telemetry is read-only w.r.t. task state: accepted even from
+            # a fenced master's clients (the fleet view must survive
+            # failover windows)
+            n = self.aggregator.push(str(req.get("worker", "?")),
+                                     req.get("samples"))
+            return {"ok": True, "accepted": n}
+        if op == "obs_stats":
+            return {"ok": True, "workers": self.aggregator.workers(),
+                    "samples": self.aggregator.merged_samples()}
         if op == "set_dataset":
             self.master.set_dataset(req["payloads"])
             return {"ok": True}
@@ -412,9 +483,16 @@ class _RpcClient:
         with self._lock, \
                 obs.span("rpc.call", metric="rpc.call_seconds",
                          metric_labels={"rpc": self._rpc_name},
-                         rpc=self._rpc_name, op=req.get("op")):
+                         rpc=self._rpc_name, op=req.get("op")) as sp:
             obs.count("rpc.calls_total", rpc=self._rpc_name,
                       op=str(req.get("op")))
+            # distributed tracing: stamp this span's identity into the
+            # envelope so the server parents its dispatch span on it. None
+            # when no session is installed — the wire bytes then stay
+            # identical to an un-instrumented client's (obs/context.py)
+            ctx = obs.wire_context(sp)
+            if ctx is not None:
+                req = dict(req, trace=ctx)
             try:
                 return self.policy.call(
                     self._call_once, req,
@@ -463,3 +541,26 @@ class MasterClient(_RpcClient):
     def stats(self):
         r = self._call({"op": "stats"})
         return (r["todo"], r["pending"], r["done"], r["discarded"], r["epoch"])
+
+    # -- cluster telemetry (obs plane) -------------------------------------
+    def obs_push(self, worker: str, samples) -> int:
+        """Push this worker's metric snapshot (``MetricsRegistry.collect()``
+        samples) to the master's aggregator; returns the accepted count.
+        An ok=false answer (e.g. a server whose dispatch predates obs_push)
+        raises, so ObsPusher counts it as a push failure, not a success."""
+        from ..obs.aggregate import wire_safe_samples
+        r = self._call({"op": "obs_push", "worker": str(worker),
+                        "samples": wire_safe_samples(list(samples))})
+        if not r.get("ok"):
+            raise ConnectionError(
+                f"obs_push rejected: {r.get('error', 'unknown error')}")
+        return int(r.get("accepted", 0))
+
+    def obs_stats(self):
+        """The merged fleet view: ``(workers, samples)`` where every sample
+        carries a ``worker=<id>`` label (the merged-registry contract)."""
+        r = self._call({"op": "obs_stats"})
+        if not r.get("ok"):
+            raise ConnectionError(
+                f"obs_stats rejected: {r.get('error', 'unknown error')}")
+        return list(r.get("workers", ())), list(r.get("samples", ()))

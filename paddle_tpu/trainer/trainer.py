@@ -246,6 +246,9 @@ class Trainer:
         self._mirror(name, n)
 
     def _checkpoint_preempted(self, pass_id, batch_id, params, opt_state):
+        # the flight ring first (no-op unless armed): if the checkpoint
+        # write itself dies, the post-mortem still shows the final batches
+        obs.flight_dump("preemption")
         if self.output_dir:
             with obs.span("trainer.checkpoint", pass_id=pass_id,
                           reason="preemption"):

@@ -1,0 +1,111 @@
+"""Subprocess node for the distributed-tracing chaos e2e
+(tests/test_obs_distributed.py) — one script, two roles:
+
+* ``master OUT DONE_FILE`` — serve a real MasterServer (native dispatch +
+  Python fallback for obs ops) over a tiny chunked dataset, print
+  ``ADDR <host> <port>``, then wait for DONE_FILE and save a clean obs
+  dump to OUT.
+* ``worker OUT HOST PORT`` — train from the master via cloud_reader with
+  an armed flight recorder and a fault plan that RAISES mid-pass: the
+  process dies with the pass unfinished and the flight dump at OUT is all
+  that survives — exactly the artifact the test stitches with the
+  master's dump.
+
+Both roles share one trace id via PADDLE_TPU_TRACE_ID (set by the test).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def run_master(out, done_file):
+    os.environ.setdefault("PADDLE_TPU_OBS_PROCESS", "master")
+    from paddle_tpu import obs
+    from paddle_tpu.data.chunks import dump_to_chunks
+    from paddle_tpu.runtime.master_service import MasterServer
+
+    session = obs.ObsSession(registry=obs.MetricsRegistry()).install()
+    rec = obs.FlightRecorder(session, out).arm()
+
+    rs = np.random.RandomState(0)
+
+    def samples():
+        for _ in range(24):
+            yield (rs.randn(4).astype(np.float32),
+                   rs.randn(1).astype(np.float32))
+
+    chunk_dir = os.path.join(os.path.dirname(out), "chunks")
+    paths = dump_to_chunks(samples, chunk_dir, samples_per_chunk=4)
+    srv = MasterServer().start()
+    srv._dispatch({"op": "set_dataset", "payloads": paths})
+    print(f"ADDR {srv.address[0]} {srv.address[1]}", flush=True)
+    deadline = time.time() + 120
+    while not os.path.exists(done_file) and time.time() < deadline:
+        time.sleep(0.1)
+    srv.stop()
+    rec.disarm()
+    session.uninstall()
+    session.save(out)
+
+
+def run_worker(out, host, port):
+    os.environ.setdefault("PADDLE_TPU_OBS_PROCESS", "worker-0")
+    import jax.numpy as jnp
+
+    from paddle_tpu import faults, obs
+    from paddle_tpu.data.chunks import cloud_reader
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.runtime.master_service import MasterClient
+    from paddle_tpu.trainer import Trainer
+
+    session = obs.ObsSession(registry=obs.MetricsRegistry()).install()
+    obs.FlightRecorder(session, out).arm()
+
+    client = MasterClient(host, int(port))
+    # one explicit snapshot push before training: guarantees a client
+    # rpc.call span whose server-side master.dispatch peer lands in the
+    # master's dump even though the crash below cuts the run short
+    client.obs_push("worker-0", session.registry.collect())
+
+    raw = cloud_reader(client)
+
+    def batches():
+        buf = []
+        for s in raw():
+            buf.append(s)
+            if len(buf) == 4:
+                yield (np.stack([b[0] for b in buf]),
+                       np.stack([b[1] for b in buf]))
+                buf = []
+
+    def loss(params, x, y):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    # the chaos: the 3rd batch's loss hook raises -> uncaught -> the
+    # process dies mid-pass; the flight recorder's excepthook (and the
+    # faults-plane pre-raise dump) leave OUT behind
+    plan = faults.FaultPlan().add("step.grad", "raise", nth=3)
+    plan.install()
+    t = Trainer(loss, SGD(0.1))
+    t.train(batches, {"w": np.zeros((4, 1), np.float32)}, num_passes=1,
+            handle_signals=False)
+    raise SystemExit("unreachable: the injected fault should have killed us")
+
+
+def main():
+    role = sys.argv[1]
+    if role == "master":
+        run_master(sys.argv[2], sys.argv[3])
+    elif role == "worker":
+        run_worker(sys.argv[2], sys.argv[3], sys.argv[4])
+    else:
+        raise SystemExit(f"unknown role {role!r}")
+
+
+if __name__ == "__main__":
+    main()

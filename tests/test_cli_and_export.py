@@ -71,17 +71,37 @@ def test_cli_train_test_time_dump(config_file, tmp_path):
 def test_cli_train_local_master(config_file, tmp_path):
     """One-binary bring-up (TrainerMain.cpp:32-49 --start_pserver analog):
     one `train --local_master` process self-hosts the task-master RPC plane
-    and trains from it, multi-pass, same artifacts as a plain train."""
+    and trains from it, multi-pass, same artifacts as a plain train.
+    ``--obs_out`` rides along: the run arms a flight recorder, obs_pushes
+    its snapshots to the in-process master, and leaves a dump the obs CLI
+    reads back (the ISSUE 4 smoke)."""
     from paddle_tpu.runtime import native_available
     if not native_available():
         pytest.skip("native task master not built")
     save = str(tmp_path / "out")
+    obs_out = str(tmp_path / "run.jsonl")
     out = _run("train", "--config", config_file, "--num_passes", "2",
                "--save_dir", save, "--local_master",
-               "--samples_per_chunk", "2")
+               "--samples_per_chunk", "2", "--obs_out", obs_out)
     assert "local master:" in out            # chunks really dispatched
     assert "pass 1 done" in out              # second pass got data
     assert os.path.exists(os.path.join(save, "pass-00001", "params.tar"))
+    assert "observability dump written" in out
+    from paddle_tpu import obs
+    dump = obs.read_jsonl(obs_out)
+    # clean exit: the FULL session dump superseded the flight ring
+    assert not dump["meta"].get("flight")
+    names = {m["name"] for m in dump["metrics"]}
+    # the v2 CLI trainer drives the fluid Executor + RPC data plane
+    assert "fluid.runs_total" in names
+    assert "rpc.calls_total" in names
+    # the obs_push path really ran against the in-process master
+    assert "obs.pushes_total" in names
+    assert "master.requests_total" in names
+    out = _run("obs", "summary", "--input", obs_out)
+    assert "fluid.runs_total" in out
+    out = _run("obs", "export", "--input", obs_out, "--format", "prom")
+    assert "paddle_tpu_fluid_runs_total" in out
 
 
 def test_export_load_inference_model(tmp_path):

@@ -543,6 +543,349 @@ def test_prefetch_queue_metrics():
     assert samples and samples[0]["type"] == "gauge"
 
 
+# -- ISSUE 4: distributed tracing, cluster aggregation, flight recorder --------
+
+def test_prom_label_value_escaping():
+    # regression: values holding '"', '\' or newlines previously emitted
+    # unparseable exposition text
+    r = obs.MetricsRegistry()
+    r.counter("rpc.calls_total").inc(op='we"ird\\path\nx')
+    text = obs.prometheus_text({"metrics": r.collect()})
+    line = next(l for l in text.splitlines()
+                if l.startswith("paddle_tpu_rpc_calls_total{"))
+    assert 'op="we\\"ird\\\\path\\nx"' in line
+    # escaped text has no raw newline inside the label braces
+    assert "\n" not in line
+
+
+def test_wire_context_shape_and_sanitize():
+    assert obs.wire_context(obs.NULL_SPAN) is None   # plane off: no key
+    r = obs.MetricsRegistry()
+    with obs.ObsSession(registry=r).installed() as s:
+        with obs.span("rpc.call") as sp:
+            ctx = obs.wire_context(sp)
+        assert ctx == {"id": obs.context.trace_id(), "span": sp.id,
+                       "pid": os.getpid()}
+        # hostile/malformed contexts degrade to no remote, never raise
+        for bad in (None, 42, "x", {}, {"id": 1}, {"id": "a", "span": "NaN",
+                                                   "pid": 1},
+                    {"id": "a", "span": -1, "pid": 1}):
+            with obs.server_span("master.dispatch", bad, op="t"):
+                pass
+        long_id = {"id": "q" * 500, "span": 7, "pid": 8}
+        with obs.server_span("master.dispatch", long_id, op="t"):
+            pass
+    spans = [e for e in s.dump()["events"] if e["name"] == "master.dispatch"]
+    assert all("remote" not in e for e in spans[:-1])
+    assert spans[-1]["remote"] == {"id": "q" * 64, "span": 7, "pid": 8}
+
+
+def test_coord_server_span_parents_under_client_rpc_call():
+    from paddle_tpu.runtime.coord import CoordServer, _CoordClient
+    srv = CoordServer().start()
+    client = _CoordClient(*srv.address)
+    r = obs.MetricsRegistry()
+    try:
+        with obs.ObsSession(registry=r).installed() as s:
+            client.call({"op": "ping"})
+    finally:
+        client.close()
+        srv.stop()
+    spans = {e["id"]: e for e in s.dump()["events"] if e["kind"] == "span"}
+    disp = next(e for e in spans.values() if e["name"] == "coord.dispatch")
+    # the server-side span names the client's rpc.call span as its remote
+    # parent — the cross-process edge (same pid here; the multiprocess
+    # e2e in test_obs_distributed.py asserts the distinct-pid case)
+    assert spans[disp["remote"]["span"]]["name"] == "rpc.call"
+    assert disp["remote"]["id"] == obs.context.trace_id()
+    assert disp["args"]["op"] == "ping"
+    # per-request-type counters on the server peer
+    assert r.counter("coord.requests_total").get(type="ping") == 1
+    assert r.counter("coord.request_errors_total").get(type="ping") == 0
+    # errors counted too
+    srv2 = CoordServer().start()
+    c2 = _CoordClient(*srv2.address)
+    try:
+        with obs.ObsSession(registry=r).installed():
+            c2.call({"op": "nope"})
+    finally:
+        c2.close()
+        srv2.stop()
+    # arbitrary op strings clamp to "unknown": a hostile peer must not
+    # mint unbounded counter series (the L005 cardinality failure mode)
+    assert r.counter("coord.request_errors_total").get(type="unknown") == 1
+    assert r.counter("coord.requests_total").get(type="nope") == 0
+
+
+def test_wire_context_absent_from_envelope_without_session():
+    # with no session the request bytes must stay identical to an
+    # un-instrumented client's: no "trace" key reaches the server
+    from paddle_tpu.runtime.coord import CoordServer, _CoordClient
+    seen = []
+    srv = CoordServer()
+    orig = srv._dispatch
+
+    def spy(req):
+        seen.append(req)
+        return orig(req)
+
+    srv._dispatch = spy
+    srv.start()
+    client = _CoordClient(*srv.address)
+    try:
+        assert not obs.is_active()
+        client.call({"op": "ping"})
+        r = obs.MetricsRegistry()
+        with obs.ObsSession(registry=r).installed():
+            client.call({"op": "ping"})
+    finally:
+        client.close()
+        srv.stop()
+    assert "trace" not in seen[0]
+    assert "trace" in seen[1]
+
+
+def test_merge_dumps_and_multi_pid_chrome_export():
+    # two synthetic per-process dumps: worker rpc.call -> master dispatch
+    worker = {
+        "meta": {"pid": 100, "process": "worker-0",
+                 "clock_origin_unix": 1000.0},
+        "metrics": [{"type": "counter", "name": "trainer.steps_total",
+                     "labels": {}, "value": 3}],
+        "events": [{"kind": "span", "name": "rpc.call", "ts": 1.0,
+                    "dur": 0.5, "tid": 1, "pid": 100, "id": 7,
+                    "parent": None, "args": {"op": "obs_push"}}]}
+    master = {
+        "meta": {"pid": 200, "process": "master",
+                 "clock_origin_unix": 1000.25},
+        "metrics": [{"type": "counter", "name": "trainer.steps_total",
+                     "labels": {}, "value": 9}],
+        "events": [{"kind": "span", "name": "master.dispatch", "ts": 0.9,
+                    "dur": 0.1, "tid": 9, "pid": 200, "id": 3,
+                    "parent": None, "args": {"op": "obs_push"},
+                    "remote": {"id": "t", "span": 7, "pid": 100}}]}
+    merged = obs.merge_dumps([worker, master])
+    # same-named series stay distinct via the worker label contract
+    series = {(m["labels"]["worker"], m["value"])
+              for m in merged["metrics"]}
+    assert series == {("worker-0", 3), ("master", 9)}
+    # clock alignment: master events shift by its later origin
+    disp = next(e for e in merged["events"]
+                if e["name"] == "master.dispatch")
+    assert disp["ts"] == pytest.approx(1.15)
+    trace = obs.chrome_trace(merged)
+    evs = trace["traceEvents"]
+    lanes = {e["pid"]: e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert lanes == {100: "worker-0", 200: "master"}
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert xs["master.dispatch"]["args"]["remote_parent"]["span"] == 7
+    # the stitch: a flow arrow from the client slice to the server slice
+    s_ev = next(e for e in evs if e["ph"] == "s")
+    f_ev = next(e for e in evs if e["ph"] == "f")
+    assert s_ev["id"] == f_ev["id"]
+    assert s_ev["pid"] == 100 and f_ev["pid"] == 200
+
+
+def test_master_dispatch_obs_push_and_merged_stats():
+    from paddle_tpu.runtime import native_available
+    if not native_available():
+        pytest.skip("native task master not built")
+    from paddle_tpu.runtime.master_service import MasterServer
+    r = obs.MetricsRegistry()
+    srv = MasterServer()          # in-process dispatch; no network start
+    with obs.ObsSession(registry=r).installed() as s:
+        wr = obs.MetricsRegistry()
+        wr.counter("trainer.steps_total").inc(5)
+        ctx = {"id": "t", "span": 11, "pid": 999}
+        resp = srv._dispatch({"op": "obs_push", "worker": "w1",
+                              "samples": wr.collect(), "trace": ctx})
+        assert resp["ok"] and resp["accepted"] == 1
+        # junk samples are filtered, never stored
+        assert srv._dispatch({"op": "obs_push", "worker": "w2",
+                              "samples": ["junk", {"no_name": 1},
+                                          {"name": "a.b_total",
+                                           "type": "counter", "value": 2,
+                                           "labels": {"x": "y"},
+                                           "evil": "dropped"}]}
+                             )["accepted"] == 1
+        out = srv._dispatch({"op": "obs_stats"})
+    assert out["workers"] == ["w1", "w2"]
+    by_worker = {}
+    for m in out["samples"]:
+        by_worker.setdefault(m["labels"]["worker"], []).append(m)
+    assert by_worker["w1"][0]["name"] == "trainer.steps_total"
+    assert by_worker["w1"][0]["value"] == 5
+    assert "evil" not in by_worker["w2"][0]
+    # dispatch span carries the wire context; counters tallied by type
+    disp = [e for e in s.dump()["events"]
+            if e.get("name") == "master.dispatch"]
+    assert disp[0]["remote"] == ctx
+    assert r.counter("master.requests_total").get(type="obs_push") == 2
+    assert r.counter("master.requests_total").get(type="obs_stats") == 1
+    assert r.gauge("master.obs_workers").get() == 2
+
+
+def test_flight_recorder_ring_keeps_tail_and_deltas(tmp_path):
+    r = obs.MetricsRegistry()
+    clock, _ = _fake_clock(0.001)
+    s = obs.ObsSession(registry=r, tracer=obs.Tracer(clock=clock))
+    p = str(tmp_path / "flight.jsonl")
+    with s.installed():
+        r.counter("trainer.steps_total").inc(10)     # pre-arm baseline
+        rec = obs.FlightRecorder(s, p, ring_size=4).arm()
+        try:
+            r.counter("trainer.steps_total").inc(3)
+            for i in range(10):
+                with obs.span("trainer.step", batch=i):
+                    pass
+            out = rec.dump("test")
+        finally:
+            rec.disarm()
+    assert out == p
+    assert s.tracer.ring is None         # disarm releases the ring too
+    back = obs.read_jsonl(p)
+    assert back["meta"]["flight"] is True
+    assert back["meta"]["reason"] == "test"
+    # the ring keeps the END of the run — the last 4 steps, not the first
+    assert [e["args"]["batch"] for e in back["events"]] == [6, 7, 8, 9]
+    steps = next(m for m in back["metrics"]
+                 if m["name"] == "trainer.steps_total")
+    assert steps["value"] == 13 and steps["delta"] == 3
+    # the flight dump is a normal dump: every exporter accepts it
+    assert obs.chrome_trace(back)["traceEvents"]
+    assert "trainer_steps_total" in obs.prometheus_text(back)
+
+
+def test_flight_dump_written_at_injected_fault(tmp_path):
+    r = obs.MetricsRegistry()
+    s = obs.ObsSession(registry=r)
+    p = str(tmp_path / "crash.jsonl")
+    plan = faults.FaultPlan().add("rpc.send", "raise", nth=1)
+    with s.installed():
+        rec = obs.FlightRecorder(s, p, ring_size=16).arm()
+        try:
+            with plan.installed():
+                with obs.span("trainer.step"):
+                    with pytest.raises(faults.FaultError):
+                        faults.fire("rpc.send")
+        finally:
+            rec.disarm()
+    back = obs.read_jsonl(p)
+    assert back["meta"]["reason"] == "fault:rpc.send"
+    # the dump precedes the unwind: the enclosing step span is still open
+    # (not yet in the ring) but the injected-fault counter is in
+    inj = next(m for m in back["metrics"]
+               if m["name"] == "faults.injected_total")
+    assert inj["labels"] == {"site": "rpc.send", "action": "raise"}
+    assert not obs.flight_dump("noop")        # disarmed: hook is inert
+
+
+def test_flight_recorder_overhead_per_batch():
+    # acceptance: the armed ring adds <= ~5µs per batch (5 span records).
+    # Measured ~0.5µs on CI-class CPUs; the bound below is 10x slack for
+    # noisy neighbours, while still catching an accidental O(ring) cost.
+    import time as _t
+    s = obs.ObsSession(registry=obs.MetricsRegistry())
+
+    def per_batch(n=300):
+        t0 = _t.perf_counter()
+        for _ in range(n):
+            for _ in range(5):
+                with s.tracer.span("trainer.step"):
+                    pass
+        return (_t.perf_counter() - t0) / n
+
+    with s.installed():
+        base = min(per_batch() for _ in range(3))
+        s.tracer.enable_ring(2048)
+        armed = min(per_batch() for _ in range(3))
+    assert armed - base < 50e-6, (base, armed)
+    # and the uninstalled fast path is untouched by the feature
+    assert obs.span("trainer.step") is obs.NULL_SPAN
+
+
+def test_metric_lint_flags_unbounded_labels():
+    # catalogue-declared label keys from the unbounded set are flagged
+    diags = analysis.lint_metric_names({
+        "data.reads_total": ("counter", "", ("path",)),
+        "rpc.calls_total": ("counter", "", ("rpc", "op")),     # bounded: ok
+    })
+    assert [d.var for d in diags] == ["data.reads_total"]
+    assert all(d.code == "L005" for d in diags)
+    # live samples: path-like values and runaway per-key cardinality
+    samples = [{"name": "ckpt.saves_total", "type": "counter",
+                "labels": {"dest": "/data/run/pass-00001"}, "value": 1}]
+    assert len(analysis.lint_metric_names(["ckpt.saves_total"],
+                                          samples=samples)) == 1
+    many = [{"name": "rpc.calls_total", "type": "counter",
+             "labels": {"op": f"op{i}"}, "value": 1} for i in range(40)]
+    d = analysis.lint_metric_names(["rpc.calls_total"], samples=many)
+    assert len(d) == 1 and "40 distinct values" in d[0].message
+    # the shipped catalogue stays clean under the extended lint
+    assert analysis.lint_metric_names(obs.CATALOGUE) == []
+
+
+def test_obs_http_server_serves_metrics_trace_summary():
+    import urllib.request
+
+    from paddle_tpu.obs.aggregate import ObsHttpServer
+    r = obs.MetricsRegistry()
+    s = obs.ObsSession(registry=r, tracer=obs.Tracer(clock=_fake_clock()[0]))
+    with s.installed():
+        r.counter("trainer.steps_total").inc(4)
+        with obs.span("trainer.pass"):
+            pass
+    srv = ObsHttpServer(s.dump).start()
+    host, port = srv.address
+
+    def get(path):
+        with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                    timeout=10) as resp:
+            return resp.status, resp.read().decode()
+
+    try:
+        code, body = get("/metrics")
+        assert code == 200
+        assert "paddle_tpu_trainer_steps_total 4" in body
+        code, body = get("/trace")
+        assert code == 200
+        assert any(e["name"] == "trainer.pass"
+                   for e in json.loads(body)["traceEvents"])
+        code, body = get("/summary")
+        assert code == 200 and "trainer.steps_total" in body
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_obs_pusher_pushes_and_counts_failures():
+    class FakeClient:
+        def __init__(self):
+            self.pushed = []
+            self.fail = False
+
+        def obs_push(self, worker, samples):
+            if self.fail:
+                raise ConnectionError("down")
+            self.pushed.append((worker, samples))
+
+    from paddle_tpu.obs.aggregate import ObsPusher
+    r = obs.MetricsRegistry()
+    client = FakeClient()
+    with obs.ObsSession(registry=r).installed():
+        r.counter("trainer.steps_total").inc()
+        pusher = ObsPusher(client, worker="w0", interval=3600)
+        assert pusher.push_once()
+        client.fail = True
+        assert not pusher.push_once()      # counted, never raised
+    assert client.pushed[0][0] == "w0"
+    assert r.counter("obs.pushes_total").get() == 1
+    assert r.counter("obs.push_failures_total").get() == 1
+
+
 def test_executor_cache_hit_metrics():
     import paddle_tpu.fluid as fluid
     r = obs.MetricsRegistry()
