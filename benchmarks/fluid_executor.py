@@ -1,0 +1,87 @@
+"""Fluid Executor hot-loop throughput — the gen-2 execution plane's row.
+
+Every other bench row drives raw jax or the Trainer's fused step; this row
+drives the *fluid Executor* the way the book tests and the v2-on-fluid path
+do — ``exe.run()`` in a loop — so the executor fast path (buffer donation,
+device-resident scope, ``return_numpy=False``, bounded compiled-fn LRU;
+docs/design/executor_perf.md) finally has a perf trajectory like the
+trainer rows.
+
+Methodology: fixed-shape MLP classification step (fc 784-256-64-10 + Adam),
+bs=256.  Warmup pays the trace+compile, then a timed loop of ``iters``
+steps with ``return_numpy=False`` — the host syncs exactly once, on the
+final loss read, so the number measures the executor dispatch path rather
+than per-step host round-trips.  The JSON note carries the cache hit rate,
+the compile count observed *inside* the timed window (must be 0 — a
+recompile here is a cache regression), and donated MB, so a regression in
+any of the three is visible in the row itself.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def _counter_total(reg, name: str) -> float:
+    """Sum a counter across its label sets (hit/miss carry `bucketed`)."""
+    return sum(v for _, v in reg.counter(name).samples())
+
+
+def run(iters: int = 200, batch: int = 256):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import obs
+
+    fluid.reset_default_programs()
+    fluid.executor._global_scope = fluid.executor.Scope()
+    img = fluid.layers.data("img", shape=(784,))
+    label = fluid.layers.data("label", shape=(), dtype="int32")
+    h1 = fluid.layers.fc(img, 256, act="relu")
+    h2 = fluid.layers.fc(h1, 64, act="relu")
+    logits = fluid.layers.fc(h2, 10)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.AdamOptimizer(1e-3).minimize(loss)
+
+    # bench.py's watchdog prelude installs a session per row; standalone
+    # invocation (python -c "...fluid_executor.run()") brings its own
+    session = obs.session()
+    own = None
+    if session is None:
+        own = obs.ObsSession(registry=obs.MetricsRegistry()).install()
+        session = own
+    reg = session.registry
+    try:
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rs = np.random.RandomState(0)
+        feed = {"img": rs.randn(batch, 784).astype(np.float32),
+                "label": rs.randint(0, 10, size=batch).astype(np.int32)}
+        out = None
+        for _ in range(3):            # warmup: trace + XLA compile
+            out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+        np.asarray(out[0])
+        c0 = _counter_total(reg, "jax.compiles_total")
+        h0 = _counter_total(reg, "fluid.cache_hits_total")
+        m0 = _counter_total(reg, "fluid.cache_misses_total")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+        final = float(np.asarray(out[0]))   # the ONE host sync ends timing
+        dt = time.perf_counter() - t0
+        hits = _counter_total(reg, "fluid.cache_hits_total") - h0
+        misses = _counter_total(reg, "fluid.cache_misses_total") - m0
+        compiles = _counter_total(reg, "jax.compiles_total") - c0
+        donated = _counter_total(reg, "fluid.donated_bytes_total")
+    finally:
+        if own is not None:
+            own.uninstall()
+    return {"metric": f"fluid_executor_mlp_steps_per_sec_bs{batch}",
+            "value": round(iters / dt, 1), "unit": "steps/s",
+            "vs_baseline": None,
+            "note": {"cache_hit_rate":
+                     round(hits / max(hits + misses, 1), 4),
+                     "timed_compiles": int(compiles),
+                     "donated_mb": round(donated / 1e6, 2),
+                     "final_loss": round(final, 4)}}

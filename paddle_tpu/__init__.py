@@ -11,13 +11,64 @@ over ICI/DCN (replacing the reference's pserver/RDMA/NCCL paths), and the host r
 
 __version__ = "0.1.0"
 
+import os as _os
+
 from . import (analysis, core, data, faults, fluid, models, nn, obs, ops,
                optimizer, parallel, trainer, utils, v2)
 from .core import CPUPlace, Place, SeqBatch, TPUPlace, sequence_mask
 from .trainer import Trainer
 
+#: env var naming a persistent XLA compilation-cache directory; applied at
+#: import (and by :func:`init`) so a preemption-resume under the same env
+#: restarts without re-paying its compiles
+COMPILE_CACHE_ENV = "PADDLE_TPU_COMPILE_CACHE_DIR"
+
+
+def enable_compile_cache(path: str) -> str:
+    """Point jax's persistent XLA compilation cache at ``path``.
+
+    Compiled executables are keyed on the serialized computation + jaxlib
+    version, so a restarted process (preemption-resume, a re-run bench, a
+    new trainer on the same pod) loads them from disk instead of
+    recompiling.  The min-compile-time/entry-size floors are dropped to 0
+    so small fluid programs cache too (the knobs are best-effort across
+    jax versions).  Returns the path.
+    """
+    import jax
+    _os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            pass   # older jax: knob absent; the cache still works
+    return path
+
+
+def init(compile_cache_dir: str = None, **flags):
+    """Process-level runtime init (the ``paddle.init`` analog).
+
+    ``compile_cache_dir`` (or ``$PADDLE_TPU_COMPILE_CACHE_DIR``) enables
+    the persistent XLA compilation cache via
+    :func:`enable_compile_cache`; remaining keyword flags are recorded
+    through :func:`v2.init`. Returns the recorded flag dict.
+    """
+    path = compile_cache_dir or _os.environ.get(COMPILE_CACHE_ENV)
+    if path:
+        flags["compile_cache_dir"] = enable_compile_cache(path)
+    return v2.init(**flags)
+
+
+if _os.environ.get(COMPILE_CACHE_ENV):
+    try:
+        enable_compile_cache(_os.environ[COMPILE_CACHE_ENV])
+    except Exception:   # an unwritable dir must not break `import paddle_tpu`
+        pass
+
 __all__ = ["analysis", "core", "data", "faults", "fluid", "nn", "obs", "ops",
            "optimizer",
            "parallel", "trainer", "utils", "models", "v2", "Trainer",
            "Place", "TPUPlace", "CPUPlace", "SeqBatch", "sequence_mask",
+           "init", "enable_compile_cache", "COMPILE_CACHE_ENV",
            "__version__"]

@@ -30,6 +30,15 @@ Lints are advisory by default (WARNING/INFO); the CLI's ``--fail-on`` and
   claim no reserved suffix.  Runs over :data:`paddle_tpu.obs.CATALOGUE`
   in the ``paddle_tpu lint`` CLI (:func:`lint_metric_names`) — metric
   names are API surface; a drive-by rename breaks dashboards silently.
+- **L006 shape-churn** (warning): a Program is being run with feeds whose
+  shapes keep changing and no bucket spec — every distinct shape pays a
+  fresh trace + XLA compile.  Unlike L001–L005 this has no static
+  signature (the desc can't see future feed shapes), so it is emitted *at
+  run time* by ``fluid.Executor`` as a ``RuntimeWarning`` naming this id,
+  on a streak of compiled-fn cache misses (``executor._CHURN_STREAK``)
+  with ``Executor(buckets=None)``.  Fix: pass a
+  :class:`~paddle_tpu.data.feeder.BucketSpec`
+  (docs/design/executor_perf.md).
 """
 
 from __future__ import annotations
@@ -45,6 +54,9 @@ LINT_CATALOGUE = {
     "L003": ("trace-safety", Severity.WARNING),
     "L004": ("sharding-consistency", Severity.ERROR),
     "L005": ("metric-naming", Severity.WARNING),
+    # L006 is runtime-emitted by fluid.Executor (cache-miss streak with no
+    # bucket spec) — catalogued here so the id/severity live in one table
+    "L006": ("shape-churn", Severity.WARNING),
 }
 
 # control-flow / executor-lowered ops act through sub-blocks, not outputs

@@ -44,6 +44,12 @@ def _make_trainer(cfg):
 
 def cmd_train(args):
     from .trainer import event
+    if getattr(args, "compile_cache", None):
+        # persistent XLA compile cache BEFORE the config builds/compiles
+        # anything: a preemption-resume of this same command re-loads its
+        # executables from disk instead of re-paying the compiles
+        from . import enable_compile_cache
+        enable_compile_cache(args.compile_cache)
     cfg = _load_config(args.config)
     trainer = _make_trainer(cfg)
     costs = []
@@ -800,6 +806,11 @@ def main(argv=None) -> int:
                    help="install an observability session for the run and "
                         "write its JSONL dump here (inspect with "
                         "'paddle_tpu obs summary/export')")
+    t.add_argument("--compile_cache", default=None,
+                   help="directory for the persistent XLA compilation "
+                        "cache: a preemption-resume (or any re-run) loads "
+                        "its compiled executables from here instead of "
+                        "recompiling ($PADDLE_TPU_COMPILE_CACHE_DIR analog)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test")

@@ -72,11 +72,24 @@ def sequence_mask(lengths: jax.Array, max_len: int, dtype=jnp.float32) -> jax.Ar
     return (pos[None, :] < lengths[:, None]).astype(dtype)
 
 
-def bucket_length(n: int, buckets: Sequence[int] = (8, 16, 32, 64, 128, 256, 512, 1024)) -> int:
-    """Round a max sequence length up to a fixed bucket to bound recompiles."""
+def bucket_length(n: int, buckets: Sequence[int] = (8, 16, 32, 64, 128, 256, 512, 1024),
+                  overflow: str = "exact") -> int:
+    """Round a max sequence length up to a fixed bucket to bound recompiles.
+
+    ``buckets`` must be ascending. Past the largest bucket, ``overflow``
+    picks the policy: ``"exact"`` returns ``n`` itself (the historical
+    packing behavior), ``"pow2"`` rounds up to the next power of two so
+    even outlier lengths land in a bounded shape family — the executor
+    :class:`~paddle_tpu.data.feeder.BucketSpec` policy. One helper owns
+    both rules so no second bucket-rounding scan can drift."""
     for b in buckets:
         if n <= b:
-            return b
+            return int(b)
+    if overflow == "pow2":
+        p = 1
+        while p < n:
+            p <<= 1
+        return p
     return int(n)
 
 

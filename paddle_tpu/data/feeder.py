@@ -17,13 +17,89 @@ TPU-native: the target layout is static-shaped —
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.lod import (NestedSeqBatch, SeqBatch, bucket_length,
                         pack_nested_sequences, pack_sequences)
+
+
+# -- shape bucketing (Executor feed policy) ------------------------------------
+
+def next_bucket(n: int, buckets: Sequence[int] = ()) -> int:
+    """Smallest listed bucket >= n (``buckets`` ascending); beyond the
+    largest (or with no list), the next power of two — so an unforeseen
+    length still lands in a bounded shape family instead of minting its
+    own compile.  Thin alias: :func:`~paddle_tpu.core.lod.bucket_length`
+    owns the rounding policy."""
+    return bucket_length(n, tuple(buckets), overflow="pow2")
+
+
+def pad_to_bucket(arr, axis: int, buckets: Sequence[int] = ()):
+    """Zero-pad ``arr`` along ``axis`` up to :func:`next_bucket`.
+
+    Returns ``(padded, true_len)`` — the caller feeds the true length
+    alongside so masked ops can ignore the tail. Host (numpy) inputs pad on
+    the host; device (jax) arrays pad on device (no round-trip).
+    """
+    if not hasattr(arr, "shape"):
+        arr = np.asarray(arr)
+    n = int(arr.shape[axis])
+    b = next_bucket(n, buckets)
+    if b == n:
+        return arr, n
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, b - n)
+    if isinstance(arr, np.ndarray):
+        return np.pad(arr, widths), n
+    return jnp.pad(arr, widths), n
+
+
+class BucketSpec:
+    """Per-feed shape-bucketing policy for :class:`~paddle_tpu.fluid.Executor`.
+
+    ``spec`` maps a feed name to its bucket boundaries::
+
+        BucketSpec({"words": (32, 64, 128)})                  # axis inferred
+        BucketSpec({"words": {"axis": 2, "buckets": (8, 16)}})  # pinned axis
+
+    A feed axis is padded up to the next listed bucket (falling back to the
+    next power of two past the largest), the true length is fed alongside
+    as ``<name>@LEN`` (int32 scalar), and the executor's compiled-fn cache
+    keys on the *bucketed* shape — a varied-length workload compiles at
+    most ``len(buckets) + 1`` times per feed instead of once per distinct
+    length. The axis defaults to the feed Variable's declared
+    ``bucket_axis``, else its first dynamic (``-1``) non-batch dim, else
+    axis 1 (axis 0 for rank-1 feeds).
+    """
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec: Dict[str, Tuple[Optional[int], Tuple[int, ...]]] = {}
+        for name, v in dict(spec).items():
+            axis: Optional[int] = None
+            if isinstance(v, dict):
+                axis = v.get("axis")
+                buckets = v.get("buckets", ())
+            else:
+                buckets = v
+            self.spec[name] = (axis, tuple(sorted(int(b) for b in buckets)))
+
+    def names(self):
+        return self.spec.keys()
+
+    def pinned_axis(self, name: str) -> Optional[int]:
+        """The axis the spec pins for ``name`` (None = caller infers)."""
+        return self.spec[name][0]
+
+    def pad(self, name: str, arr, default_axis: Optional[int] = None):
+        """(padded, true_len) for one feed; see :func:`pad_to_bucket`."""
+        axis, buckets = self.spec[name]
+        if axis is None:
+            axis = (default_axis if default_axis is not None
+                    else (1 if getattr(arr, "ndim", 1) >= 2 else 0))
+        return pad_to_bucket(arr, axis, buckets)
 
 
 @dataclass

@@ -11,6 +11,7 @@ from ..nn import initializer
 from .backward import append_backward
 from .evaluator import Accuracy as AccuracyEvaluator
 from .evaluator import ChunkEvaluator
+from ..data.feeder import BucketSpec
 from .executor import Executor, Scope, global_scope
 from .framework import (Block, Operator, Program, Variable,
                         default_main_program, default_startup_program,
@@ -25,6 +26,7 @@ from .regularizer import L1Decay, L2Decay, append_regularization_ops
 __all__ = ["layers", "backward", "io", "optimizer", "registry", "executor",
            "nets", "regularizer", "evaluator", "initializer",
            "append_backward", "Executor", "Scope", "global_scope",
+           "BucketSpec",
            "Program", "Block", "Operator", "Variable",
            "default_main_program", "default_startup_program", "program_guard",
            "reset_default_programs", "While", "Cond", "StaticRNN",

@@ -23,6 +23,8 @@ grad-op emission).
 
 from __future__ import annotations
 
+import warnings
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -30,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..data.feeder import BucketSpec
 from .framework import Block, Program, Variable
 from .registry import OpRegistry
 
@@ -328,32 +331,161 @@ def _trace_beam_search_gen(op, env, ctx: TraceContext):
     env[op.outputs["Scores"][0]] = scores
 
 
-class Executor:
-    """exe.run(program, feed=..., fetch_list=...) (fluid/executor.py:7-20)."""
+#: consecutive compiled-fn cache misses before the executor warns that the
+#: workload is shape-churning with no bucket spec (L006, analysis/lints.py)
+_CHURN_STREAK = 4
 
-    def __init__(self, place=None, scope: Optional[Scope] = None):
+#: default compiled-fn LRU capacity — generous (a cache entry is a traced
+#: closure + XLA executable handle, not the HBM working set), but bounded so
+#: unbucketed shape churn is a warning, not a slow leak
+DEFAULT_CACHE_CAPACITY = 512
+
+
+class Executor:
+    """exe.run(program, feed=..., fetch_list=...) (fluid/executor.py:7-20).
+
+    Hot-path contract (docs/design/executor_perf.md):
+
+    * ``donate=True`` (default) hands persistables that the run overwrites
+      (optimizer updates, BN stats) to XLA as donated buffers — the update
+      happens in place, no second HBM copy per step.  A persistable that is
+      also fetched (or fed) in the same run is automatically kept; pass
+      ``donate=False`` (constructor or per-run) to opt out entirely.  After
+      a donating run, previously-held references to the old parameter
+      arrays are dead (``x.is_deleted()``) — re-read them from the scope.
+    * Persistables live in the scope as **device arrays** between runs;
+      ``run(..., return_numpy=False)`` returns jax arrays without blocking
+      the host, so a training loop only syncs where it reads values.
+    * ``buckets=...`` (a :class:`~paddle_tpu.data.feeder.BucketSpec` or its
+      dict form) pads designated feed axes up to a bounded set of shapes so
+      the compiled-fn cache is keyed on bucket shapes; the true length is
+      fed alongside as ``<name>@LEN``.
+    * The compiled-fn cache is a bounded LRU (``cache_capacity``).
+    """
+
+    def __init__(self, place=None, scope: Optional[Scope] = None, *,
+                 donate: bool = True,
+                 buckets: Optional[Any] = None,
+                 cache_capacity: int = DEFAULT_CACHE_CAPACITY):
         self.place = place
         self.scope = scope if scope is not None else global_scope()
-        self._cache: Dict[Tuple, Any] = {}
+        self.donate = donate
+        if buckets is not None and not isinstance(buckets, BucketSpec):
+            buckets = BucketSpec(buckets)
+        self.buckets: Optional[BucketSpec] = buckets
+        if cache_capacity < 1:
+            raise ValueError("cache_capacity must be >= 1")
+        self.cache_capacity = cache_capacity
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._verified: set = set()   # analysis pre-flights already passed
         self._step = 0   # feeds the implicit '__step__' var (stochastic ops)
+        # L006 shape-churn heuristic: consecutive never-seen-key misses per
+        # (program, block, fetch) signature — keyed so first-runs of
+        # DIFFERENT programs (startup + train + eval) never sum to a
+        # streak, with a seen-key set so LRU-eviction thrash over a
+        # BOUNDED shape family (which bucketing can't improve) doesn't
+        # count as churn either
+        self._miss_streaks: Dict[Tuple, int] = {}
+        self._seen_keys: set = set()
+        self._churn_warned = False
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None,
-            use_cache: bool = True, verify: bool = False) -> List[np.ndarray]:
+            use_cache: bool = True, verify: bool = False,
+            return_numpy: bool = True,
+            donate: Optional[bool] = None) -> List[Any]:
         with obs.span("fluid.run", metric="fluid.run_seconds"):
-            return self._run(program, feed, fetch_list, use_cache, verify)
+            return self._run(program, feed, fetch_list, use_cache, verify,
+                             return_numpy, donate)
 
-    def _run(self, program, feed, fetch_list, use_cache, verify):
+    # ------------------------------------------------------------------
+    def _default_bucket_axis(self, block: Block, name: str,
+                             ndim: int) -> Optional[int]:
+        """Axis to bucket when the spec doesn't pin one: the feed Variable's
+        declared ``bucket_axis``, else its first dynamic (-1) non-batch dim
+        (layers.data marks the batch dim -1 at axis 0; a second -1 is the
+        variable-length axis). A declared feed with NO dynamic non-batch
+        dim is an error — silently guessing an axis would pad a static
+        feature dim and surface as a distant shape mismatch inside the
+        traced program."""
+        v = block.vars.get(name)
+        if v is not None:
+            if getattr(v, "bucket_axis", None) is not None:
+                return v.bucket_axis
+            dyn = [i for i, s in enumerate(v.shape) if i > 0 and s == -1]
+            if dyn and dyn[0] < ndim:
+                return dyn[0]
+            if ndim >= 2:
+                raise ValueError(
+                    f"cannot infer a bucket axis for feed '{name}': its "
+                    f"declared shape {v.shape} has no dynamic (-1) non-batch "
+                    "dim; pin one in the spec "
+                    f"(buckets={{'{name}': {{'axis': A, 'buckets': (...)}}}}) "
+                    "or declare layers.data(..., bucket_axis=A)")
+        return None
+
+    def _apply_buckets(self, feed: Dict[str, Any], block: Block) -> bool:
+        """Pad spec'd feeds in place; True when any feed was bucketed."""
+        applied = False
+        for name in self.buckets.names():
+            if name not in feed:
+                continue
+            arr = feed[name]
+            if not hasattr(arr, "shape"):
+                arr = np.asarray(arr)
+            default_axis = None
+            if self.buckets.pinned_axis(name) is None:
+                default_axis = self._default_bucket_axis(block, name,
+                                                         arr.ndim)
+            padded, true_len = self.buckets.pad(name, arr, default_axis)
+            feed[name] = padded
+            # the true extent rides along so masked ops can ignore the pad
+            # tail; scalar shape — it never perturbs the cache key
+            feed[name + "@LEN"] = np.int32(true_len)
+            applied = True
+        return applied
+
+    def _maybe_warn_churn(self, streak: int):
+        """L006 shape-churn: a streak of never-seen-before cache keys for
+        ONE (program, fetch) signature means every distinct feed shape is
+        paying a fresh trace + XLA compile (warns once per executor; lint
+        id in analysis/lints.py). Fires with a partial BucketSpec too —
+        a spec that misses the churning feed doesn't bound anything — but
+        the threshold then grows by the spec's own shape-family size, so a
+        covering spec legitimately warming one compile per bucket never
+        trips it."""
+        threshold = _CHURN_STREAK
+        if self.buckets is not None:
+            threshold += sum(len(b) + 1            # +1: pow-2 overflow shape
+                             for _, b in self.buckets.spec.values())
+        if self._churn_warned or streak < threshold:
+            return
+        self._churn_warned = True
+        fix = ("pass Executor(buckets={'<feed>': (32, 64, ...)})"
+               if self.buckets is None else
+               "extend the BucketSpec to cover the still-varying feed(s)")
+        warnings.warn(
+            f"L006 shape-churn: {streak} consecutive compiled-fn cache "
+            "misses for the same program — each distinct feed shape pays a "
+            f"fresh trace and XLA compile. If feeds vary in length, {fix} "
+            "to pad onto a bounded shape family "
+            "(docs/design/executor_perf.md).",
+            RuntimeWarning, stacklevel=4)
+
+    def _run(self, program, feed, fetch_list, use_cache, verify,
+             return_numpy=True, donate=None):
         from .framework import default_main_program
         program = program or default_main_program()
-        feed = {k: jnp.asarray(v) for k, v in (feed or {}).items()}
+        block = program.global_block()
+        feed = dict(feed or {})
+        bucketed = self.buckets is not None and self._apply_buckets(feed,
+                                                                    block)
+        feed = {k: jnp.asarray(v) for k, v in feed.items()}
         # anything with a .name (Variable, v2 LayerOutput) or a plain string
         fetch_names = [v if isinstance(v, str) else v.name
                        for v in (fetch_list or [])]
-        block = program.global_block()
         if "__step__" in block.vars and "__step__" not in feed:
             feed["__step__"] = jnp.asarray(self._step, jnp.int32)
             self._step += 1
@@ -373,8 +505,12 @@ class Executor:
                 self._verified.add(vkey)
 
         # vars the block reads from the scope (persistables created earlier)
+        # — minus any the caller feeds this run: the fed value must WIN
+        # (and the scope copy would otherwise ride to the device as a dead
+        # argument only to be shadowed, or worse, shadow the feed)
         persist_in = [name for name, v in block.vars.items()
-                      if v.persistable and self.scope.has(name)]
+                      if v.persistable and name not in feed
+                      and self.scope.has(name)]
         # persistable vars written by ops (optimizer updates, BN stats) synced
         # back after the run — including writes inside control-flow sub-blocks
         # (those values flow to env via the loop carry; they must also be
@@ -393,25 +529,83 @@ class Executor:
                     "sub-block but has no initial value; initialize it in the "
                     "scope (or a startup program) first")
 
+        # donation split, decided from desc-level facts so it is a pure
+        # function of the cache key: a persistable the run overwrites is
+        # donated to XLA (updated in place) UNLESS the same run also
+        # fetches it — that needs the old buffer readable (fed persistables
+        # never reach persist_in at all; the fed value wins)
+        donate = self.donate if donate is None else donate
+        written_set, fetch_set = set(written), set(fetch_names)
+        donated_in = [n for n in persist_in
+                      if donate and n in written_set
+                      and n not in fetch_set]
+        donated_set = set(donated_in)
+        kept_in = [n for n in persist_in if n not in donated_set]
+
+        bflag = "true" if bucketed else "false"
         key = (program._serial, program.version, block.idx, tuple(fetch_names),
-               tuple(persist_in),
+               tuple(persist_in), bool(donate),
                tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed.items())))
         fn = self._cache.get(key) if use_cache else None
         obs.count("fluid.runs_total")
+        churn_key = (program._serial, block.idx, tuple(fetch_names))
         if fn is None:
             # a miss pays the trace (+ XLA compile on first call)
-            obs.count("fluid.cache_misses_total")
-            fn = self._build(program, block, list(feed), persist_in,
+            obs.count("fluid.cache_misses_total", bucketed=bflag)
+            # deliberate use_cache=False runs and re-compiles of a key the
+            # LRU evicted (a bounded shape family thrashing a small cache)
+            # are not shape churn
+            if use_cache and key not in self._seen_keys:
+                if len(self._seen_keys) > 4096:     # unbounded-churn cap
+                    self._seen_keys.clear()
+                self._seen_keys.add(key)
+                if len(self._miss_streaks) > 64:    # stale program signatures
+                    self._miss_streaks.clear()
+                streak = self._miss_streaks.get(churn_key, 0) + 1
+                self._miss_streaks[churn_key] = streak
+                self._maybe_warn_churn(streak)
+            fn = self._build(program, block, list(feed), kept_in, donated_in,
                              fetch_names, written)
             if use_cache:
                 self._cache[key] = fn
+                while len(self._cache) > self.cache_capacity:
+                    self._cache.popitem(last=False)   # evict the LRU entry
+                    obs.count("fluid.cache_evictions_total")
         else:
-            obs.count("fluid.cache_hits_total")
-        persist_vals = [self.scope.get(n) for n in persist_in]
-        fetches, new_persist = fn(feed, persist_vals)
+            obs.count("fluid.cache_hits_total", bucketed=bflag)
+            self._miss_streaks[churn_key] = 0
+            self._cache.move_to_end(key)
+        if use_cache:
+            obs.gauge_set("fluid.cache_size", len(self._cache))
+        kept_vals = [self.scope.get(n) for n in kept_in]
+        donated_vals = [self.scope.get(n) for n in donated_in]
+        if donated_in and obs.is_active():
+            obs.count("fluid.donated_bytes_total",
+                      sum(getattr(v, "nbytes", 0) for v in donated_vals))
+        try:
+            fetches, new_persist = fn(feed, kept_vals, donated_vals)
+        except Exception:
+            # a failure AFTER dispatch (e.g. jax_debug_nans) has already
+            # invalidated the donated inputs but never produced outputs to
+            # sync back — the scope now maps those names to dead buffers.
+            # Say so here, where the cause is known; the next run would
+            # otherwise fail with an anonymous 'Array has been deleted'.
+            dead = [n for n, v in zip(donated_in, donated_vals)
+                    if getattr(v, "is_deleted", lambda: False)()]
+            if dead:
+                warnings.warn(
+                    f"Executor.run failed after donating {len(dead)} "
+                    f"persistable buffer(s) ({dead[:4]}...): their scope "
+                    "values are invalidated — reload them (startup program "
+                    "/ load_persistables / checkpoint) before the next run, "
+                    "or use donate=False while debugging.",
+                    RuntimeWarning, stacklevel=3)
+            raise
         for n, v in zip(written, new_persist):
             self.scope.set(n, v)
-        return [np.asarray(v) for v in fetches]
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -427,14 +621,16 @@ class Executor:
         return out
 
     # ------------------------------------------------------------------
-    def _build(self, program: Program, block: Block, feed_names, persist_in,
-               fetch_names, written):
+    def _build(self, program: Program, block: Block, feed_names, kept_in,
+               donated_in, fetch_names, written):
         has_host_ops = any(op.type == "fill_init" for op in block.ops)
 
-        def raw(feed: Dict[str, Any], persist_vals: List[Any]):
+        def raw(feed: Dict[str, Any], kept_vals: List[Any],
+                donated_vals: List[Any]):
             env: Dict[str, Any] = {}
             env.update(feed)
-            env.update(dict(zip(persist_in, persist_vals)))
+            env.update(dict(zip(kept_in, kept_vals)))
+            env.update(dict(zip(donated_in, donated_vals)))
             ctx = TraceContext(program, dict(env))
             _trace_ops(block.ops, env, ctx)
             fetches = [env[n] for n in fetch_names]
@@ -443,4 +639,7 @@ class Executor:
 
         if has_host_ops:
             return raw  # startup programs run eagerly (host-side initializers)
-        return jax.jit(raw)
+        # every donated name is also written (enforced by the _run split), so
+        # XLA aliases each donated input buffer with its updated output —
+        # params/BN stats update in place instead of allocating a second copy
+        return jax.jit(raw, donate_argnums=(2,) if donated_in else ())

@@ -27,7 +27,8 @@ class Variable:
                  dtype: str = "float32", persistable: bool = False,
                  is_data: bool = False, lod_level: int = 0,
                  trainable: bool = True,
-                 sharding: Optional[Sequence[Optional[str]]] = None):
+                 sharding: Optional[Sequence[Optional[str]]] = None,
+                 bucket_axis: Optional[int] = None):
         self.block = block
         self.name = name
         self.shape = tuple(int(s) for s in shape)
@@ -45,6 +46,11 @@ class Variable:
         if isinstance(sharding, str):
             sharding = (sharding,)
         self.sharding = tuple(sharding) if sharding is not None else None
+        # which axis of a feed varies in length (the executor's BucketSpec
+        # pads it when no axis is pinned in the spec); rides Program JSON
+        # like sharding so a deserialized program keeps its feed contract
+        self.bucket_axis = (int(bucket_axis) if bucket_axis is not None
+                            else None)
 
     def __repr__(self):
         return (f"Variable({self.name}, shape={self.shape}, dtype={self.dtype}"
@@ -61,6 +67,8 @@ class Variable:
                 d[k] = getattr(self, k)
         if self.sharding is not None:
             d["sharding"] = list(self.sharding)
+        if self.bucket_axis is not None:
+            d["bucket_axis"] = self.bucket_axis
         return d
 
 
@@ -209,7 +217,8 @@ class Program:
                 v = Variable(
                     b, vd["name"], vd["shape"], vd["dtype"],
                     vd["persistable"], vd["is_data"], vd.get("lod_level", 0),
-                    vd.get("trainable", True), vd.get("sharding"))
+                    vd.get("trainable", True), vd.get("sharding"),
+                    vd.get("bucket_axis"))
                 for k in ("lr_scale", "l2_rate"):
                     if k in vd:
                         setattr(v, k, vd[k])

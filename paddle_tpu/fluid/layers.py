@@ -91,15 +91,20 @@ def _create_parameter(name_hint: str, shape, dtype="float32",
 
 def data(name: str, shape: Sequence[int], dtype="float32",
          lod_level: int = 0,
-         sharding: Optional[Sequence[Optional[str]]] = None) -> Variable:
+         sharding: Optional[Sequence[Optional[str]]] = None,
+         bucket_axis: Optional[int] = None) -> Variable:
     """Feed slot (layers.py data:179); shape excludes the batch dim.
 
     ``sharding`` optionally names one mesh axis per dim (batch dim included,
     None = replicated), e.g. ``("data", None)`` — checked against
-    parallel.mesh axis names by ``analysis.lint_program`` (L004)."""
+    parallel.mesh axis names by ``analysis.lint_program`` (L004).
+
+    ``bucket_axis`` marks the variable-length axis (batch dim included) the
+    executor's ``BucketSpec`` pads when the spec doesn't pin one — set it
+    when the dynamic axis is not the feed's first ``-1`` dim."""
     return _block().create_var(name=name, shape=(-1,) + tuple(shape),
                                dtype=dtype, is_data=True, lod_level=lod_level,
-                               sharding=sharding)
+                               sharding=sharding, bucket_axis=bucket_axis)
 
 
 def fc(input: Variable, size: int, act: Optional[str] = None,

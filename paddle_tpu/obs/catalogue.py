@@ -56,9 +56,20 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
                               ("site", "action")),
     # -- fluid: fluid/executor.py ---------------------------------------
     "fluid.runs_total": ("counter", "Executor.run invocations"),
-    "fluid.cache_hits_total": ("counter", "compiled-fn cache hits"),
+    "fluid.cache_hits_total": ("counter", "compiled-fn cache hits, labels: "
+                                          "bucketed (was the feed padded "
+                                          "by a BucketSpec)", ("bucketed",)),
     "fluid.cache_misses_total": ("counter", "compiled-fn cache misses "
-                                            "(trace+compile paid)"),
+                                            "(trace+compile paid), labels: "
+                                            "bucketed", ("bucketed",)),
+    "fluid.cache_evictions_total": ("counter", "LRU evictions from the "
+                                               "bounded compiled-fn cache"),
+    "fluid.cache_size": ("gauge", "live entries in the compiled-fn cache "
+                                  "(bounded by Executor cache_capacity)"),
+    "fluid.donated_bytes_total": ("counter", "persistable bytes handed to "
+                                             "XLA as donated buffers "
+                                             "(updated in place, no second "
+                                             "HBM copy)"),
     "fluid.run_seconds": ("histogram", "whole Executor.run duration"),
     "fluid.verify_seconds": ("histogram", "static pre-flight "
                                           "(analysis.check_or_raise)"),

@@ -509,8 +509,16 @@ class Trainer:
                 jax.config.update("jax_enable_x64", prev)
 
         def to64(x):
-            x = np.asarray(jax.device_get(x))
-            return x.astype(np.float64) if np.issubdtype(x.dtype, np.floating) else x
+            # one host transfer: device_get already yields ndarray (the old
+            # np.asarray(jax.device_get(x)) chain materialized the leaf
+            # twice). astype keeps its default copy — device_get can return
+            # a READ-ONLY view, and the check loop below writes into these
+            # leaves through p_host, so they must be owned writable copies
+            x = jax.device_get(x)
+            if not hasattr(x, "dtype"):
+                x = np.asarray(x)
+            return (x.astype(np.float64)
+                    if np.issubdtype(x.dtype, np.floating) else x)
 
         with enable_x64():
             params64 = jax.tree_util.tree_map(to64, params)
@@ -522,7 +530,13 @@ class Trainer:
             rs = np.random.RandomState(seed)
             ok = True
             for li, (p, g) in enumerate(zip(leaves, gleaves)):
+                # host copies hoisted OUT of the perturbation loop: the old
+                # code re-transferred the whole gradient leaf from device
+                # once per checked index (np.asarray(device_get(g)) inside
+                # the loop) — n_checks transfers where one suffices
                 p_host = np.asarray(jax.device_get(p), np.float64)
+                g_flat = np.asarray(jax.device_get(g),
+                                    np.float64).reshape(-1)
                 flat = p_host.reshape(-1)
                 n_checks = min(max_checks_per_param, flat.size)
                 for idx in rs.choice(flat.size, size=n_checks, replace=False):
@@ -537,7 +551,7 @@ class Trainer:
                             *batch64))
                     flat[idx] = orig
                     numeric = (vals[+1] - vals[-1]) / (2 * eps)
-                    analytic = float(np.asarray(jax.device_get(g)).reshape(-1)[idx])
+                    analytic = float(g_flat[idx])
                     denom = max(abs(numeric), abs(analytic), 1e-6)
                     if abs(numeric - analytic) / denom > rtol:
                         log.warning("checkgrad mismatch leaf %d idx %d: "

@@ -50,8 +50,13 @@ def test_cli_version():
 
 def test_cli_train_test_time_dump(config_file, tmp_path):
     save = str(tmp_path / "out")
+    cc = str(tmp_path / "compile_cache")
     out = _run("train", "--config", config_file, "--num_passes", "2",
-               "--save_dir", save, "--log_period", "2")
+               "--save_dir", save, "--log_period", "2",
+               "--compile_cache", cc)
+    # --compile_cache wired through paddle_tpu.enable_compile_cache: the
+    # run persists its XLA executables for a preemption-resume to reload
+    assert os.path.isdir(cc) and os.listdir(cc)
     assert "pass 1 done" in out
     assert os.path.exists(os.path.join(save, "pass-00001", "params.tar"))
     assert os.path.exists(os.path.join(save, "inference", "model.json"))

@@ -46,6 +46,26 @@ def test_buffered_propagates_errors():
         list(buffered(lambda: bad(), 2)())
 
 
+def test_pad_to_bucket_and_next_bucket():
+    from paddle_tpu.data.feeder import BucketSpec, next_bucket, pad_to_bucket
+    assert next_bucket(5, (8, 16)) == 8
+    assert next_bucket(9, (8, 16)) == 16
+    assert next_bucket(17, (8, 16)) == 32     # pow-2 overflow past the list
+    assert next_bucket(3) == 4                # no list: pure pow-2
+    arr = np.arange(10, dtype=np.float32).reshape(2, 5)
+    padded, true_len = pad_to_bucket(arr, 1, (8,))
+    assert padded.shape == (2, 8) and true_len == 5
+    np.testing.assert_array_equal(padded[:, :5], arr)
+    assert np.all(padded[:, 5:] == 0)
+    same, n = pad_to_bucket(padded, 1, (8,))  # already on a bucket: no-op
+    assert same is padded and n == 8
+    spec = BucketSpec({"w": (8,), "x": {"axis": 0, "buckets": (4,)}})
+    p, n = spec.pad("w", arr)                 # default axis 1 for rank-2
+    assert p.shape == (2, 8) and n == 5
+    p, n = spec.pad("x", arr)                 # pinned axis 0
+    assert p.shape == (4, 5) and n == 2
+
+
 def test_feeder_dense_index_seq_sparse():
     feeder = DataFeeder([DenseSlot(3), IndexSlot(), SeqSlot(),
                          SparseSlot(100)])

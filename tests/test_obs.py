@@ -900,6 +900,8 @@ def test_executor_cache_hit_metrics():
         exe.run(prog, feed=feed, fetch_list=[y])
         exe.run(prog, feed=feed, fetch_list=[y])
     assert r.counter("fluid.runs_total").get() == 2
-    assert r.counter("fluid.cache_misses_total").get() == 1
-    assert r.counter("fluid.cache_hits_total").get() == 1
+    # hit/miss counters carry the bucketed label (no BucketSpec -> "false")
+    assert r.counter("fluid.cache_misses_total").get(bucketed="false") == 1
+    assert r.counter("fluid.cache_hits_total").get(bucketed="false") == 1
+    assert r.gauge("fluid.cache_size").get() == 1
     assert r.histogram("fluid.run_seconds").snapshot()["count"] == 2
