@@ -260,7 +260,7 @@ def main(full: bool = False):
         rows.append((f"__import__('benchmarks.lstm_textcls', fromlist=['x'])"
                      f".bench_row({bs}, {hidden}, {ref})", ROW_TIMEOUT))
     mods = ["transformer_lm", "resnet50", "seq2seq_nmt", "transformer_nmt",
-            "serving_decode", "fluid_executor"]
+            "serving_decode", "fluid_executor", "sharded_gpt2"]
     if full:
         mods.append("fused_rnn")
     for name in mods:

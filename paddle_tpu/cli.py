@@ -571,8 +571,8 @@ def cmd_cluster_train(args):
     hosts = _cluster_hosts(args)
     if hosts:
         # world size is the host list in this mode; flag the conflict
-        # instead of silently dropping an explicit local-mode option
-        if args.num_workers != 2:
+        # instead of silently dropping an explicitly-passed local option
+        if args.num_workers is not None:
             print(f"cluster_train: --hosts mode runs one node per host "
                   f"({len(hosts)}); ignoring --num_workers "
                   f"{args.num_workers}.", file=sys.stderr)
@@ -588,6 +588,8 @@ def cmd_cluster_train(args):
         for line in _render_host_commands(args, hosts):
             print(line)
         return 0
+    if args.num_workers is None:
+        args.num_workers = 2             # local-mode default world size
     restarts = max(0, getattr(args, "restart_on_failure", 0) or 0)
     for attempt in range(restarts + 1):
         rc = (_multihost_attempt(args, hosts, attempt) if hosts
@@ -865,7 +867,10 @@ def main(argv=None) -> int:
     ct.add_argument("script_args", nargs="*",
                     help="args passed through to the script (put them after "
                          "a -- separator if they start with a dash)")
-    ct.add_argument("--num_workers", type=int, default=2)
+    # None default = "not passed": --hosts mode warns on ANY explicit value
+    # (a hard-coded sentinel of 2 could not tell `--num_workers 2` from the
+    # default); local mode resolves it to 2
+    ct.add_argument("--num_workers", type=int, default=None)
     ct.add_argument("--devices_per_worker", type=int, default=0,
                     help="force N virtual CPU devices per worker (testing; "
                          "0 = use the worker's real accelerators)")

@@ -361,15 +361,52 @@ class Executor:
       the compiled-fn cache is keyed on bucket shapes; the true length is
       fed alongside as ``<name>@LEN``.
     * The compiled-fn cache is a bounded LRU (``cache_capacity``).
+
+    Sharding contract (docs/design/spmd.md): ``mesh=`` (a
+    ``jax.sharding.Mesh``, defaulting to the ambient
+    :func:`paddle_tpu.parallel.use_mesh`) makes the executor compile every
+    program through ``jax.jit(..., in_shardings=..., out_shardings=...)``.
+    Each persistable's ``PartitionSpec`` resolves through ``layout`` (a
+    :class:`paddle_tpu.parallel.SpecLayout`; annotation > layout rule >
+    replicated), parameters are *placed* sharded the first time the mesh
+    executor touches them (init, load, checkpoint restore) and stay
+    sharded in the device-resident scope across runs; feeds shard their
+    batch dim over the ``data`` axis unless annotated otherwise. Sharding
+    specs join the compiled-fn cache key, and donation keeps aliasing the
+    sharded buffers in place.
     """
 
     def __init__(self, place=None, scope: Optional[Scope] = None, *,
                  donate: bool = True,
                  buckets: Optional[Any] = None,
+                 mesh: Optional[Any] = None,
+                 layout: Optional[Any] = None,
                  cache_capacity: int = DEFAULT_CACHE_CAPACITY):
         self.place = place
         self.scope = scope if scope is not None else global_scope()
         self.donate = donate
+        if mesh is None:
+            from ..parallel.mesh import current_mesh
+            mesh = current_mesh()
+        if layout is not None and mesh is None:
+            raise ValueError(
+                "Executor(layout=...) needs a mesh: pass mesh=... or "
+                "construct inside parallel.use_mesh(...)")
+        if mesh is not None and layout is None:
+            from ..parallel.sharding import SpecLayout
+            layout = SpecLayout()
+        self.mesh = mesh
+        self.layout = layout
+        # device identity joins the cache key: a compiled executable is
+        # pinned to its device assignment
+        self._mesh_sig = (tuple(mesh.shape.items()),
+                          tuple(int(d.id) for d in mesh.devices.flat)) \
+            if mesh is not None else None
+        self._mesh_stats_emitted = False
+        # resolved-sharding memo (specs are a pure function of program +
+        # mesh + layout + arg shapes): a steady-state training loop must
+        # not re-walk the layout's rule table per persistable per step
+        self._shard_memo: Dict[Tuple, Tuple] = {}
         if buckets is not None and not isinstance(buckets, BucketSpec):
             buckets = BucketSpec(buckets)
         self.buckets: Optional[BucketSpec] = buckets
@@ -474,6 +511,85 @@ class Executor:
             "(docs/design/executor_perf.md).",
             RuntimeWarning, stacklevel=4)
 
+    # -------------------------------------------------- sharding plane ----
+    def _annotation(self, block: Block, name: str):
+        """The variable's ``sharding`` annotation; optimizer accumulators
+        (``param@moment1``) inherit their base parameter's annotation —
+        slot layouts must follow the parameter or the update op pays a
+        reshard every step."""
+        v = block.vars.get(name)
+        ann = getattr(v, "sharding", None) if v is not None else None
+        if ann is None and "@" in name:
+            base = block.vars.get(name.split("@", 1)[0])
+            ann = getattr(base, "sharding", None) if base is not None else None
+        return ann
+
+    def _persist_sharding(self, block: Block, name: str, value):
+        return self.layout.resolve(self.mesh, name, np.shape(value),
+                                   self._annotation(block, name))
+
+    def _feed_sharding(self, block: Block, name: str, value):
+        """Feeds: annotation wins; a fed persistable resolves like a
+        parameter; plain data shards its batch dim over ``data``."""
+        shape = np.shape(value)
+        ann = self._annotation(block, name)
+        v = block.vars.get(name)
+        if ann is None and v is not None and v.persistable:
+            return self._persist_sharding(block, name, value)
+        if ann is not None:
+            return self.layout.resolve(self.mesh, name, shape, ann)
+        from jax.sharding import NamedSharding
+        spec = type(self.layout).fit(self.mesh,
+                                     self.layout.batch_spec(len(shape)),
+                                     shape)
+        return NamedSharding(self.mesh, spec)
+
+    def _place_persistables(self, persist_in, spec_of) -> None:
+        """Move scope values whose live sharding differs from the resolved
+        layout (host arrays from a startup program / checkpoint restore,
+        or arrays placed for a previous mesh) onto the mesh — the
+        init/load-time sharded placement of the GSPMD plane."""
+        placed = 0
+        for n in persist_in:
+            cur = self.scope.get(n)
+            target = spec_of[n]
+            if getattr(cur, "sharding", None) == target:
+                continue
+            new = jax.device_put(cur, target)
+            self.scope.set(n, new)
+            placed += int(getattr(new, "nbytes", 0))
+        if placed:
+            obs.count("fluid.placed_bytes_total", placed)
+            self._mesh_stats_emitted = False
+        if not self._mesh_stats_emitted and obs.is_active():
+            self._emit_mesh_stats(persist_in, spec_of)
+            self._mesh_stats_emitted = True
+
+    def _emit_mesh_stats(self, persist_in, spec_of) -> None:
+        """Per-axis utilization through the obs plane: how much of the
+        persistable footprint each mesh axis actually divides, and the
+        per-device parameter bytes the layout achieves."""
+        total = per_device = 0
+        by_axis: Dict[str, int] = {a: 0 for a in self.mesh.shape}
+        for n in persist_in:
+            v = self.scope.get(n)
+            nbytes = int(getattr(v, "nbytes", 0))
+            total += nbytes
+            ways = 1
+            for entry in spec_of[n].spec:
+                axes = ((entry,) if isinstance(entry, str)
+                        else tuple(entry or ()))
+                for a in axes:
+                    by_axis[a] += nbytes
+                    ways *= self.mesh.shape[a]
+            per_device += nbytes // ways
+        for a, size in self.mesh.shape.items():
+            obs.gauge_set("mesh.axis_size", size, axis=a)
+            obs.gauge_set("mesh.axis_utilization",
+                          (by_axis[a] / total) if total else 0.0, axis=a)
+        obs.gauge_set("fluid.param_bytes_per_device", per_device)
+        obs.gauge_set("fluid.param_bytes_global", total)
+
     def _run(self, program, feed, fetch_list, use_cache, verify,
              return_numpy=True, donate=None):
         from .framework import default_main_program
@@ -542,9 +658,46 @@ class Executor:
         donated_set = set(donated_in)
         kept_in = [n for n in persist_in if n not in donated_set]
 
+        # mesh path: resolve every argument's sharding, place scope
+        # persistables, and extend the cache key with the resolved specs.
+        # Resolution is memoized per (program version, args signature) —
+        # it is a pure function of program + mesh + layout, and the rule-
+        # table regex walk must not run per persistable per hot-loop step
+        shardings = None
+        if self.mesh is not None:
+            skey = (program._serial, program.version, block.idx,
+                    tuple(persist_in), tuple(written),
+                    tuple((k, v.shape, str(v.dtype))
+                          for k, v in sorted(feed.items())))
+            memo = self._shard_memo.get(skey)
+            if memo is None:
+                feed_sh = {k: self._feed_sharding(block, k, v)
+                           for k, v in feed.items()}
+                spec_of = {n: self._persist_sharding(block, n,
+                                                     self.scope.get(n))
+                           for n in persist_in}
+                from jax.sharding import NamedSharding, PartitionSpec
+                replicated = NamedSharding(self.mesh, PartitionSpec())
+                out_sh = [spec_of.get(n) or feed_sh.get(n) or replicated
+                          for n in written]
+                mesh_key = (self._mesh_sig,
+                            tuple(sorted((k, str(s.spec))
+                                         for k, s in feed_sh.items())),
+                            tuple((n, str(spec_of[n].spec))
+                                  for n in persist_in))
+                if len(self._shard_memo) > 1024:   # unbounded-churn cap
+                    self._shard_memo.clear()
+                memo = (feed_sh, spec_of, out_sh, replicated, mesh_key)
+                self._shard_memo[skey] = memo
+            feed_sh, spec_of, out_sh, replicated, mesh_key = memo
+            self._place_persistables(persist_in, spec_of)
+            shardings = (feed_sh, spec_of, out_sh, replicated)
+        else:
+            mesh_key = None
+
         bflag = "true" if bucketed else "false"
         key = (program._serial, program.version, block.idx, tuple(fetch_names),
-               tuple(persist_in), bool(donate),
+               tuple(persist_in), bool(donate), mesh_key,
                tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed.items())))
         fn = self._cache.get(key) if use_cache else None
         obs.count("fluid.runs_total")
@@ -565,7 +718,7 @@ class Executor:
                 self._miss_streaks[churn_key] = streak
                 self._maybe_warn_churn(streak)
             fn = self._build(program, block, list(feed), kept_in, donated_in,
-                             fetch_names, written)
+                             fetch_names, written, shardings)
             if use_cache:
                 self._cache[key] = fn
                 while len(self._cache) > self.cache_capacity:
@@ -622,7 +775,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _build(self, program: Program, block: Block, feed_names, kept_in,
-               donated_in, fetch_names, written):
+               donated_in, fetch_names, written, shardings=None):
         has_host_ops = any(op.type == "fill_init" for op in block.ops)
 
         def raw(feed: Dict[str, Any], kept_vals: List[Any],
@@ -642,4 +795,22 @@ class Executor:
         # every donated name is also written (enforced by the _run split), so
         # XLA aliases each donated input buffer with its updated output —
         # params/BN stats update in place instead of allocating a second copy
-        return jax.jit(raw, donate_argnums=(2,) if donated_in else ())
+        donate_args = (2,) if donated_in else ()
+        if shardings is None:
+            return jax.jit(raw, donate_argnums=donate_args)
+        # GSPMD lowering: argument/result shardings pin the layout the
+        # resolver chose; XLA's SPMD partitioner inserts the collectives.
+        # Donated sharded buffers keep the same out-sharding, so the alias
+        # holds and updates stay in place per shard. EVERY output sharding
+        # is specified — fetches gather to replicated (the host reads them
+        # anyway): donation pairs inputs to outputs by aval, and a
+        # mesh-run with unspecified out_shardings mispairs a donated
+        # shard with a fetch on this jax version (alias size mismatch).
+        feed_sh, spec_of, out_sh, replicated = shardings
+        in_shardings = (feed_sh,
+                        [spec_of[n] for n in kept_in],
+                        [spec_of[n] for n in donated_in])
+        out_shardings = ([replicated] * len(fetch_names), out_sh)
+        return jax.jit(raw, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=donate_args)

@@ -27,13 +27,27 @@ def save_persistables(executor: Executor, dirname: str,
         to_tar(f, tree)
 
 
+def _restore(executor: Executor, program: Program, tree) -> None:
+    """Place loaded host arrays into the scope — sharded per the
+    executor's layout when it is mesh-aware (restore re-places onto the
+    CURRENT mesh; a checkpoint gathered on an 8-chip job loads fine onto
+    a 2-chip debug mesh because specs re-resolve against it)."""
+    import jax
+    import jax.numpy as jnp
+    block = program.global_block()
+    for name, arr in tree.items():
+        if executor.mesh is not None and name in block.vars:
+            sh = executor._persist_sharding(block, name, arr)
+            executor.scope.set(name, jax.device_put(jnp.asarray(arr), sh))
+        else:
+            executor.scope.set(name, jnp.asarray(arr))
+
+
 def load_persistables(executor: Executor, dirname: str,
                       main_program: Optional[Program] = None):
-    import jax.numpy as jnp
     with open(os.path.join(dirname, "persistables.tar"), "rb") as f:
         tree = from_tar(f)
-    for name, arr in tree.items():
-        executor.scope.set(name, jnp.asarray(arr))
+    _restore(executor, main_program or default_main_program(), tree)
 
 
 # -- merged inference model (capi merged-model + fluid io analog) ---------------
@@ -73,12 +87,9 @@ def export_inference_model(dirname: str, feed_names, fetch_vars,
 def load_inference_model(dirname: str, executor: Executor):
     """-> (program, feed_names, fetch_names); scope populated with params."""
     import json
-
-    import jax.numpy as jnp
     with open(os.path.join(dirname, "model.json")) as f:
         meta = json.load(f)
     program = Program.from_dict(meta["program"])
     with open(os.path.join(dirname, "params.tar"), "rb") as f:
-        for name, arr in from_tar(f).items():
-            executor.scope.set(name, jnp.asarray(arr))
+        _restore(executor, program, from_tar(f))
     return program, meta["feed_names"], meta["fetch_names"]

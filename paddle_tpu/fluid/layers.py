@@ -42,7 +42,9 @@ def _create_parameter(name_hint: str, shape, dtype="float32",
     layer's default initializer), ``is_static`` (frozen: no grad/update),
     ``lr_scale`` (per-param learning-rate multiplier) and ``l2_rate``
     (per-param weight decay) — the latter two consumed by
-    fluid.optimizer.Optimizer.minimize."""
+    fluid.optimizer.Optimizer.minimize — and ``sharding`` (one mesh axis
+    name or None per dim; lowered by the mesh-aware Executor, linted by
+    L004)."""
     main = default_main_program()
     attr = dict(attr) if attr else {}
     exact = attr.get("name")
@@ -62,7 +64,8 @@ def _create_parameter(name_hint: str, shape, dtype="float32",
             for key, current in (
                     ("is_static", not existing.trainable),
                     ("lr_scale", getattr(existing, "lr_scale", None)),
-                    ("l2_rate", getattr(existing, "l2_rate", None))):
+                    ("l2_rate", getattr(existing, "l2_rate", None)),
+                    ("sharding", getattr(existing, "sharding", None))):
                 if key in attr and attr[key] != current:
                     raise ValueError(
                         f"shared parameter {exact!r}: conflicting {key!r} "
@@ -80,6 +83,9 @@ def _create_parameter(name_hint: str, shape, dtype="float32",
         v.lr_scale = float(attr["lr_scale"])
     if attr.get("l2_rate") is not None:
         v.l2_rate = float(attr["l2_rate"])
+    if attr.get("sharding") is not None:
+        sh = attr["sharding"]
+        v.sharding = (sh,) if isinstance(sh, str) else tuple(sh)
     sb = default_startup_program().global_block()
     sv = sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True)
     sb.append_op("fill_init", inputs={}, outputs={"Out": [name]},

@@ -70,6 +70,18 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
                                              "XLA as donated buffers "
                                              "(updated in place, no second "
                                              "HBM copy)"),
+    "fluid.placed_bytes_total": ("counter", "persistable bytes device_put "
+                                            "onto the executor's mesh per "
+                                            "the resolved layout (init / "
+                                            "load / restore placement)"),
+    "fluid.param_bytes_per_device": ("gauge", "per-device share of the "
+                                              "persistable footprint under "
+                                              "the resolved shardings "
+                                              "(replicated would equal "
+                                              "param_bytes_global)"),
+    "fluid.param_bytes_global": ("gauge", "total persistable bytes the "
+                                          "mesh executor holds (the "
+                                          "replicated footprint)"),
     "fluid.run_seconds": ("histogram", "whole Executor.run duration"),
     "fluid.verify_seconds": ("histogram", "static pre-flight "
                                           "(analysis.check_or_raise)"),
@@ -101,6 +113,14 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "coord.request_errors_total": ("counter", "coord RPCs answered with "
                                               "an error (or raising), "
                                               "labels: type", ("type",)),
+    # -- mesh: fluid/executor.py (GSPMD sharding plane) -----------------
+    "mesh.axis_size": ("gauge", "devices along each mesh axis, "
+                                "labels: axis", ("axis",)),
+    "mesh.axis_utilization": ("gauge", "fraction of the persistable "
+                                       "footprint actually sharded over "
+                                       "each axis (1.0 = every parameter "
+                                       "byte divides along it), labels: "
+                                       "axis", ("axis",)),
     # -- obs: obs/aggregate.py (worker-side pusher) ---------------------
     "obs.pushes_total": ("counter", "registry snapshots pushed to the "
                                     "master (obs_push RPC)"),

@@ -21,9 +21,11 @@ TPU-native SPMD design (SURVEY.md §2.5):
   experts + tokens sharded over an ``expert`` axis, all_to_all dispatch).
 """
 
-from .mesh import MeshSpec, make_mesh, local_mesh, mesh_axis_size
+from .compat import pcast, shard_map
+from .mesh import (MeshSpec, current_mesh, make_mesh, local_mesh,
+                   mesh_axis_size, use_mesh)
 from .sharding import (replicate, shard, shard_batch, shard_params,
-                       with_sharding_constraint, ShardingRules)
+                       with_sharding_constraint, ShardingRules, SpecLayout)
 from .collectives import (all_reduce, all_gather, reduce_scatter, broadcast,
                           all_to_all, permute_ring, axis_index)
 from .data_parallel import DataParallel, Zero1DataParallel, Zero1State
@@ -36,8 +38,10 @@ from . import multihost
 
 __all__ = [
     "MeshSpec", "make_mesh", "local_mesh", "mesh_axis_size",
+    "current_mesh", "use_mesh",
+    "shard_map", "pcast",
     "replicate", "shard", "shard_batch", "shard_params",
-    "with_sharding_constraint", "ShardingRules",
+    "with_sharding_constraint", "ShardingRules", "SpecLayout",
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
     "permute_ring", "axis_index",
     "DataParallel",

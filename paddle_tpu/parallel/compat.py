@@ -1,0 +1,49 @@
+"""jax API compatibility for the parallel plane.
+
+The framework is written against the current spellings (``jax.shard_map``
+with ``check_vma``, ``lax.pcast`` for varying-axes typing). The tier-1
+environment carries an older jax where ``shard_map`` still lives in
+``jax.experimental.shard_map`` (kwarg ``check_rep``) and ``pcast`` does not
+exist. One resolution point here keeps every call site on the modern
+spelling — and keeps the whole parallel suite runnable on both jax
+generations instead of AttributeError-ing on import of the hot path.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["shard_map", "pcast"]
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        """``jax.shard_map`` spelling on top of the experimental module.
+
+        ``check_vma`` maps onto the old ``check_rep`` knob; when the caller
+        leaves it unset we default it OFF — the code base is written for
+        the varying-mesh-axes type system, and the legacy replication
+        checker rejects valid programs of that style (ppermute rings,
+        pallas_call bodies) that VMA accepts.
+        """
+        kw.setdefault("check_rep", bool(check_vma) if check_vma is not None
+                      else False)
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+
+
+if hasattr(lax, "pcast"):
+    pcast = lax.pcast
+else:
+    def pcast(x, axis_name, *, to="varying"):
+        """Identity fallback: ``pcast`` only adjusts the replication-
+        tracking *type* of a value (unvarying -> varying over an axis);
+        with the legacy checker disabled the value itself is already
+        correct."""
+        del axis_name, to
+        return x

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import make_mesh
 from .sharding import ShardingRules, replicate, shard_batch, shard_params
+from . import compat
 
 
 class DataParallel:
@@ -59,11 +60,19 @@ class DataParallel:
 
     # -- placement ---------------------------------------------------------
     def init(self, params, opt_state=None):
-        """Place params (+ optimizer state) on the mesh."""
+        """Place params (+ optimizer state) on the mesh. Called again on a
+        checkpoint restore, this is what re-places host arrays onto the
+        CURRENT mesh — the rules are a pure function of path+shape, so a
+        job resumed on a different mesh shape just re-resolves."""
         params = shard_params(params, self.mesh, self.rules)
         if opt_state is None:
             opt_state = self.opt.init(params)
-        opt_state = jax.device_put(opt_state, replicate(self.mesh))
+        if hasattr(self.rules, "resolve"):
+            # SpecLayout: slot paths embed their parameter's path, so the
+            # same resolution shards optimizer moments like their params
+            opt_state = self.rules.apply(self.mesh, opt_state)
+        else:
+            opt_state = jax.device_put(opt_state, replicate(self.mesh))
         return params, opt_state
 
     def shard_batch(self, batch):
@@ -204,7 +213,7 @@ class Zero1DataParallel:
                                                {"flat": flat_shard})
             return new_p["flat"], new_state, jax.lax.pmean(loss, axis)
 
-        fn = jax.shard_map(
+        fn = compat.shard_map(
             local_step, mesh=self.mesh,
             in_specs=(flat_spec, state_spec, stats_spec) + batch_specs,
             out_specs=(flat_spec, state_spec, P()),

@@ -9,13 +9,17 @@ named mesh axes.
 Canonical axis names used across the framework:
   ``data``  — batch sharding (DP)           ``model`` — tensor/model parallel (TP)
   ``pipe``  — pipeline stages (PP)          ``seq``   — sequence/context parallel (SP)
-  ``expert``— expert parallel (EP, reserved)
+  ``expert``— expert parallel (EP)          ``fsdp``  — parameter sharding (ZeRO-3
+  ``tp``    — tensor parallel (the           style: storage split, XLA gathers
+  ``SpecLayout`` spelling; ``model``         for compute)
+  remains the legacy alias)
 """
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -23,8 +27,8 @@ from jax.sharding import Mesh
 
 
 # Axis ordering: innermost (fastest-varying over devices) LAST so that the most
-# communication-heavy axis (model/seq) lands on nearest-neighbour ICI links.
-CANONICAL_ORDER = ("pipe", "data", "expert", "seq", "model")
+# communication-heavy axis (model/tp/seq) lands on nearest-neighbour ICI links.
+CANONICAL_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "model", "tp")
 
 
 @dataclass
@@ -79,3 +83,41 @@ def local_mesh(**axes: int) -> Mesh:
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape.get(axis, 1)
+
+
+# -- the ambient mesh ----------------------------------------------------------
+# One job, one logical mesh: components that place data (the fluid Executor,
+# checkpoint restore, benches) pick up the enclosing ``use_mesh`` instead of
+# each growing a mesh parameter on every call path. A ContextVar (not a
+# module-global list) keeps the scope per-thread/per-task — jax's own mesh
+# context is thread-local too, and a prefetch or RPC thread constructing an
+# Executor must not inherit (or corrupt) another thread's ambient mesh.
+
+import contextvars as _contextvars
+
+_MESH_STACK: "_contextvars.ContextVar[Tuple[Mesh, ...]]" = \
+    _contextvars.ContextVar("paddle_tpu_mesh_stack", default=())
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Make ``mesh`` the ambient mesh (``current_mesh``) for the scope.
+
+    Also enters jax's own mesh context so named-axis APIs resolve. An
+    ``Executor()`` constructed inside the scope adopts the mesh::
+
+        with pp.use_mesh(pp.make_mesh(data=2, fsdp=2, tp=2)):
+            exe = fluid.Executor(layout=pp.SpecLayout())
+    """
+    token = _MESH_STACK.set(_MESH_STACK.get() + (mesh,))
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _MESH_STACK.reset(token)
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The innermost :func:`use_mesh` mesh of this thread/task, or None."""
+    stack = _MESH_STACK.get()
+    return stack[-1] if stack else None

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import pallas_kernels as pk
+from . import compat
 
 _NEG = -1e30
 
@@ -415,7 +416,7 @@ def ring_self_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
         order = zigzag_order(T, n)
         q, k, v = (jnp.take(x, order, axis=1) for x in (q, k, v))
     # check_vma=False: pallas_call out_shapes carry no varying-mesh-axes info
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         partial(ring_attention, axis_name=seq_axis, causal=causal,
                 zigzag=zigzag),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -443,6 +444,6 @@ def ulysses_attention(mesh: Mesh, q, k, v, seq_axis: str = "seq",
         o = pk.flash_attention(q, k, v, causal=causal)
         return lax.all_to_all(o, seq_axis, split_axis=1, concat_axis=2, tiled=True)
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = compat.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -13,6 +13,7 @@ import re
 from typing import List, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -42,6 +43,17 @@ def shard_batch(batch, mesh: Mesh, axis: str = "data"):
     return jax.tree_util.tree_map(put, batch)
 
 
+def _first_match(rules, path: str) -> Optional[P]:
+    """First spec whose compiled pattern matches ``path`` (prefix match,
+    the ParamAttr-era regex contract) — the ONE implementation of rule
+    lookup, shared by ShardingRules and SpecLayout so their semantics
+    cannot drift."""
+    for pat, spec in rules:
+        if pat.match(path):
+            return spec
+    return None
+
+
 class ShardingRules:
     """Ordered (path-regex -> PartitionSpec) table for parameter pytrees.
 
@@ -61,10 +73,8 @@ class ShardingRules:
                                                   for pat, spec in rules]
 
     def spec_for(self, path: str) -> P:
-        for pat, spec in self.rules:
-            if pat.fullmatch(path) or pat.match(path):
-                return spec
-        return P()
+        spec = _first_match(self.rules, path)
+        return spec if spec is not None else P()
 
     def apply(self, mesh: Mesh, params):
         """device_put every leaf per its matched spec."""
@@ -82,8 +92,113 @@ class ShardingRules:
         return _unflatten_paths(out)
 
 
-def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
-    """Place a params pytree on the mesh (replicated unless rules say otherwise)."""
+class SpecLayout:
+    """Resolve parameters/persistables to PartitionSpecs on a named mesh.
+
+    The layout-resolution contract (docs/design/spmd.md), highest wins:
+
+    1. an explicit per-variable ``sharding`` annotation (``Variable.sharding``
+       riding Program JSON, or the ``annotation`` argument here),
+    2. the first matching user rule — an ordered (path-regex ->
+       PartitionSpec) table, :class:`ShardingRules` style,
+    3. built-in role rules (``roles=True``): embedding tables shard their
+       vocab dim over ``(fsdp, tp)``; other 2-D weights shard
+       ``(fsdp, tp)``; >=3-D kernels shard the output-channel (last) dim
+       over ``tp``; 1-D vectors and scalars replicate,
+    4. replicated.
+
+    Every resolved spec is then *fitted* to the actual mesh and value
+    shape: axes the mesh does not carry drop out of the spec, and a dim
+    whose extent is not divisible by its axes' total size falls back to
+    replicated on that dim — an annotation written for a 256-way pod
+    degrades gracefully on a 8-chip test mesh instead of erroring at
+    placement time.
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None, *,
+                 data_axis: str = "data", fsdp_axis: str = "fsdp",
+                 tp_axis: str = "tp", roles: bool = True):
+        self.data_axis = data_axis
+        self.fsdp_axis = fsdp_axis
+        self.tp_axis = tp_axis
+        self.roles = roles
+        self.rules = ShardingRules(rules) if rules else None
+
+    # -- resolution --------------------------------------------------------
+    def spec_for(self, path: str, shape: Sequence[int] = (),
+                 annotation: Optional[Sequence] = None) -> P:
+        """The un-fitted spec for one value (contract order above)."""
+        if annotation is not None:
+            return P(*annotation)
+        if self.rules is not None:
+            spec = _first_match(self.rules.rules, path)
+            if spec is not None:
+                return spec
+        if not self.roles:
+            return P()
+        ndim = len(shape)
+        if ndim >= 2 and "embed" in path.lower():
+            # vocab rows over fsdp x tp, feature dim replicated (the
+            # SNIPPETS [3] embeddings() layout)
+            return P((self.fsdp_axis, self.tp_axis),
+                     *([None] * (ndim - 1)))
+        if ndim == 2:
+            return P(self.fsdp_axis, self.tp_axis)
+        if ndim >= 3:
+            return P(*([None] * (ndim - 1)), self.tp_axis)
+        return P()
+
+    @staticmethod
+    def fit(mesh: Mesh, spec: P, shape: Sequence[int]) -> P:
+        """Trim ``spec`` to what ``mesh`` and ``shape`` support."""
+        entries = list(spec)[:len(shape)]
+        entries += [None] * (len(shape) - len(entries))
+        out = []
+        for dim, entry in zip(shape, entries):
+            axes = (entry,) if isinstance(entry, str) else tuple(entry or ())
+            axes = tuple(a for a in axes if a in mesh.shape)
+            total = 1
+            for a in axes:
+                total *= mesh.shape[a]
+            if not axes or total <= 1 or dim % total:
+                out.append(None)
+            elif len(axes) == 1:
+                out.append(axes[0])
+            else:
+                out.append(axes)
+        while out and out[-1] is None:      # canonical short form
+            out.pop()
+        return P(*out)
+
+    def resolve(self, mesh: Mesh, path: str, shape: Sequence[int],
+                annotation: Optional[Sequence] = None) -> NamedSharding:
+        spec = self.spec_for(path, shape, annotation)
+        return NamedSharding(mesh, self.fit(mesh, spec, shape))
+
+    def batch_spec(self, ndim: int) -> P:
+        """Activations/feeds: leading (batch) dim over ``data``."""
+        if ndim < 1:
+            return P()
+        return P(self.data_axis, *([None] * (ndim - 1)))
+
+    # -- ShardingRules-compatible pytree interface -------------------------
+    def apply(self, mesh: Mesh, params):
+        """device_put every leaf per its resolved sharding."""
+        flat = _flatten_with_paths(params)
+        out = {p: jax.device_put(l, self.resolve(mesh, p, np.shape(l)))
+               for p, l in flat}
+        return _unflatten_paths(out)
+
+    def shardings(self, mesh: Mesh, params):
+        """A pytree of NamedShardings matching ``params`` (jit in_shardings)."""
+        flat = _flatten_with_paths(params)
+        out = {p: self.resolve(mesh, p, np.shape(l)) for p, l in flat}
+        return _unflatten_paths(out)
+
+
+def shard_params(params, mesh: Mesh, rules=None):
+    """Place a params pytree on the mesh (replicated unless rules say
+    otherwise); ``rules`` is a :class:`ShardingRules` or :class:`SpecLayout`."""
     if rules is None:
         return jax.device_put(params, replicate(mesh))
     return rules.apply(mesh, params)

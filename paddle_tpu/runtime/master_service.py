@@ -139,6 +139,12 @@ class MasterServer:
         self.lease_lost = threading.Event()
         self._host, self._port = host, port
         self._srv_h = None        # native server handle (master_server.cc)
+        self._lib = None          # set (before handle publication) in start()
+        # guards every read/swap of _srv_h: stop() can race a housekeeping
+        # tick (or a second stop() from LeaseKeeper.on_lost), and the
+        # native handle must never be ptms_stop'd twice or fenced after
+        # free — both are a native crash, precisely during failover
+        self._srv_lock = threading.Lock()
         self.address: Tuple[str, int] = (host, port)
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -194,8 +200,23 @@ class MasterServer:
                 self._keeper = None
             raise OSError(f"ptms_start failed to bind "
                           f"{self._host}:{self._port}")
-        self._srv_h = h
+        # _lib must be live BEFORE the handle is published: the keeper
+        # thread is already running, and an _on_lease_lost -> stop() that
+        # observes the handle must be able to ptms_stop it. A stop() that
+        # ran to COMPLETION before publication saw _srv_h=None and stopped
+        # nothing — publishing now would leave a deposed master's native
+        # listener serving forever, so detect it and stop the handle here.
         self._lib = lib
+        with self._srv_lock:
+            if self._stop.is_set():
+                lib.ptms_stop(h)
+                h = None
+            else:
+                self._srv_h = h
+        if h is None:
+            raise RuntimeError(
+                "master stopped during start-up (lease lost or stop() "
+                "called before the server handle was published)")
         self.address = (self._host, out_port.value)
         # ops the native dispatch does not know (obs_push/obs_stats and
         # anything future) fall back into Python's _dispatch: the C++
@@ -222,9 +243,16 @@ class MasterServer:
             lib.ptms_reply(reply, data, len(data))
 
         self._fallback_cb = PTMS_FALLBACK_FN(_fallback)
-        lib.ptms_set_fallback(h, self._fallback_cb)
-        # push the initial fencing state before any request can mutate
-        lib.ptms_set_fenced(h, 1 if self._fenced_out() else 0)
+        # initial fencing state computed OUTSIDE the lock (filesystem read);
+        # the native calls re-read the handle under it — a stop() racing
+        # start() (lease lost mid-bring-up) may already have freed `h`, and
+        # these must then be skipped, not crash on a dead handle
+        fenced0 = 1 if self._fenced_out() else 0
+        with self._srv_lock:
+            if self._srv_h is not None:
+                lib.ptms_set_fallback(self._srv_h, self._fallback_cb)
+                # push the fencing state before any request can mutate
+                lib.ptms_set_fenced(self._srv_h, fenced0)
         hk = threading.Thread(target=self._housekeeping, daemon=True)
         hk.start()
         self._threads = [hk]
@@ -242,10 +270,14 @@ class MasterServer:
             self._keeper.stop(release=release_lease)
             self._keeper = None
         # native stop severs the listener AND every live connection — a
-        # deposed master must not keep answering connected clients
-        h, self._srv_h = self._srv_h, None
-        if h:
-            self._lib.ptms_stop(h)
+        # deposed master must not keep answering connected clients. The
+        # swap-and-call happens under the handle lock so a concurrent
+        # stop() or housekeeping tick can never double-free or fence a
+        # freed handle
+        with self._srv_lock:
+            h, self._srv_h = self._srv_h, None
+            if h:
+                self._lib.ptms_stop(h)
 
     def try_snapshot(self) -> bool:
         """Fenced snapshot write: refused (False) once a newer master has
@@ -272,10 +304,13 @@ class MasterServer:
                 return
             # keep the native server's fencing flag current (the C++
             # dispatch consults only this flag — same staleness bound as
-            # the old per-request cached check, one tick/renewal window)
-            if self._srv_h is not None:
-                self._lib.ptms_set_fenced(
-                    self._srv_h, 1 if self._fenced_out() else 0)
+            # the old per-request cached check, one tick/renewal window).
+            # _fenced_out() runs OUTSIDE the lock (it can hit the
+            # filesystem); only the handle read + native call are guarded
+            fenced = 1 if self._fenced_out() else 0
+            with self._srv_lock:
+                if self._srv_h is not None:
+                    self._lib.ptms_set_fenced(self._srv_h, fenced)
 
     def _fenced_out(self) -> bool:
         """Deposed-master check. Deposition is permanent, so a positive

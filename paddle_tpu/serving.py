@@ -127,6 +127,12 @@ class ContinuousBatcher:
         queue = list(requests)
         for r in queue:
             r.prompt = np.asarray(r.prompt, np.int32).reshape(-1)
+            if r.prompt.size == 0:
+                # prefill's ragged gather reads logits[b, pos-1]; pos==0
+                # wraps to the last padded position and the "first token"
+                # would be silent garbage — exactness demands a real prompt
+                raise ValueError(f"request {r.rid}: empty prompt (prefill "
+                                 "needs at least one token)")
             if r.prompt.size + 1 > self.model.max_len:
                 raise ValueError(f"request {r.rid}: prompt longer than "
                                  f"max_len {self.model.max_len}")

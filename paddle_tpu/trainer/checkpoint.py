@@ -49,6 +49,10 @@ COMPLETE_MANIFEST = "_COMPLETE"
 
 
 def _flatten(tree) -> Dict[str, np.ndarray]:
+    # gather-on-save: device_get on a fully-addressable sharded array
+    # assembles the global value, so checkpoints are mesh-independent and
+    # restore re-places onto whatever mesh the loader runs under
+    # (docs/design/spmd.md "Checkpoints across meshes")
     return {path: np.asarray(jax.device_get(leaf))
             for path, leaf in flatten_path_tree(tree)}
 

@@ -77,6 +77,11 @@ class Trainer:
       loss_fn: (params, *batch) -> scalar loss.
       optimizer: paddle_tpu optimizer.
       mesh: optional jax Mesh -> SPMD data-parallel step over its 'data' axis.
+      layout: optional :class:`paddle_tpu.parallel.SpecLayout` (or
+        ShardingRules) resolving parameter paths to PartitionSpecs —
+        params and optimizer slots shard across the mesh (fsdp/tp) instead
+        of replicating, and checkpoint restore re-places them onto the
+        current mesh via the same rules.
       outputs_fn: optional (params, *batch) -> dict of device metrics handed to
         evaluators (e.g. {'logits':..., 'labels':...}). Evaluated INSIDE the
         fused train step on the PRE-update parameters — the reference's
@@ -104,6 +109,7 @@ class Trainer:
     """
 
     def __init__(self, loss_fn: Callable, optimizer, *, mesh=None,
+                 layout=None,
                  outputs_fn: Optional[Callable] = None,
                  evaluators=None, output_dir: Optional[str] = None,
                  prefetch: int = 2, log_period: int = 0,
@@ -156,11 +162,19 @@ class Trainer:
         # (checkpointing the NaN-poisoned trees would make resume start from
         # garbage — worse than no checkpoint at all)
         guard_mode = on_nonfinite in ("skip", "halt")
+        if layout is not None and mesh is None:
+            from ..parallel.mesh import current_mesh
+            mesh = current_mesh()
+            if mesh is None:
+                raise ValueError("Trainer(layout=...) needs mesh=... or an "
+                                 "enclosing parallel.use_mesh(...)")
         self.mesh = mesh
+        self.layout = layout
         if mesh is not None:
             # the revert needs the pre-update trees alive after the step,
             # so buffer donation is off on that path
             self._dp = DataParallel(loss_fn, optimizer, mesh=mesh,
+                                    param_rules=layout,
                                     aux_fn=outputs_fn, donate=not guard_mode)
             self._step = None
         else:

@@ -22,6 +22,27 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _session_compile_cache(tmp_path_factory):
+    """Session-scoped persistent XLA compile cache (ROADMAP item 5).
+
+    Many tests trace structurally-identical small programs into FRESH jit
+    closures (every Executor/Trainer instantiation mints new callables),
+    so jax's in-memory cache never hits across tests — the persistent
+    cache keys on the serialized computation and does. Honors an external
+    $PADDLE_TPU_COMPILE_CACHE_DIR (e.g. a CI cache mount); otherwise a
+    session tmp dir so repeated shape families compile once per run. The
+    env var is exported so subprocess-spawning tests inherit the cache.
+    """
+    import paddle_tpu
+    path = os.environ.get(paddle_tpu.COMPILE_CACHE_ENV)
+    if not path:
+        path = str(tmp_path_factory.mktemp("xla_compile_cache"))
+        os.environ[paddle_tpu.COMPILE_CACHE_ENV] = path
+    paddle_tpu.enable_compile_cache(path)
+    yield
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deterministic fault-injection tests "

@@ -216,3 +216,26 @@ def test_model_zoo_features_example():
     import importlib
     mod = importlib.import_module("examples.model_zoo_features")
     mod.main()
+
+
+def test_cluster_train_num_workers_warning_sentinel():
+    """--hosts mode warns on ANY explicitly-passed --num_workers —
+    including the old default value 2 (the sentinel is now None, resolved
+    to 2 only in local mode; ADVICE r5)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "cluster_train", "s.py",
+             "--hosts", "h1,h2", "--dry-run", *extra],
+            capture_output=True, text=True, env=env, timeout=120)
+
+    r = run("--num_workers", "2")
+    assert r.returncode == 0
+    assert "ignoring --num_workers 2" in r.stderr
+    r = run("--num_workers", "5")
+    assert "ignoring --num_workers 5" in r.stderr
+    r = run()                                    # not passed: no warning
+    assert r.returncode == 0
+    assert "ignoring --num_workers" not in r.stderr
+    assert len([l for l in r.stdout.splitlines() if l.strip()]) == 2

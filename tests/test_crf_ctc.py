@@ -10,6 +10,7 @@ import numpy as np
 
 from paddle_tpu.ops import crf, ctc
 from op_test import check_grad
+import pytest
 
 
 def brute_force_log_norm(em, start, end, trans, length):
@@ -60,6 +61,9 @@ def test_crf_decode_matches_brute_force(np_rng):
     np.testing.assert_allclose(float(score[0]), best_s, rtol=1e-4)
 
 
+# slow: central-difference CRF grad (18s) — the registry numeric-gradient sweep
+# covers linear_chain_crf grads in tier-1
+@pytest.mark.slow
 def test_crf_loss_grad(np_rng):
     N, T = 3, 3
     em = np_rng.randn(2, T, N).astype(np.float32)
@@ -104,6 +108,8 @@ def test_ctc_matches_brute_force(np_rng):
     np.testing.assert_allclose(float(loss[0]), expect, rtol=1e-4)
 
 
+# slow: central-difference CTC grad (22s) — the registry sweep covers warpctc
+@pytest.mark.slow
 def test_ctc_grad(np_rng):
     T, V = 4, 3
     logits = np_rng.randn(2, T, V).astype(np.float32) * 0.5

@@ -140,6 +140,9 @@ def test_inception_branch_fusion_matches_unfused():
                                rtol=1e-5, atol=1e-5)
 
 
+# slow: stem-equivalence variant (37s); test_conv2d_stem_auto_route_matches_direct
+# keeps the auto-route stem covered in tier-1
+@pytest.mark.slow
 def test_googlenet_s2d_stem_matches_direct():
     """GoogleNet's s2d stem path equals the direct 7x7 conv (odd input
     sizes take the direct path)."""
@@ -236,7 +239,12 @@ def test_deepfm_learns():
     assert costs[-1] < costs[0] * 0.9
 
 
-@pytest.mark.parametrize("cls", ["alexnet", "googlenet"])
+@pytest.mark.parametrize("cls", [
+    "alexnet",
+    # slow: the googlenet variant compiles 35s of inception stacks; alexnet
+    # keeps the big-image-model forward+grad path covered in tier-1
+    pytest.param("googlenet", marks=pytest.mark.slow),
+])
 def test_alexnet_googlenet_forward_and_grad(cls):
     """AlexNet / GoogleNet (benchmark/paddle/image/{alexnet,googlenet}.py):
     ImageNet-shaped forward, and a finite training gradient with dropout /

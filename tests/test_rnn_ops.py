@@ -7,6 +7,7 @@ import numpy as np
 
 from paddle_tpu.ops import rnn
 from op_test import check_grad
+import pytest
 
 
 def _lstm_params(np_rng, D, H):
@@ -32,6 +33,8 @@ def test_lstm_masking_freezes_state(np_rng):
                                rtol=1e-5)
 
 
+# slow: central-difference LSTM grad (26s) — the registry sweep covers lstm W/U
+@pytest.mark.slow
 def test_lstm_grad(np_rng):
     D, H = 2, 3
     w, u, b = _lstm_params(np_rng, D, H)

@@ -108,3 +108,18 @@ def test_idle_slot_parking_near_max_len(model_and_params):
     got = b.serve([Request(0, prompt, gen)])
     want = _solo(model, params, prompt, gen)
     np.testing.assert_array_equal(got[0], want)
+
+
+def test_zero_length_prompt_rejected(model_and_params):
+    """pos==0 ragged-prefill gather would wrap to the last padded position
+    and emit a garbage first token — an empty prompt must be rejected in
+    validation, same as an over-long one (ADVICE r5)."""
+    model, params = model_and_params
+    b = ContinuousBatcher(model, params, slots=2, segment=8,
+                          cache_bucket=32)
+    with pytest.raises(ValueError, match="empty prompt"):
+        b.serve([Request(0, np.zeros((0,), np.int32), 4)])
+    # a mixed batch is rejected before any slot state is touched
+    with pytest.raises(ValueError, match="request 1"):
+        b.serve([Request(0, np.array([3, 5], np.int32), 4),
+                 Request(1, np.array([], np.int32), 4)])

@@ -231,6 +231,8 @@ def test_eval_outputs_fused_into_step():
     assert calls["n"] <= 2, f"outputs_fn traced {calls['n']} times"
 
 
+# slow: profiler-smoke variant of the benchmark path (18s)
+@pytest.mark.slow
 def test_benchmark_with_xla_profile(tmp_path):
     """--job=time with an XLA trace (hl_profiler / test_GpuProfiler.cpp
     analog): trace artifacts must land in the log dir."""
@@ -317,3 +319,29 @@ def test_param_stats_period_logs_magnitudes():
     lines = [m for m in records if m.startswith("param ")]
     assert any("fc.w" in ln and "absmax" in ln for ln in lines)
     assert len(lines) >= 4          # 2 params x 2 dumps (batches 2 and 4)
+
+
+def test_trainer_layout_shards_params_and_slots(tmp_path):
+    """Trainer(mesh=..., layout=...): params AND Adam moments place
+    sharded per the SpecLayout, training still converges, and a
+    checkpoint-resume re-places onto the current mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    model = _MLP()
+    mesh = pp.make_mesh(data=2, fsdp=2, tp=2)
+    layout = pp.SpecLayout()
+    trainer = Trainer(_loss(model), Adam(1e-3), mesh=mesh, layout=layout,
+                      output_dir=str(tmp_path))
+    params, opt_state = trainer.train(_reader(), model.init(jax.random.PRNGKey(0)),
+                                      num_passes=1, feeder=_feeder)
+    w1 = params["l1"]["w"]                      # (784, 64): (fsdp, tp)
+    assert w1.sharding.spec == P("fsdp", "tp")
+    assert w1.addressable_shards[0].data.shape == (392, 32)
+    m = opt_state["slots"]["l1"]["w"]["m"]      # Adam moment follows
+    assert m.sharding.spec == P("fsdp", "tp")
+    # resume: checkpoint gathered on save, re-placed sharded on restore
+    trainer2 = Trainer(_loss(model), Adam(1e-3), mesh=mesh, layout=layout,
+                       output_dir=str(tmp_path))
+    params2, _ = trainer2.train(_reader(), model.init(jax.random.PRNGKey(1)),
+                                num_passes=1, resume=True, feeder=_feeder)
+    assert params2["l1"]["w"].sharding.spec == P("fsdp", "tp")

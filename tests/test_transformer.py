@@ -99,6 +99,9 @@ def test_length_masked_loss():
     np.testing.assert_allclose(lm, lm2, rtol=1e-6)
 
 
+# slow: untied-head generate variant; tied-head generate + dense-reference
+# equivalence keep the decode path covered in tier-1
+@pytest.mark.slow
 def test_untied_head_shape_and_generate():
     model, params = _model(tie_head=False)
     ids = jax.random.randint(jax.random.PRNGKey(4), (2, 5), 0, V)
@@ -129,7 +132,7 @@ def test_seq_parallel_matches_single_device():
     def fwd(params, ids, positions):
         return model(params, ids, positions=positions, seq_axis="seq")
 
-    sharded = jax.jit(jax.shard_map(
+    sharded = jax.jit(pp.shard_map(
         fwd, mesh=mesh,
         in_specs=(P(), P(None, "seq"), P(None, "seq")),
         out_specs=P(None, "seq"), check_vma=False))
@@ -137,6 +140,8 @@ def test_seq_parallel_matches_single_device():
     np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
 
 
+# slow: remat-vs-no-remat equivalence is stable niche coverage (56s)
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     """remat=True (jax.checkpoint per block) must not change values or
     gradients — only the backward's memory/FLOP trade."""
@@ -225,7 +230,7 @@ def test_seq_parallel_shifted_loss_matches_unsharded():
         return model.shifted_loss(params, ids_in, targets,
                                   positions=positions, seq_axis="seq")
 
-    sharded = jax.jit(jax.shard_map(
+    sharded = jax.jit(pp.shard_map(
         f, mesh=mesh,
         in_specs=(P(), P(None, "seq"), P(None, "seq"), P(None, "seq")),
         out_specs=P(), check_vma=False))
@@ -236,6 +241,9 @@ def test_seq_parallel_shifted_loss_matches_unsharded():
         model.loss(params, ids, seq_axis="seq")
 
 
+# slow: full-reforward equivalence (77s); the bucketed cached-decode test and
+# the serving exact-parity suite keep cached decode covered in tier-1
+@pytest.mark.slow
 def test_cached_decode_matches_full_reforward():
     """KV-cache incremental decode (the serving path) must match the full
     re-forward greedy token-for-token, tied and untied heads."""
