@@ -129,6 +129,7 @@ def _capture_row(expr: str, timeout: float = ROW_TIMEOUT,
             continue
         _current_child = None
         lines = [l for l in out.splitlines() if l.startswith("{")]
+        lines = _validate_lines(expr, lines)
         if lines:
             return lines
         tail = "\n".join(err.splitlines()[-5:])
@@ -137,6 +138,28 @@ def _capture_row(expr: str, timeout: float = ROW_TIMEOUT,
               file=sys.stderr, flush=True)
         time.sleep(3)
     return []
+
+
+def _validate_lines(expr: str, lines: list) -> list:
+    """Bench-row schema gate (benchmarks/schema.py): a malformed row is
+    DROPPED loudly — and the row expression then retries/fails like any
+    other row failure — instead of printing a dict that silently lacks the
+    columns the trend tooling keys on. `paddle_tpu lint --bench-rows`
+    runs the same check statically over saved BENCH files."""
+    from benchmarks.schema import validate_row
+    kept = []
+    for line in lines:
+        try:
+            problems = validate_row(json.loads(line))
+        except ValueError as e:
+            problems = [f"not valid JSON: {e}"]
+        if problems:
+            print(f"bench: row {expr!r} emitted a malformed row "
+                  f"(dropped): {'; '.join(problems)}\n  {line[:200]}",
+                  file=sys.stderr, flush=True)
+        else:
+            kept.append(line)
+    return kept
 
 
 def _row(expr: str, timeout: float = ROW_TIMEOUT, tries: int = 2) -> bool:
@@ -266,6 +289,13 @@ def main(full: bool = False):
     for name in mods:
         rows.append((f"__import__('benchmarks.{name}', fromlist=['x'])"
                      ".run()", ROW_TIMEOUT))
+    # the decode-roofline rows (ROADMAP item 3): int8-KV decode (cache
+    # read halved) and speculative decoding (target weights stream once
+    # per round) next to the full-precision decode row above
+    rows.append(("__import__('benchmarks.serving_decode', fromlist=['x'])"
+                 ".run_quantized()", ROW_TIMEOUT))
+    rows.append(("__import__('benchmarks.speculative_decode', "
+                 "fromlist=['x']).run()", ROW_TIMEOUT))
     rows.append(("__import__('benchmarks.serving_decode', fromlist=['x'])"
                  ".run_continuous()", ROW_TIMEOUT))
     if full:
@@ -279,6 +309,8 @@ def main(full: bool = False):
         rows.append(("__import__('benchmarks.serving_decode', "
                      "fromlist=['x']).run_config(8, bucket=None)",
                      ROW_TIMEOUT))
+        rows.append(("__import__('benchmarks.speculative_decode', "
+                     "fromlist=['x']).run_tiny_draft()", ROW_TIMEOUT))
         rows.append(("__import__('benchmarks.resnet50', fromlist=['x'])"
                      ".run_with_infeed()", ROW_TIMEOUT))
         rows.append(("__import__('benchmarks.transformer_lm', "

@@ -84,13 +84,21 @@ def _time_path(fused: bool, iters: int, repeats: int) -> float:
 
 
 def run(iters: int = 100, repeats: int = 3):
+    from paddle_tpu.ops.rnn import _fused_bwd_plan, _fused_plan
+
     scan_ms = _time_path(False, iters, repeats)
     fused_ms = _time_path(True, iters, repeats)
     return {"metric": "lstm_fused_vs_scan_train_speedup_bs64_h256_len30-100",
             "value": round(scan_ms / fused_ms, 3), "unit": "x (scan_ms/fused_ms)",
             "vs_baseline": None,
             "scan_ms": round(scan_ms, 3), "fused_ms": round(fused_ms, 3),
-            "note": "full train step; fused = Pallas fwd + hand bwd kernels"}
+            "fwd_plan": _fused_plan(SEQ_LEN, HIDDEN, seq_h_units=6,
+                                    batch=BATCH),
+            "bwd_plan": _fused_bwd_plan(SEQ_LEN, HIDDEN, 4, 11, BATCH),
+            "note": "full train step; fused = Pallas fwd + hand bwd "
+                    "kernels under the ISSUE 7 wide-tile (block_b, "
+                    "chunk_t) plans — this row is the on-chip re-measure "
+                    "of the old blk=8 crossover (docs/design/kernels.md)"}
 
 
 if __name__ == "__main__":

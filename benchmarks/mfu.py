@@ -63,7 +63,13 @@ def step_flops(fn, *args, **kwargs) -> Optional[float]:
 
 def attach_mfu(result: dict, flops_per_step: Optional[float],
                sec_per_step: float) -> dict:
-    """Add mfu + gflops_per_step fields to a bench JSON record."""
+    """Add mfu + gflops_per_step fields to a bench JSON record.
+
+    ``mfu`` is ALWAYS present — null when the chip peak or the step FLOPs
+    are unknown (off-TPU hosts) — per the bench-row schema
+    (benchmarks/schema.py): a missing roofline column reads as a tooling
+    bug, an explicit null as an honest unknown."""
+    result.setdefault("mfu", None)
     if flops_per_step:
         result["gflops_per_step"] = round(flops_per_step / 1e9, 2)
         peak = peak_flops_per_sec()

@@ -162,15 +162,24 @@ def run_with_infeed(steps: int = 24, batch: int = BATCH):
               jnp.asarray(np.stack([hb[1] for hb in host_batches])))
     compute = chained_ms_per_step(run_n, (params, state) + staged, 12,
                                   2) / 1e3
-    return {"metric": f"resnet50_train_images_per_sec_bs{batch}_incl_infeed",
-            "value": round(batch / e2e, 2), "unit": "images/sec",
-            "vs_baseline": None,
-            "compute_only_images_per_sec": round(batch / compute, 2),
-            "overlap_ratio": round(compute / e2e, 3),
-            "infeed_mb_per_sec": round(batch_bytes / e2e / 1e6, 1),
-            "note": "DoubleBuffer uint8 host->HBM feed (on-device "
-                    "normalize) overlapped with compute; host link is a "
-                    "remote tunnel (deployment lower bound)"}
+    from benchmarks.mfu import attach_mfu, step_flops
+    flops = step_flops(step_fn, params, state,
+                       staged[0][0].astype(jnp.bfloat16) / 255.0,
+                       staged[1][0])
+    # e2e time: mfu here reads "fraction of peak sustained INCLUDING the
+    # infeed stall", pairing with overlap_ratio (bench-row schema:
+    # every *_train_* row carries its mfu column)
+    return attach_mfu(
+        {"metric": f"resnet50_train_images_per_sec_bs{batch}_incl_infeed",
+         "value": round(batch / e2e, 2), "unit": "images/sec",
+         "vs_baseline": None,
+         "compute_only_images_per_sec": round(batch / compute, 2),
+         "overlap_ratio": round(compute / e2e, 3),
+         "infeed_mb_per_sec": round(batch_bytes / e2e / 1e6, 1),
+         "note": "DoubleBuffer uint8 host->HBM feed (on-device "
+                 "normalize) overlapped with compute; host link is a "
+                 "remote tunnel (deployment lower bound)"},
+        flops, e2e)
 
 
 if __name__ == "__main__":

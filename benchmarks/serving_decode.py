@@ -46,21 +46,25 @@ def build(batch: int):
     return model, p16, prompt
 
 
-def _avg_step_bytes(model, params, batch: int, bucket) -> float:
-    """Average HBM bytes per decode step: weights + live cache rows."""
+def _avg_step_bytes(model, params, batch: int, bucket,
+                    kv_dtype=None) -> float:
+    """Average HBM bytes per decode step: weights + live cache rows.
+    int8 KV reads 1 byte/element plus one f32 scale per (row, head) —
+    the quantized cache term is ~(1/2 + 4/(2*Dh)) of the bf16 one."""
     w = _param_bytes(params)
     d_head = D_MODEL // N_HEADS
+    row_bytes = (N_HEADS * (d_head + 4) if kv_dtype == "int8"
+                 else N_HEADS * d_head * 2)
     total_cache = 0.0
     for i in range(STEPS):
         pos = PROMPT + i
         read = (MAX_LEN if bucket is None
                 else min(-(-(pos + 1) // bucket) * bucket, MAX_LEN))
-        # k + v, bf16, all layers
-        total_cache += 2 * 2 * batch * read * N_HEADS * d_head * N_LAYERS
+        total_cache += 2 * batch * read * row_bytes * N_LAYERS  # k + v
     return w + total_cache / STEPS
 
 
-def run_config(batch: int, bucket=256) -> dict:
+def run_config(batch: int, bucket=256, kv_dtype=None) -> dict:
     model, p16, prompt = build(batch)
 
     # ONE jitted program for prefill + every bucketed segment scan: an
@@ -68,7 +72,7 @@ def run_config(batch: int, bucket=256) -> dict:
     # remote tunnel each eager op pays the full dispatch RTT (measured
     # 35x slower end-to-end)
     decode = jax.jit(lambda p, ids: model.generate_cached(
-        p, ids, steps=STEPS, bucket=bucket))
+        p, ids, steps=STEPS, bucket=bucket, kv_dtype=kv_dtype))
 
     out = decode(p16, prompt)          # compile + warm
     int(out[0, -1])                    # fetch: block_until_ready lies
@@ -78,26 +82,45 @@ def run_config(batch: int, bucket=256) -> dict:
     dt = time.perf_counter() - t0
     ms_tok = dt / STEPS * 1e3
     toks_sec = batch * STEPS / dt
-    step_bytes = _avg_step_bytes(model, p16, batch, bucket)
+    step_bytes = _avg_step_bytes(model, p16, batch, bucket, kv_dtype)
     bw = step_bytes / (ms_tok / 1e3) / 1e9
-    return {"metric": f"transformer_lm_decode_tokens_per_sec_bs{batch}"
-                      f"_prompt{PROMPT}_gen{STEPS}"
-                      + ("" if bucket is None else f"_bucket{bucket}"),
-            "value": round(toks_sec, 1), "unit": "tokens/sec",
-            "vs_baseline": None,
-            "ms_per_token": round(ms_tok, 3),
-            "step_bytes_mb": round(step_bytes / 1e6, 1),
-            "hbm_bw_gbps": round(bw, 1),
-            "hbm_bw_util": round(bw / HBM_GBPS, 3),
-            "note": "GPT-2-small KV-cache greedy decode; bytes/step = bf16 "
-                    "weights + live cache rows (bucketed reads); util vs "
-                    f"{HBM_GBPS:.0f} GB/s v5e HBM"}
+    note = ("GPT-2-small KV-cache greedy decode; bytes/step = bf16 "
+            "weights + live cache rows (bucketed reads); util vs "
+            f"{HBM_GBPS:.0f} GB/s v5e HBM")
+    row = {"metric": f"transformer_lm_decode_tokens_per_sec_bs{batch}"
+                     f"_prompt{PROMPT}_gen{STEPS}"
+                     + ("" if bucket is None else f"_bucket{bucket}")
+                     + ("" if kv_dtype is None else f"_kv{kv_dtype}"),
+           "value": round(toks_sec, 1), "unit": "tokens/sec",
+           "vs_baseline": None,
+           "ms_per_token": round(ms_tok, 3),
+           "step_bytes_mb": round(step_bytes / 1e6, 1),
+           "hbm_bw_gbps": round(bw, 1),
+           "hbm_bw_util": round(bw / HBM_GBPS, 3),
+           "note": note}
+    if kv_dtype is not None:
+        full = _avg_step_bytes(model, p16, batch, bucket, None)
+        row["projected_bytes_reduction"] = round(full / step_bytes, 3)
+        row["note"] = (note + f"; {kv_dtype} KV cache — bytes/step "
+                       f"{step_bytes / 1e6:.1f} MB vs {full / 1e6:.1f} MB "
+                       "full-precision (the projected reduction; tokens "
+                       "follow the quantized-KV numerics contract, "
+                       "docs/design/kernels.md)")
+    return row
 
 
 def run() -> dict:
     """Driver row: the strongest static config, bs64 bucketed (bs8/bs32 in
     __main__)."""
     return run_config(64)
+
+
+def run_quantized() -> dict:
+    """The int8-KV decode row: same workload as run(), cache read halved —
+    the decode-roofline lever of ROADMAP item 3 (target >= 0.30 HBM-bw
+    util; on bytes-bound decode the tokens/sec gain tracks the bytes
+    reduction)."""
+    return run_config(64, kv_dtype="int8")
 
 
 def run_continuous(n_requests: int = 128, slots: int = 64,
@@ -153,4 +176,5 @@ if __name__ == "__main__":
     for bs in (8, 32, 64):
         print(json.dumps(run_config(bs)), flush=True)
     print(json.dumps(run_config(8, bucket=None)), flush=True)
+    print(json.dumps(run_quantized()), flush=True)
     print(json.dumps(run_continuous()), flush=True)

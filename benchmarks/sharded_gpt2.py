@@ -126,6 +126,7 @@ def run(iters: int = 12, repeats: int = 2, batch: int = BATCH,
                      f"_seq{seq}_mesh{n_dev}",
            "value": round(tokens / (ms / 1e3), 1), "unit": "tokens/sec",
            "vs_baseline": None,
+           "mfu": None,           # overwritten below when peak is known
            "note": note}
     peak = peak_flops_per_sec()
     if flops and peak:

@@ -421,6 +421,24 @@ struct Server {
       std::string resp =
           req.ok ? dispatch(req, body)
                  : std::string("{\"ok\": false, \"error\": \"bad request\"}");
+      // the Python client drops any frame over kMaxFrame as a dead
+      // connection, so an oversized response (a near-64 MB set_dataset
+      // payload whose JSON escaping expanded past the limit in a
+      // get_task reply) must degrade to a STRUCTURED error the client
+      // can surface, not a silent hangup (ADVICE r5).
+      // $PTMS_MAX_RESPONSE_FRAME shrinks the bound for tests (read per
+      // request so an in-process test can arm it after startup); the
+      // REQUEST bound stays kMaxFrame (the client enforces the same).
+      const char* rm_env = getenv("PTMS_MAX_RESPONSE_FRAME");
+      unsigned long rm_v = rm_env ? strtoul(rm_env, nullptr, 10) : 0;
+      const uint32_t resp_max =
+          (rm_v > 0 && rm_v <= kMaxFrame) ? (uint32_t)rm_v : kMaxFrame;
+      if (resp.size() > resp_max) {
+        resp = "{\"ok\": false, \"error\": \"payload too large: response "
+               "of " + std::to_string(resp.size()) +
+               " bytes exceeds the frame limit of " +
+               std::to_string((unsigned long)resp_max) + " bytes\"}";
+      }
       uint32_t out_le = htole32((uint32_t)resp.size());
       char hdr[4];
       memcpy(hdr, &out_le, 4);

@@ -218,8 +218,21 @@ def cmd_lint(args):
 
     Exit code: 0 clean (below the --fail-on threshold), 1 findings at or
     above it, 2 usage errors (missing/broken config).  --json emits
-    machine-readable diagnostics on a pure-JSON stdout."""
+    machine-readable diagnostics on a pure-JSON stdout.
+
+    ``--bench-rows FILE...`` additionally (or, without --config, ONLY)
+    validates saved bench rows — JSON or JSONL of bench.py output lines —
+    against the bench-row schema (analysis/bench_schema.py: required keys
+    per row, roofline columns per metric family), so a benchmark that
+    drops a column fails in CI instead of silently thinning the trend
+    data."""
     from . import analysis, fluid
+    if args.bench_rows and args.config is None:
+        return _lint_bench_rows(args.bench_rows, as_json=args.json)
+    if args.config is None:
+        print("lint: --config is required (or pass --bench-rows alone)",
+              file=sys.stderr)
+        return 2
     try:
         cfg = _load_config(args.config)
     except Exception as e:
@@ -270,7 +283,78 @@ def cmd_lint(args):
             print(analysis.format_diagnostics(all_diags))
         print(summary)
     failed = any(d.severity >= threshold for d in all_diags)
+    if args.bench_rows:
+        # under --json, bench-row findings go to STDERR so stdout stays
+        # the pure diagnostics JSON (`lint --json | jq` contract)
+        rc = _lint_bench_rows(args.bench_rows,
+                              stream=sys.stderr if args.json
+                              else sys.stdout)
+        failed = failed or rc != 0
     return 1 if failed else 0
+
+
+def _lint_bench_rows(paths, as_json: bool = False, stream=None) -> int:
+    """Validate bench-row files (JSON array/object or JSONL) against the
+    bench-row schema; 0 clean, 1 findings, 2 unreadable input.
+    ``as_json`` (the bench-rows-only ``--json`` path) emits the findings
+    as a JSON array on stdout instead of text lines."""
+    from .analysis.bench_schema import validate_row
+    stream = stream if stream is not None else sys.stdout
+    findings = []
+
+    def emit(path, ln, name, problem):
+        findings.append({"code": "B001", "path": path, "line": ln,
+                         "metric": name, "message": problem})
+        if not as_json:
+            print(f"{path}:{ln}: B001 bench-row-schema: {name}: {problem}",
+                  file=stream)
+
+    n_rows = n_bad = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            print(f"lint: cannot read bench rows {path!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        rows = []
+        try:
+            data = json.loads(text)
+            if isinstance(data, dict) and "metric" not in data \
+                    and isinstance(data.get("tail"), str):
+                # a driver record (BENCH_r0x.json): the rows live as JSONL
+                # inside its "tail" field
+                text = data["tail"]
+                raise ValueError("driver record: parse tail as JSONL")
+            rows = data if isinstance(data, list) else [data]
+        except ValueError:
+            for ln, line in enumerate(text.splitlines(), 1):
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue      # log noise / truncated tail heads
+                try:
+                    rows.append((ln, json.loads(line)))
+                except ValueError as e:
+                    emit(path, ln, "<no metric>", f"not valid JSON: {e}")
+                    n_bad += 1
+        rows = [r if isinstance(r, tuple) else (i + 1, r)
+                for i, r in enumerate(rows)]
+        for ln, row in rows:
+            n_rows += 1
+            for problem in validate_row(row):
+                name = (row.get("metric", "<no metric>")
+                        if isinstance(row, dict) else "<not a dict>")
+                emit(path, ln, name, problem)
+                n_bad += 1
+    if as_json:
+        print(json.dumps(findings, indent=1))
+        print(f"lint: bench rows — {n_bad} problem(s) over {n_rows} "
+              "row(s)", file=sys.stderr)
+    else:
+        print(f"lint: bench rows — {n_bad} problem(s) over {n_rows} "
+              "row(s)", file=stream)
+    return 1 if n_bad else 0
 
 
 def cmd_merge_model(args):
@@ -832,7 +916,13 @@ def main(argv=None) -> int:
 
     lt = sub.add_parser("lint", help="statically verify + lint the config's "
                                      "Program IR (no trace, no compile)")
-    common(lt)
+    lt.add_argument("--config", required=False, default=None,
+                    help="config to verify (optional when --bench-rows "
+                         "is given alone)")
+    lt.add_argument("--bench-rows", nargs="+", default=None,
+                    dest="bench_rows", metavar="FILE",
+                    help="also validate saved bench rows (BENCH_*.json / "
+                         "bench.py JSONL) against the bench-row schema")
     lt.add_argument("--fail-on", choices=["error", "warning", "info"],
                     default="error", dest="fail_on",
                     help="lowest severity that makes the exit code nonzero")

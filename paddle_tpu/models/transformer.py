@@ -18,9 +18,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .. import nn
+from .. import obs
 from ..nn.initializer import normal
 from ..ops import pallas_kernels as pk
 
@@ -187,7 +187,8 @@ class TransformerLM(nn.Module):
         return ids
 
     # -- incremental decoding (the serving path) ---------------------------
-    def prefill(self, params, prompt, lengths=None):
+    def prefill(self, params, prompt, lengths=None, *,
+                kv_dtype: Optional[str] = None):
         """Run the prompt once, materializing per-layer KV caches padded to
         max_len. Returns (cell, last_logits [B, V]); cell carries the caches
         and the per-sample write position.
@@ -199,7 +200,17 @@ class TransformerLM(nn.Module):
         decode mask (j <= pos) never reads a row past ``pos``, and each
         generation step overwrites row ``pos`` before advancing — so the
         garbage is overwritten strictly before it becomes readable. This is
-        the slot-refill path of continuous batching (serving.py)."""
+        the slot-refill path of continuous batching (serving.py).
+
+        ``kv_dtype="int8"`` stores the caches as symmetric int8 rows with
+        per-(position, head) f32 scales (``k{i}_scale``/``v{i}_scale`` in
+        the cell) — decode's HBM cache read halves; the prompt forward
+        itself still runs full precision (the quantization error enters
+        only through later cache READS; docs/design/kernels.md states the
+        numerics contract)."""
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                             "(None or 'int8')")
         B, T0 = prompt.shape
         x = self.embed(params["embed"], prompt)
         x = x + params["pos_embed"][:T0].astype(x.dtype)
@@ -208,13 +219,26 @@ class TransformerLM(nn.Module):
         else:
             cell = {"pos": jnp.asarray(lengths, jnp.int32)}
         pad = self.max_len - T0
+        pad4 = ((0, 0), (0, pad), (0, 0), (0, 0))
         for i in range(len(self.blocks)):
             blk = self.blocks[i]
             q, k, v = blk.heads(params[f"blocks_{i}"], x)
             o = blk.attend(q, k, v)
             x = blk.finish(params[f"blocks_{i}"], x, o)
-            cell[f"k{i}"] = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-            cell[f"v{i}"] = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            if kv_dtype == "int8":
+                k8, ks = pk.quantize_kv(k)
+                v8, vs = pk.quantize_kv(v)
+                cell[f"k{i}"] = jnp.pad(k8, pad4)
+                cell[f"v{i}"] = jnp.pad(v8, pad4)
+                # padded scales are 1.0 so dequant of (masked) garbage rows
+                # stays finite
+                cell[f"k{i}_scale"] = jnp.pad(
+                    ks, ((0, 0), (0, pad), (0, 0)), constant_values=1.0)
+                cell[f"v{i}_scale"] = jnp.pad(
+                    vs, ((0, 0), (0, pad), (0, 0)), constant_values=1.0)
+            else:
+                cell[f"k{i}"] = jnp.pad(k, pad4)
+                cell[f"v{i}"] = jnp.pad(v, pad4)
         x = self.ln_f(params["ln_f"], x)
         logits = (x @ params["embed"]["w"].T.astype(x.dtype)
                   if self.tie_head else self.head(params["head"], x))
@@ -222,8 +246,29 @@ class TransformerLM(nn.Module):
             return cell, logits[:, -1]
         return cell, logits[jnp.arange(B), cell["pos"] - 1]
 
+    def _append_rows(self, cell, new_cell, i, k, v, pos):
+        """Write this step's k/v rows ([B, S, H, Dh]) at pos..pos+S-1 and
+        return the updated (kc, vc, k_scale, v_scale) cache views —
+        quantizing the new rows when the cell carries an int8 cache."""
+        quant = f"k{i}_scale" in cell
+        upd = jax.vmap(lambda c, r, p: jax.lax.dynamic_update_slice(
+            c, r, (p,) + (0,) * (c.ndim - 1)))
+        if quant:
+            k, ks = pk.quantize_kv(k)
+            v, vs = pk.quantize_kv(v)
+            ksc = upd(cell[f"k{i}_scale"], ks, pos)
+            vsc = upd(cell[f"v{i}_scale"], vs, pos)
+            new_cell[f"k{i}_scale"], new_cell[f"v{i}_scale"] = ksc, vsc
+        else:
+            ksc = vsc = None
+        kc = upd(cell[f"k{i}"], k, pos)
+        vc = upd(cell[f"v{i}"], v, pos)
+        new_cell[f"k{i}"], new_cell[f"v{i}"] = kc, vc
+        return kc, vc, ksc, vsc
+
     def decode_step(self, params, cell, tokens, *,
-                    cache_len: Optional[int] = None):
+                    cache_len: Optional[int] = None,
+                    attn_route: Optional[str] = None):
         """One incremental step: tokens [B] -> (logits [B, V], new cell).
         Attention reads the KV cache (masked to written positions) instead
         of re-running the prefix — O(T) per token instead of O(T^2).
@@ -232,38 +277,84 @@ class TransformerLM(nn.Module):
         entries: the cache is stored padded to max_len, but a step whose
         positions are all < cache_len only streams cache_len rows from HBM
         instead of max_len — the bucketed serving path (callers guarantee
-        pos < cache_len; generate_cached's bucketing does)."""
+        pos < cache_len; generate_cached's bucketing does).
+
+        The cache read goes through the ONE auto-routing entry point
+        ``ops.pallas_kernels.decode_attention`` (dense reference math for
+        short reads / off-TPU, the per-sample Pallas kernel for long
+        on-TPU reads; ``attn_route`` forces a route for tests). int8
+        cells (prefill ``kv_dtype="int8"``) quantize the appended row and
+        dequantize reads in-kernel."""
         pos = cell["pos"]                                  # [B]
         L = self.max_len if cache_len is None else min(cache_len,
                                                        self.max_len)
         x = self.embed(params["embed"], tokens[:, None])   # [B, 1, D]
         x = x + params["pos_embed"][pos][:, None, :].astype(x.dtype)
         new_cell = {"pos": pos + 1}
-        upd = jax.vmap(
-            lambda c, kv, p: jax.lax.dynamic_update_slice(
-                c, kv[None], (p, 0, 0)))
         for i in range(len(self.blocks)):
             blk = self.blocks[i]
             q, k, v = blk.heads(params[f"blocks_{i}"], x)  # [B, 1, H, Dh]
-            kc = upd(cell[f"k{i}"], k[:, 0], pos)
-            vc = upd(cell[f"v{i}"], v[:, 0], pos)
-            new_cell[f"k{i}"], new_cell[f"v{i}"] = kc, vc
-            s = jnp.einsum("bhd,bshd->bhs", q[:, 0].astype(jnp.float32),
-                           kc[:, :L].astype(jnp.float32)) / np.sqrt(blk.d_head)
-            valid = (jnp.arange(L)[None, :]
-                     <= pos[:, None])[:, None, :]
-            s = jnp.where(valid, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhs,bshd->bhd", p,
-                           vc[:, :L].astype(jnp.float32))[:, None]
-            x = blk.finish(params[f"blocks_{i}"], x, o)
+            kc, vc, ksc, vsc = self._append_rows(cell, new_cell, i,
+                                                 k, v, pos)
+            o = pk.decode_attention(
+                q[:, 0], kc[:, :L], vc[:, :L], pos,
+                scale=blk.d_head ** -0.5,
+                k_scale=None if ksc is None else ksc[:, :L],
+                v_scale=None if vsc is None else vsc[:, :L],
+                route=attn_route)
+            x = blk.finish(params[f"blocks_{i}"], x, o[:, None])
         x = self.ln_f(params["ln_f"], x)
         logits = (x @ params["embed"]["w"].T.astype(x.dtype)
                   if self.tie_head else self.head(params["head"], x))
         return logits[:, 0], new_cell
 
+    def verify_step(self, params, cell, tokens, *,
+                    cache_len: Optional[int] = None):
+        """Multi-token incremental step — the speculative-decoding verify:
+        tokens [B, S] are appended to the cache (rows pos..pos+S-1) and
+        scored in ONE batched pass, returning (logits [B, S, V], new cell)
+        where logits[:, i] is the next-token distribution after tokens
+        [..., :i+1]. Equivalent to S sequential decode_step calls at S-th
+        of the dispatches; query i attends cache rows j <= pos+i (causal
+        within the span, everything live before it). Works on int8 cells
+        (span rows quantize on append; reads dequantize)."""
+        B, S = tokens.shape
+        pos = cell["pos"]                                  # [B]
+        L = self.max_len if cache_len is None else min(cache_len,
+                                                       self.max_len)
+        offs = jnp.arange(S, dtype=jnp.int32)
+        positions = pos[:, None] + offs[None, :]           # [B, S]
+        x = self.embed(params["embed"], tokens)            # [B, S, D]
+        x = x + params["pos_embed"][positions].astype(x.dtype)
+        new_cell = {"pos": pos + S}
+        for i in range(len(self.blocks)):
+            blk = self.blocks[i]
+            q, k, v = blk.heads(params[f"blocks_{i}"], x)  # [B, S, H, Dh]
+            kc, vc, ksc, vsc = self._append_rows(cell, new_cell, i,
+                                                 k, v, pos)
+            kr = kc[:, :L].astype(jnp.float32)
+            vr = vc[:, :L].astype(jnp.float32)
+            if ksc is not None:
+                kr = kr * ksc[:, :L, :, None]
+                vr = vr * vsc[:, :L, :, None]
+            s = jnp.einsum("bihd,bjhd->bhij",
+                           q.astype(jnp.float32) * blk.d_head ** -0.5, kr)
+            valid = (jnp.arange(L)[None, None, None, :]
+                     <= positions[:, None, :, None])       # [B, 1, S, L]
+            s = jnp.where(valid, s, -1e30)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s - m)
+            p = p / jnp.sum(p, axis=-1, keepdims=True)
+            o = jnp.einsum("bhij,bjhd->bihd", p, vr)       # [B, S, H, Dh]
+            x = blk.finish(params[f"blocks_{i}"], x, o)
+        x = self.ln_f(params["ln_f"], x)
+        logits = (x @ params["embed"]["w"].T.astype(x.dtype)
+                  if self.tie_head else self.head(params["head"], x))
+        return logits, new_cell
+
     def generate_cached(self, params, prompt, steps: int,
-                        bucket: Optional[int] = None):
+                        bucket: Optional[int] = None,
+                        kv_dtype: Optional[str] = None):
         """Greedy continuation through the KV cache: jitted scans, no
         prefix re-forward. Matches generate_greedy token-for-token.
 
@@ -283,7 +374,7 @@ class TransformerLM(nn.Module):
                 f"prompt_len ({prompt.shape[1]}) + steps ({steps}) exceeds "
                 f"max_len ({self.max_len}); use generate_greedy for "
                 "sliding-window generation past the trained context")
-        cell, last_logits = self.prefill(params, prompt)
+        cell, last_logits = self.prefill(params, prompt, kv_dtype=kv_dtype)
         first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
 
         def make_body(cache_len):
@@ -318,3 +409,130 @@ class TransformerLM(nn.Module):
                 pos += seg
             toks = jnp.concatenate(chunks, axis=1)
         return jnp.concatenate([prompt, toks], axis=1)
+
+    # -- the fused decode step (one compiled dispatch per token) -----------
+    def _decode_fn(self, kind, **static):
+        """Model-instance cache of the jitted decode-step programs: a fresh
+        ``jax.jit`` closure per call would recompile per call, so repeated
+        generate_fused/speculative runs (bench warm-up + measure) reuse one
+        executable per static config."""
+        cache = self.__dict__.setdefault("_decode_jit", {})
+        key = (kind,) + tuple(sorted(static.items()))
+        fn = cache.get(key)
+        if fn is not None:
+            return fn
+        if kind == "prefill":
+            kv_dtype = static["kv_dtype"]
+            sample = static.get("sample", "greedy")
+            top_k = static.get("top_k")
+            temp = static.get("temperature", 1.0)
+
+            def pf(params, prompt, rng):
+                cell, last = self.prefill(params, prompt, kv_dtype=kv_dtype)
+                first, rng = _sample_token(last, rng, sample, top_k, temp)
+                return cell, first.astype(prompt.dtype), rng
+            fn = jax.jit(pf)
+        elif kind == "step":
+            cache_len = static["cache_len"]
+            sample = static["sample"]
+            top_k, temp = static["top_k"], static["temperature"]
+            attn_route = static["attn_route"]
+
+            def step(params, cell, cur, rng):
+                logits, cell = self.decode_step(params, cell, cur,
+                                                cache_len=cache_len,
+                                                attn_route=attn_route)
+                nxt, rng = _sample_token(logits, rng, sample, top_k, temp)
+                return cell, nxt.astype(cur.dtype), rng
+            fn = jax.jit(step)
+        elif kind == "verify":
+            cache_len = static["cache_len"]
+
+            def vf(params, cell, span):
+                logits, cell = self.verify_step(params, cell, span,
+                                                cache_len=cache_len)
+                return jnp.argmax(logits, axis=-1).astype(span.dtype), cell
+            fn = jax.jit(vf)
+        else:
+            raise ValueError(kind)
+        cache[key] = fn
+        return fn
+
+    def generate_fused(self, params, prompt, steps: int, *,
+                       bucket: Optional[int] = None,
+                       kv_dtype: Optional[str] = None,
+                       sample: str = "greedy", top_k: Optional[int] = None,
+                       temperature: float = 1.0, key=None,
+                       attn_route: Optional[str] = None):
+        """The fused decode loop: ONE compiled dispatch per generated token
+        (prefill emits the first; every later token is a single jitted
+        step fusing cache append + attention read + MLP + logits +
+        greedy/top-k sampling), vs one dispatch PER OP for an eager
+        decode. Greedy output is token-for-token identical to
+        :meth:`generate_cached` (tests/test_decode_fused.py).
+
+        Evidence rides the obs plane: ``decode.dispatches_total``
+        (route=prefill|step) counts real host dispatches — exactly
+        ``steps`` for ``steps`` tokens — ``decode.tokens_total`` the
+        emitted tokens, and ``kernels.bytes_total{kernel=decode_attention}``
+        the modeled cache-read bytes (halved under ``kv_dtype="int8"``).
+
+        ``sample="topk"`` needs ``top_k`` and a PRNG ``key``; greedy
+        ignores both."""
+        if prompt.shape[1] + steps > self.max_len:
+            raise ValueError(
+                f"prompt_len ({prompt.shape[1]}) + steps ({steps}) exceeds "
+                f"max_len ({self.max_len})")
+        if sample not in ("greedy", "topk"):
+            raise ValueError(f"unknown sample mode {sample!r}")
+        if sample == "topk" and (top_k is None or key is None):
+            raise ValueError("sample='topk' needs top_k and key")
+        B, T0 = prompt.shape
+        rng = key if key is not None else jax.random.PRNGKey(0)
+        cell, cur, rng = self._decode_fn(
+            "prefill", kv_dtype=kv_dtype, sample=sample, top_k=top_k,
+            temperature=temperature)(params, prompt, rng)
+        obs.count("decode.dispatches_total", route="prefill")
+        toks = [cur]
+        kv_bytes = 1 if kv_dtype == "int8" else \
+            jnp.dtype(self._compute_dtype(params)).itemsize
+        n_heads = self.blocks[0].n_heads
+        d_head = self.blocks[0].d_head
+        for j in range(1, steps):
+            pos = T0 + j                       # max live position + 1
+            if bucket is None:
+                cache_len = None
+                L = self.max_len
+            else:
+                cache_len = min(-(-pos // bucket) * bucket, self.max_len)
+                L = cache_len
+            step = self._decode_fn("step", cache_len=cache_len,
+                                   sample=sample, top_k=top_k,
+                                   temperature=temperature,
+                                   attn_route=attn_route)
+            cell, cur, rng = step(params, cell, cur, rng)
+            toks.append(cur)
+            obs.count("decode.dispatches_total", route="step")
+            obs.count("kernels.bytes_total",
+                      2 * B * L * n_heads * (d_head * kv_bytes
+                                             + (4 if kv_dtype == "int8"
+                                                else 0))
+                      * len(self.blocks),
+                      kernel="decode_attention")
+        obs.count("decode.tokens_total", B * steps, route="fused")
+        return jnp.concatenate([prompt, jnp.stack(toks, axis=1)], axis=1)
+
+    def _compute_dtype(self, params):
+        """dtype of the attention k/v activations (follows the embedding
+        table, which the cache rows inherit)."""
+        return params["embed"]["w"].dtype
+
+
+def _sample_token(logits, rng, sample, top_k, temperature):
+    """Greedy argmax or top-k/temperature sampling from [B, V] logits."""
+    if sample == "greedy":
+        return jnp.argmax(logits, axis=-1), rng
+    v, idx = jax.lax.top_k(logits.astype(jnp.float32), top_k)
+    rng, sub = jax.random.split(rng)
+    choice = jax.random.categorical(sub, v / temperature)
+    return jnp.take_along_axis(idx, choice[:, None], 1)[:, 0], rng

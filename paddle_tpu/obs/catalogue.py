@@ -49,6 +49,23 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "data.retries_total": ("counter", "cloud_reader idle-poll retries"),
     "data.giveups_total": ("counter", "cloud_reader starvation deadlines"),
     "data.backoff_seconds_total": ("counter", "total poll backoff slept"),
+    # -- decode: models/transformer.py generate_fused, serving.py -------
+    "decode.dispatches_total": ("counter", "compiled decode-step programs "
+                                           "dispatched from the host (ONE "
+                                           "serves a whole token / segment "
+                                           "/ verify span — the fused-"
+                                           "decode contract), labels: "
+                                           "route", ("route",)),
+    "decode.tokens_total": ("counter", "tokens emitted by decode loops "
+                                       "(generate_fused / continuous "
+                                       "batching / speculative), labels: "
+                                       "route", ("route",)),
+    "decode.spec_proposed_total": ("counter", "draft tokens proposed to "
+                                              "speculative verify"),
+    "decode.spec_accepted_total": ("counter", "proposed tokens the "
+                                              "target's verify accepted "
+                                              "(acceptance rate = "
+                                              "accepted/proposed)"),
     # -- faults: faults/inject.py ---------------------------------------
     "faults.injected_total": ("counter", "faults fired, labels: site, "
                                          "action — a chaos run is "
@@ -89,6 +106,19 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "jax.compiles_total": ("counter", "XLA backend compiles observed "
                                       "(one per executable built)"),
     "jax.compile_seconds": ("histogram", "XLA backend-compile durations"),
+    # -- kernels: ops/pallas_kernels.py, ops/rnn.py entry points --------
+    "kernels.bytes_total": ("counter", "modeled HBM bytes streamed by "
+                                       "Pallas-kernel reads, counted at "
+                                       "host-dispatched call sites (decode: "
+                                       "live cache rows, halved under int8 "
+                                       "KV), labels: kernel", ("kernel",)),
+    "kernels.routes_total": ("counter", "auto-route decisions at the "
+                                        "kernel entry points; counted when "
+                                        "the routing Python runs — once "
+                                        "per TRACE for in-jit sites, not "
+                                        "per executed step, labels: "
+                                        "kernel, route",
+                             ("kernel", "route")),
     # -- lease: runtime/coord.py, runtime/lease.py ----------------------
     "lease.renews_total": ("counter", "lease renewals attempted"),
     "lease.renew_failures_total": ("counter", "renewals the server "

@@ -526,6 +526,155 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 # ---------------------------------------------------------------------------
+# Fused decode-step attention — the KV-cache read is the bytes term that
+# dominates incremental decode (BENCH_r05: ~18% of the v5e's 819 GB/s).
+# One program per SAMPLE streams that sample's live cache rows through VMEM
+# once, in the cache's natural [L, H, D] layout (no head transpose in HBM),
+# masks rows past the write position, and runs the f32 softmax read there.
+# The int8 path dequantizes rows in VMEM from per-(row, head) scales, so the
+# HBM cache term halves (2 bytes -> 1 + scale overhead) while the matmuls
+# stay f32 — the quantized-KV numerics contract of docs/design/kernels.md.
+# ---------------------------------------------------------------------------
+
+def _decode_attn_kernel(q_ref, k_ref, v_ref, pos_ref, o_ref, *, scale: float):
+    """One sample: q [1, H, D], k/v [1, L, H, D], pos [1, 1, 1] int32 ->
+    o [1, H, D] f32. Rows j <= pos are live (row pos holds THIS step's k/v,
+    appended before the read)."""
+    q = q_ref[0].astype(jnp.float32) * scale            # [H, D]
+    k = k_ref[0].astype(jnp.float32)                    # [L, H, D]
+    v = v_ref[0].astype(jnp.float32)
+    _decode_attn_body(q, k, v, pos_ref[0, 0, 0], o_ref)
+
+
+def _decode_attn_q_kernel(q_ref, k_ref, ks_ref, v_ref, vs_ref, pos_ref,
+                          o_ref, *, scale: float):
+    """int8-KV variant: k/v int8 [1, L, H, D] with per-(row, head) f32
+    scales [1, L, H]; rows dequantize in VMEM, never materializing an f32
+    cache in HBM."""
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0][..., None]
+    v = v_ref[0].astype(jnp.float32) * vs_ref[0][..., None]
+    _decode_attn_body(q, k, v, pos_ref[0, 0, 0], o_ref)
+
+
+def _decode_attn_body(q, k, v, pos, o_ref):
+    """Shared masked-softmax read: head-batched dots, softmax over live
+    rows. Identical formulation to _dense_decode_attention so the kernel
+    and reference routes agree to the ulp on the same inputs."""
+    L = k.shape[0]
+    # [H, L]: contract D, batch H
+    s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (1,))),
+                            preferred_element_type=jnp.float32)
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
+    s = jnp.where(j <= pos, s, _NEG)
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=1, keepdims=True)
+    # [H, D]: contract L, batch H
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((0,), (1,))),
+                            preferred_element_type=jnp.float32)
+    o_ref[0] = o / l
+
+
+def quantize_kv(x: jax.Array):
+    """Symmetric int8 rows for the KV cache: x [..., D] ->
+    (q int8 [..., D], scale f32 [...]) with x ~= q * scale per row.
+
+    Per-(position, head) scales: one f32 per D-vector — 2 extra bytes per
+    64-element bf16 row vs the 64 saved, so the cache read genuinely
+    halves. scale = amax/127 keeps the codebook symmetric (no zero-point),
+    matching the in-kernel dequant ``q.astype(f32) * scale``."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dense_decode_attention(q, k, v, pos, scale, k_scale, v_scale):
+    """Reference-math route (short caches / off-TPU): same masked-softmax
+    formulation as the kernel, ordinary XLA ops. Quantized caches
+    dequantize up front — numerically the kernel's contract, but the f32
+    cache materializes, so this route only makes sense where the cache is
+    small anyway."""
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[..., None]
+        v = v.astype(jnp.float32) * v_scale[..., None]
+    L = k.shape[1]
+    s = jnp.einsum("bhd,bjhd->bhj", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    valid = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, :]
+    s = jnp.where(valid, s, _NEG)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bhj,bjhd->bhd", p / l, v.astype(jnp.float32))
+
+
+def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     pos: jax.Array, *, scale: Optional[float] = None,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None,
+                     route: Optional[str] = None,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """Single-token KV-cache attention read — THE auto-routing entry for
+    the decode step (models/transformer.py decode_step and everything
+    above it: generate_cached/generate_fused, serving.ContinuousBatcher,
+    speculative verify).
+
+    q: [B, H, D] (this step's query); k/v: [B, L, H, D] cache slices
+    already bounded to the live read length L (callers slice ``[:, :L]``
+    per their cache bucket); pos: [B] int32 — rows j <= pos[b] are live.
+    k_scale/v_scale: [B, L, H] f32 per-row dequant scales when k/v are
+    int8 (see :func:`quantize_kv`). Returns o [B, H, D] f32.
+
+    Routing (``route=None``): the Pallas kernel streams the cache once
+    per sample and wins exactly where decode is cache-bytes-bound — long
+    reads on the TPU; short reads (L < SHORT_SEQ_DENSE) and off-TPU hosts
+    take the dense reference math, where XLA's fusion already keeps the
+    small score tensor out of HBM. Both routes share one masked-softmax
+    formulation, so route choice never changes greedy tokens
+    (tests/test_decode_fused.py asserts this bit-for-bit on CPU via
+    ``route="kernel", interpret=True``)."""
+    B, L, H, D = k.shape
+    scale_v = scale if scale is not None else D ** -0.5
+    if route is None:
+        route = ("kernel" if _on_tpu() and L >= SHORT_SEQ_DENSE
+                 else "dense")
+    from .. import obs
+    obs.count("kernels.routes_total", kernel="decode_attention", route=route)
+    if route == "dense":
+        return _dense_decode_attention(q, k, v, pos, scale_v, k_scale,
+                                       v_scale)
+    if route != "kernel":
+        raise ValueError(f"unknown decode_attention route {route!r}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    posb = pos.astype(jnp.int32)[:, None, None]          # [B, 1, 1]
+    q_spec = pl.BlockSpec((1, H, D), lambda b: (b, 0, 0))
+    kv_spec = pl.BlockSpec((1, L, H, D), lambda b: (b, 0, 0, 0))
+    sc_spec = pl.BlockSpec((1, L, H), lambda b: (b, 0, 0))
+    pos_spec = pl.BlockSpec((1, 1, 1), lambda b: (b, 0, 0))
+    out_spec = pl.BlockSpec((1, H, D), lambda b: (b, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((B, H, D), jnp.float32)
+    if k_scale is not None:
+        kernel = functools.partial(_decode_attn_q_kernel, scale=scale_v)
+        return pl.pallas_call(
+            kernel, grid=(B,),
+            in_specs=[q_spec, kv_spec, sc_spec, kv_spec, sc_spec, pos_spec],
+            out_specs=out_spec, out_shape=out_shape,
+            interpret=bool(interpret),
+        )(q, k, k_scale, v, v_scale, posb)
+    kernel = functools.partial(_decode_attn_kernel, scale=scale_v)
+    return pl.pallas_call(
+        kernel, grid=(B,),
+        in_specs=[q_spec, kv_spec, kv_spec, pos_spec],
+        out_specs=out_spec, out_shape=out_shape,
+        interpret=bool(interpret),
+    )(q, k, v, posb)
+
+
+# ---------------------------------------------------------------------------
 # Fused LSTM sequence kernel — the hl_cuda_lstm.cu analog: the entire T-step
 # recurrence runs inside ONE kernel with the recurrent weights and the h/c
 # state resident in VMEM, so the per-step state never round-trips HBM the way
@@ -608,6 +757,7 @@ def lstm_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
                         h0: Optional[jax.Array] = None,
                         c0: Optional[jax.Array] = None, *,
                         forget_bias: float = 0.0, block_b: int = 8,
+                        chunk_t: Optional[int] = None,
                         save_cell: bool = False,
                         interpret: Optional[bool] = None):
     """Masked LSTM over a whole sequence in one Pallas kernel.
@@ -618,11 +768,39 @@ def lstm_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
     hand-written backward kernel consumes — ops/rnn.py wires the custom
     VJP, so training uses this kernel in BOTH directions, matching the
     reference's training-mode fused hl_lstm kernels).
+
+    ``chunk_t`` splits time into chunk-sized kernel launches threading
+    (h, c) between them — all inside one traced graph, so the cost is one
+    h/c HBM round-trip per boundary, not a dispatch. This is what lets
+    ``block_b`` grow past 8 on long sequences: the resident tile is
+    [chunk_t, block_b, •] instead of [T, block_b, •], and a 32/64-row
+    batch tile feeds the MXU where the old whole-sequence 8-row tile
+    starved it (ops/rnn.py _fused_plan picks the pair).
     """
     B, T, G = xw.shape
     if G % 4:
         raise ValueError(f"xw last dim {G} must be 4*H (i/f/g/o gates)")
     H = G // 4
+    if chunk_t is not None and chunk_t < T:
+        h = h0 if h0 is not None else jnp.zeros((B, H), xw.dtype)
+        c = c0 if c0 is not None else jnp.zeros((B, H), xw.dtype)
+        outs, cells = [], []
+        for s in range(0, T, chunk_t):
+            e = min(T, s + chunk_t)
+            res = lstm_sequence_fused(
+                xw[:, s:e], lengths - s, u, b, h, c,
+                forget_bias=forget_bias, block_b=block_b,
+                save_cell=save_cell, interpret=interpret)
+            if save_cell:
+                o, h, c, cs = res
+                cells.append(cs)
+            else:
+                o, h, c = res
+            outs.append(o)
+        out = jnp.concatenate(outs, axis=1)
+        if save_cell:
+            return out, h, c, jnp.concatenate(cells, axis=1)
+        return out, h, c
     if interpret is None:
         interpret = not _on_tpu()
     if b is None:
@@ -981,15 +1159,28 @@ def gru_sequence_fused_bwd(xw, lengths, u, h0, out_seq, g_out, g_ht, *,
 def gru_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
                        b: Optional[jax.Array] = None,
                        h0: Optional[jax.Array] = None, *,
-                       block_b: int = 8,
+                       block_b: int = 8, chunk_t: Optional[int] = None,
                        interpret: Optional[bool] = None):
     """Masked GRU over a whole sequence in one Pallas kernel; see
-    lstm_sequence_fused for the design notes. xw: x@W [B, T, 3H];
-    returns (out [B, T, H], hT [B, H])."""
+    lstm_sequence_fused for the design notes (including ``chunk_t`` time
+    chunking, which buys the wide MXU-feeding batch tiles). xw: x@W
+    [B, T, 3H]; returns (out [B, T, H], hT [B, H])."""
     B, T, G = xw.shape
     if G % 3:
         raise ValueError(f"xw last dim {G} must be 3*H (z/r/candidate gates)")
     H = G // 3
+    if chunk_t is not None and chunk_t < T:
+        if b is not None:
+            xw = xw + b
+            b = None
+        h = h0 if h0 is not None else jnp.zeros((B, H), xw.dtype)
+        outs = []
+        for s in range(0, T, chunk_t):
+            e = min(T, s + chunk_t)
+            o, h = gru_sequence_fused(xw[:, s:e], lengths - s, u, None, h,
+                                      block_b=block_b, interpret=interpret)
+            outs.append(o)
+        return jnp.concatenate(outs, axis=1), h
     if interpret is None:
         interpret = not _on_tpu()
     if b is not None:

@@ -86,69 +86,139 @@ def lstm(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
     identical math, so fused training == scan training numerically (see
     tests/test_pallas.py).
 
-    ``fused=None`` (default) auto-selects by batch size, per measurement on
-    the v5e chip (benchmarks/fused_rnn.py, docs/design/fused_rnn_bench.md):
-    the whole-sequence kernel wins latency-bound small batches (B=1 fwd
-    2.0x faster), while XLA's scan wins MXU-bound large batches (B=64
-    train 2.2x faster — VMEM caps the kernel's batch tile at 8 rows, which
-    starves the 128-wide MXU, and XLA already keeps the scan carry
-    on-chip). So auto = kernel iff B <= 8.
+    ``fused=None`` (default) auto-selects: the kernel whenever a legal
+    (batch-tile, time-chunk) plan fits VMEM on the TPU (see
+    :func:`_fused_plan`), the scan otherwise. The original narrow-tile
+    kernel lost MXU-bound large batches (B=64 train 2.2x slower — VMEM
+    capped the whole-sequence batch tile at 8 rows, starving the 128-row
+    MXU; docs/design/fused_rnn_bench.md); time-chunked launches lift that
+    cap to 32/64-row tiles, which is what routes the textcls (h256,
+    len 30-100, B>=64) and NMT-encoder shape families onto the kernel.
+    benchmarks/fused_rnn.py re-measures the crossover on-chip.
     """
+    B, T, _ = x.shape
+    H = u.shape[0]
     if fused is None:
-        fused = x.shape[0] <= 8
-    if fused and not reverse:
+        fused = True                 # auto: plan + backend decide below
+    if fused:
         from . import pallas_kernels as _pk
-        B, T, _ = x.shape
-        H = u.shape[0]
-        blk = _fused_block_b(T, H, seq_h_units=6, batch=B)
-        if not _pk._on_tpu() or blk is None:
-            # off-TPU, or the sequence is too long for the whole-sequence
-            # tile to fit VMEM even at block_b=1 — the scan handles any shape
-            return _lstm_scan(x, lengths, w, u, b, h0, c0, reverse,
-                              forget_bias)
-        lens = (lengths if lengths is not None
-                else jnp.full((B,), T, jnp.int32))
-        b_ = b if b is not None else jnp.zeros((4 * H,), x.dtype)
-        h0_ = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
-        c0_ = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
-        out, ht, ct = _lstm_fused(x, lens, w, u, b_, h0_, c0_, forget_bias,
-                                  blk)
-        return out, LSTMState(ht, ct)
+        from .. import obs
+        plan = _fused_plan(T, H, seq_h_units=6, batch=B)
+        obs.count("kernels.routes_total", kernel="lstm_sequence_fused",
+                  route=("fused" if _pk._on_tpu() and plan is not None
+                         else "scan"))
+        if _pk._on_tpu() and plan is not None:
+            blk, chunk = plan
+            lens = (lengths if lengths is not None
+                    else jnp.full((B,), T, jnp.int32))
+            b_ = b if b is not None else jnp.zeros((4 * H,), x.dtype)
+            h0_ = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+            c0_ = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+            xk = _reverse_within_length(x, lens) if reverse else x
+            out, ht, ct = _lstm_fused(xk, lens, w, u, b_, h0_, c0_,
+                                      forget_bias, blk, chunk)
+            if reverse:
+                out = _reverse_within_length(out, lens)
+            return out, LSTMState(ht, ct)
+        # off-TPU, or no VMEM-legal plan — the scan handles any shape
     return _lstm_scan(x, lengths, w, u, b, h0, c0, reverse, forget_bias)
 
 
-def _fused_block_b(T: int, H: int, gates: int = 4,
-                   seq_h_units: Optional[int] = None,
-                   batch: Optional[int] = None,
-                   budget_bytes: int = 15_500_000):
-    """Largest LEGAL batch tile whose whole-sequence VMEM working set fits;
-    None -> use the scan. ``gates``: 4 for LSTM, 3 for GRU (sizes the
-    [H, gates*H] u and the [T, blk, gates*H] xw tile). ``seq_h_units``:
-    total width of the per-step sequence buffers in multiples of H
-    (default xw + out = gates + 1; the train forward adds the saved cell
-    sequence, the backward roughly doubles it).
+#: minimum resident time-chunk for a wide batch tile: below this the
+#: chunk-boundary h/c round-trips start to rival the per-step work
+_CHUNK_MIN_WIDE = 16
+
+
+def _fused_plan(T: int, H: int, gates: int = 4,
+                seq_h_units: Optional[int] = None,
+                batch: Optional[int] = None,
+                budget_bytes: int = 15_500_000,
+                double_buffer_always: bool = False
+                ) -> Optional[Tuple[int, int]]:
+    """(block_b, chunk_t) for the fused whole-sequence kernels, or None
+    for the scan. ``gates``: 4 for LSTM, 3 for GRU (sizes the [H, gates*H]
+    u and the [chunk, blk, gates*H] xw tile); ``seq_h_units``: total width
+    of the per-step sequence buffers in multiples of H (default xw + out =
+    gates + 1; the train forward adds the saved cell sequence, the
+    backward roughly doubles it).
+
+    Preference order: the WIDEST batch tile whose resident time-chunk
+    still fits VMEM — the recurrent matmul is [blk, H] @ [H, gates*H] per
+    step, so blk is the MXU row dimension and an 8-row tile starves the
+    128-row systolic array (the measured 2.2x large-batch loss of the old
+    whole-sequence-resident kernel). chunk_t < T costs one h/c HBM
+    round-trip per boundary inside the same traced graph — cheap next to
+    feeding the MXU 4-8x more rows.
 
     Mosaic tiling: the batch tile is the second-to-last block dim, so it
     must be a multiple of 8 — or equal the whole (padded) batch, i.e. a
     single grid program, which is how sub-8 batches run. Cost model
     calibrated against the chip's 16 MB scoped VMEM (measured on v5e):
     with more than one grid program Pallas double-buffers every
-    batch-varying block, so the tile costs 2×; a single-program grid is
+    batch-varying block, so the tile costs 2x; a single-program grid is
     single-buffered (which is why tiny-batch probes fit shapes that OOM
     at full batch)."""
     if seq_h_units is None:
         seq_h_units = gates + 1
     u_bytes = H * gates * H * 4          # u resident + du accumulator
+    avail = budget_bytes - 2 * u_bytes
+    if avail <= 0:
+        return None
 
-    def fits(blk, grid_is_1):
-        tile = T * blk * seq_h_units * H * 4
-        return 2 * u_bytes + (tile if grid_is_1 else 2 * tile) <= budget_bytes
+    def chunk_for(blk, grid_is_1):
+        per_step = blk * seq_h_units * H * 4
+        if double_buffer_always or not grid_is_1:
+            per_step *= 2                # double-buffered batch tiles
+        return avail // per_step
 
     if batch is not None and batch < 8:
-        return batch if fits(batch, True) else None
-    if batch is not None and batch <= 8:
-        return 8 if fits(8, True) else None
-    return 8 if fits(8, False) else None
+        chunk = chunk_for(batch, True)
+        return (batch, min(T, chunk)) if chunk >= min(T, 8) else None
+    for blk in (64, 32, 16):
+        if batch is not None and blk > batch:
+            continue
+        chunk = chunk_for(blk, batch is not None and blk == batch)
+        if chunk >= min(T, _CHUNK_MIN_WIDE):
+            return blk, min(T, chunk)
+    chunk = chunk_for(8, batch == 8)
+    if chunk >= min(T, 8):
+        return 8, min(T, chunk)
+    return None
+
+
+def _fused_bwd_plan(T: int, H: int, gates: int, seq_h_units: int,
+                    batch: int,
+                    budget_bytes: int = 15_500_000) -> Optional[Tuple[int, int]]:
+    """(block_b, chunk_t) for the hand-written backward kernels — the SAME
+    planner as :func:`_fused_plan` (one place owns the VMEM cost model and
+    tile preference), always double-buffer-costed. The reverse recurrence
+    splits cleanly at chunk boundaries: the saved (out, c) sequences
+    provide each chunk's initial state, so the wrapper runs a few kernel
+    launches instead of one."""
+    return _fused_plan(T, H, gates, seq_h_units, batch, budget_bytes,
+                       double_buffer_always=True)
+
+
+def _reverse_within_length(x: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Flip each sample's FIRST ``length`` steps along time; positions at
+    or past length become zero. x: [B, T, ...].
+
+    This is how ``reverse=True`` rides the forward-only fused kernels: a
+    masked reverse scan over a right-padded batch is exactly a forward
+    scan over the within-length-flipped input — state updates visit the
+    original steps length-1..0 and frozen (t >= length) steps stay
+    frozen — with the output flipped back on the way out (outputs at
+    padding are zero on both sides, so the round trip is lossless).
+    Ordinary gather/where, so autodiff flows through it around the fused
+    kernel's custom VJP."""
+    T = x.shape[1]
+    idx = lengths.astype(jnp.int32)[:, None] - 1 - jnp.arange(T)[None, :]
+    ok = idx >= 0                                     # [B, T]
+    idx = jnp.clip(idx, 0, T - 1)
+    tail = (1,) * (x.ndim - 2)
+    out = jnp.take_along_axis(x, idx.reshape(idx.shape + tail), axis=1)
+    return jnp.where(ok.reshape(ok.shape + tail), out,
+                     jnp.zeros((), x.dtype))
 
 
 def _lstm_scan(x, lengths, w, u, b, h0, c0, reverse, forget_bias):
@@ -174,8 +244,8 @@ def _lstm_scan(x, lengths, w, u, b, h0, c0, reverse, forget_bias):
     return jnp.swapaxes(ys, 0, 1), LSTMState(h, c)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
-def _lstm_fused(x, lens, w, u, b, h0, c0, forget_bias, block_b):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def _lstm_fused(x, lens, w, u, b, h0, c0, forget_bias, block_b, chunk_t):
     """Forward through the Pallas fused kernel; under autodiff the VJP pairs
     it with the hand-written reverse-recurrence kernel
     (pallas_kernels.lstm_sequence_fused_bwd) — fused in BOTH directions,
@@ -185,41 +255,27 @@ def _lstm_fused(x, lens, w, u, b, h0, c0, forget_bias, block_b):
     B, T, D = x.shape
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
     return lstm_sequence_fused(xw, lens, u, b, h0=h0, c0=c0,
-                               forget_bias=forget_bias, block_b=block_b)
+                               forget_bias=forget_bias, block_b=block_b,
+                               chunk_t=chunk_t)
 
 
-def _lstm_fused_fwd(x, lens, w, u, b, h0, c0, forget_bias, block_b):
+def _lstm_fused_fwd(x, lens, w, u, b, h0, c0, forget_bias, block_b, chunk_t):
     from .pallas_kernels import lstm_sequence_fused
     B, T, D = x.shape
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
     out, ht, ct, c_seq = lstm_sequence_fused(
         xw, lens, u, b, h0=h0, c0=c0, forget_bias=forget_bias,
-        block_b=block_b, save_cell=True)
+        block_b=block_b, chunk_t=chunk_t, save_cell=True)
     return (out, ht, ct), (x, lens, w, u, b, h0, c0, xw, out, c_seq)
 
 
-def _bwd_chunk_len(T: int, H: int, gates: int, seq_h_units: int,
-                   budget_bytes: int = 15_500_000) -> Optional[int]:
-    """Longest time-chunk whose blk=8 backward tile fits VMEM (double-
-    buffered). The reverse recurrence splits cleanly at chunk boundaries —
-    the saved (out, c) sequences provide each chunk's initial state — so
-    long sequences run as a few kernel launches instead of falling back to
-    the T-step scan."""
-    u_bytes = H * gates * H * 4
-    avail = budget_bytes - 2 * u_bytes
-    per_step = 2 * 8 * seq_h_units * H * 4
-    if avail < 8 * per_step:
-        return None
-    return min(T, avail // per_step)
-
-
-def _lstm_fused_bwd(forget_bias, block_b, res, g):
+def _lstm_fused_bwd(forget_bias, block_b, chunk_t, res, g):
     x, lens, w, u, b, h0, c0, xw, out, c_seq = res
     zero_lens = np.zeros(lens.shape, jax.dtypes.float0)
     B, T, D = x.shape
     H = u.shape[0]
-    chunk = _bwd_chunk_len(T, H, 4, 11)      # 2*(xw+dxw) + 3 H-wide seqs
-    if chunk is None:
+    plan = _fused_bwd_plan(T, H, 4, 11, B)   # 2*(xw+dxw) + 3 H-wide seqs
+    if plan is None:
         # VMEM won't hold even an 8-step backward tile: replay the
         # (bit-identical) scan under autodiff instead
         def replay(x, w, u, b, h0, c0):
@@ -233,7 +289,7 @@ def _lstm_fused_bwd(forget_bias, block_b, res, g):
 
     from .pallas_kernels import lstm_sequence_fused_bwd
     g_out, g_ht, g_ct = g
-    blk = 8 if B >= 8 else B
+    blk, chunk = plan
     dh, dc = g_ht, g_ct
     du = jnp.zeros((H, 4 * H), jnp.float32)
     parts = []
@@ -274,21 +330,32 @@ def gru(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
     ``fused=True`` runs both directions through the Pallas whole-sequence
     kernels (hl_gpu_gru.cuh analog) — same contract as lstm(fused=True):
     identical math to the scan, hand-written backward kernel;
-    ``fused=None`` auto-selects the kernel only for small batches (see
-    lstm() docstring for the measured crossover)."""
+    ``fused=None`` auto-selects the kernel whenever a VMEM-legal
+    (batch-tile, time-chunk) plan exists on the TPU — including
+    ``reverse=True`` (the bidirectional NMT encoder), which rides the
+    forward kernel via the within-length flip (see lstm())."""
     B, T, D = x.shape
     H = u.shape[0]
     if fused is None:
-        fused = B <= 8
-    if fused and not reverse:
+        fused = True
+    if fused:
         from . import pallas_kernels as _pk
-        blk = _fused_block_b(T, H, gates=3, batch=B)
-        if _pk._on_tpu() and blk is not None:
+        from .. import obs
+        plan = _fused_plan(T, H, gates=3, batch=B)
+        obs.count("kernels.routes_total", kernel="gru_sequence_fused",
+                  route=("fused" if _pk._on_tpu() and plan is not None
+                         else "scan"))
+        if _pk._on_tpu() and plan is not None:
+            blk, chunk = plan
             lens = (lengths if lengths is not None
                     else jnp.full((B,), T, jnp.int32))
             b_ = b if b is not None else jnp.zeros((3 * H,), x.dtype)
             h0_ = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
-            return _gru_fused(x, lens, w, u, b_, h0_, blk)
+            xk = _reverse_within_length(x, lens) if reverse else x
+            out, ht = _gru_fused(xk, lens, w, u, b_, h0_, blk, chunk)
+            if reverse:
+                out = _reverse_within_length(out, lens)
+            return out, ht
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
     mask = (sequence_mask(lengths, T, x.dtype) if lengths is not None
             else jnp.ones((B, T), x.dtype))
@@ -306,31 +373,33 @@ def gru(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
     return jnp.swapaxes(ys, 0, 1), h
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def _gru_fused(x, lens, w, u, b, h0, block_b):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _gru_fused(x, lens, w, u, b, h0, block_b, chunk_t):
     from .pallas_kernels import gru_sequence_fused
     B, T, D = x.shape
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
-    return gru_sequence_fused(xw, lens, u, b, h0=h0, block_b=block_b)
+    return gru_sequence_fused(xw, lens, u, b, h0=h0, block_b=block_b,
+                              chunk_t=chunk_t)
 
 
-def _gru_fused_fwd(x, lens, w, u, b, h0, block_b):
+def _gru_fused_fwd(x, lens, w, u, b, h0, block_b, chunk_t):
     from .pallas_kernels import gru_sequence_fused
     B, T, D = x.shape
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, -1)
     if b is not None:
         xw = xw + b                        # kernel expects bias pre-added
-    out, ht = gru_sequence_fused(xw, lens, u, None, h0=h0, block_b=block_b)
+    out, ht = gru_sequence_fused(xw, lens, u, None, h0=h0, block_b=block_b,
+                                 chunk_t=chunk_t)
     return (out, ht), (x, lens, w, u, b, h0, xw, out)
 
 
-def _gru_fused_bwd(block_b, res, g):
+def _gru_fused_bwd(block_b, chunk_t, res, g):
     x, lens, w, u, b, h0, xw, out = res
     zero_lens = np.zeros(lens.shape, jax.dtypes.float0)
     B, T, D = x.shape
     H = u.shape[0]
-    chunk = _bwd_chunk_len(T, H, 3, 8)       # 2*(xw+dxw) + 2 H-wide seqs
-    if chunk is None:
+    plan = _fused_bwd_plan(T, H, 3, 8, B)    # 2*(xw+dxw) + 2 H-wide seqs
+    if plan is None:
         def replay(x, w, u, b, h0):
             return gru(x, lens, w, u, b, h0, fused=False)
 
@@ -340,7 +409,7 @@ def _gru_fused_bwd(block_b, res, g):
 
     from .pallas_kernels import gru_sequence_fused_bwd
     g_out, g_ht = g
-    blk = 8 if B >= 8 else B
+    blk, chunk = plan
     dh = g_ht
     du = jnp.zeros((H, 3 * H), jnp.float32)
     parts = []

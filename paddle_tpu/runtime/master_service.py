@@ -559,6 +559,11 @@ class MasterClient(_RpcClient):
 
     def get_task(self) -> Optional[Tuple[int, str]]:
         r = self._call({"op": "get_task"})
+        if not r.get("ok") and r.get("error"):
+            # a structured server error ("payload too large: ..." when the
+            # escaped response would blow the frame limit) must surface as
+            # an exception, not read as an innocent empty queue
+            raise RuntimeError(f"get_task failed: {r['error']}")
         if r.get("task") is None:
             return None
         return r["task"]["id"], r["task"]["payload"]

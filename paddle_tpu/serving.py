@@ -26,12 +26,13 @@ to running it alone through ``generate_cached`` (tests/test_serving.py).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import obs
 from .core.lod import bucket_length
 
 
@@ -56,14 +57,22 @@ class ContinuousBatcher:
     def __init__(self, model, params, *, slots: int = 8, segment: int = 32,
                  cache_bucket: int = 256,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
-                 schedule: str = "longest_first"):
+                 schedule: str = "longest_first",
+                 kv_dtype: Optional[str] = None):
         """``schedule``: admission order over the request queue.
         "longest_first" (default) admits the largest generation budgets
         first — classic longest-processing-time scheduling, which shortens
         the drained-slot tail where short stragglers leave most of the pool
         idle (measured +31% delivered tok/s on a mixed U[32,256] workload
         vs "fifo"). Per-request outputs are identical either way (greedy
-        decode is batch-order independent; tests/test_serving.py)."""
+        decode is batch-order independent; tests/test_serving.py).
+
+        ``kv_dtype="int8"`` holds the slot pool's KV caches quantized
+        (models/transformer.py prefill) — the decode segment's HBM cache
+        read halves, which matters exactly here where decode is
+        cache-bytes-bound. Tokens then follow the quantized-KV numerics
+        contract (docs/design/kernels.md): identical to SOLO decode at the
+        same kv_dtype, approximately equal to full-precision decode."""
         if schedule not in ("longest_first", "fifo"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.model, self.params = model, params
@@ -71,6 +80,7 @@ class ContinuousBatcher:
         self.cache_bucket = cache_bucket
         self.prompt_buckets = prompt_buckets
         self.schedule = schedule
+        self.kv_dtype = kv_dtype
         self._seg_fns = {}      # cache_len -> jitted segment scan
         self._prefill_fns = {}  # Tpad -> jitted ragged prefill
         self._merge = None      # jitted masked slot merge
@@ -101,9 +111,11 @@ class ContinuousBatcher:
         fn = self._prefill_fns.get(tpad)
         if fn is None:
             model = self.model
+            kv_dtype = self.kv_dtype
 
             def pf(params, prompts, lengths):
-                cell, last = model.prefill(params, prompts, lengths)
+                cell, last = model.prefill(params, prompts, lengths,
+                                           kv_dtype=kv_dtype)
                 first = jnp.argmax(last, axis=-1).astype(prompts.dtype)
                 return cell, first
             fn = self._prefill_fns.setdefault(tpad, jax.jit(pf))
@@ -213,6 +225,8 @@ class ContinuousBatcher:
                 -(-(max_pos + self.segment + 1) // self.cache_bucket)
                 * self.cache_bucket, self.model.max_len)
             cell, cur, toks = self._seg_fn(cache_len)(self.params, cell, cur)
+            # one dispatch serves `segment` tokens across every live slot
+            obs.count("decode.dispatches_total", route="serve_segment")
             pos_host += self.segment
             block = np.asarray(toks)               # [B, segment] host sync
             for i, s in enumerate(slots):
@@ -225,9 +239,140 @@ class ContinuousBatcher:
                     if hits.size:
                         take, done = take[:hits[0]], True
                 s.out.extend(int(t) for t in take)
+                obs.count("decode.tokens_total", len(take), route="serve")
                 s.left -= len(take)
                 if done or s.left <= 0:
                     results[s.req.rid] = np.asarray(s.out, np.int32)
                     slots[i] = _Slot()             # free the slot
             admit()
         return results
+
+
+class SpeculativeDecoder:
+    """Speculative greedy decoding: a small DRAFT model proposes ``k-1``
+    tokens per round; the target verifies the whole span in ONE batched
+    ``verify_step`` pass (models/transformer.py) and emits the longest
+    agreeing prefix plus its own correction token.
+
+    Exactness by construction: every emitted token is the target's greedy
+    continuation of the emitted prefix — the draft only decides HOW MANY
+    tokens each target dispatch yields, never WHICH — so the output equals
+    plain greedy decode for ANY acceptance pattern, including an
+    adversarial draft that never agrees (tests/test_serving.py). The win
+    is dispatch/bytes economics: the target's weights stream once per
+    ROUND instead of once per token, amortized over 1 + accepted tokens.
+
+    Rollback rides the existing position-masked cache contract: rejected
+    span rows (and the draft's rows for rejected proposals) sit past the
+    reset write position, are never readable (mask j <= pos), and are
+    overwritten before the position reaches them again — the same
+    invariant prefill's ragged tail relies on.
+
+    The draft is any model exposing ``prefill(params, prompt)`` and
+    ``decode_step(params, cell, tokens)``; the bench's default is the
+    target itself reading an int8 KV cache (a self-speculation draft with
+    halved cache bytes and high agreement — docs/design/kernels.md).
+    """
+
+    def __init__(self, model, params, draft_model, draft_params, *, k: int = 4,
+                 kv_dtype: Optional[str] = None,
+                 draft_kv_dtype: Optional[str] = None):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.model, self.params = model, params
+        self.draft_model, self.draft_params = draft_model, draft_params
+        self.k = k
+        self.kv_dtype, self.draft_kv_dtype = kv_dtype, draft_kv_dtype
+        draft = draft_model
+        dkv = draft_kv_dtype
+
+        def dpf(p, ids):
+            cell, last = draft.prefill(p, ids, kv_dtype=dkv) \
+                if dkv is not None else draft.prefill(p, ids)
+            return cell
+        self._draft_prefill = jax.jit(dpf)
+
+        def dstep(p, cell, cur):
+            logits, cell = draft.decode_step(p, cell, cur)
+            return jnp.argmax(logits, axis=-1).astype(cur.dtype), cell
+        self._draft_step = jax.jit(dstep)
+
+    def generate(self, prompt, steps: int) -> Tuple[np.ndarray, Dict]:
+        """prompt [B, T0] (or [T0]) -> (tokens [B, steps] int32, stats).
+        stats: rounds / proposed / accepted / acceptance_rate — the bench
+        row's headline numbers (benchmarks/speculative_decode.py)."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        B, T0 = prompt.shape
+        if T0 == 0:
+            raise ValueError("empty prompt (prefill needs >= 1 token)")
+        # frozen samples keep re-writing up to k span rows past their last
+        # position, and a final round can overshoot by k-1 — 2k of margin
+        # keeps every write inside max_len
+        need = T0 + steps + 2 * self.k
+        for name, m in (("model", self.model), ("draft", self.draft_model)):
+            if need > m.max_len:
+                raise ValueError(
+                    f"prompt ({T0}) + steps ({steps}) + 2k ({2 * self.k}) "
+                    f"exceeds {name} max_len ({m.max_len})")
+        ids = jnp.asarray(prompt)
+        rng = jax.random.PRNGKey(0)                # greedy: never consumed
+        cell, cur, _ = self.model._decode_fn(
+            "prefill", kv_dtype=self.kv_dtype, sample="greedy", top_k=None,
+            temperature=1.0)(self.params, ids, rng)
+        obs.count("decode.dispatches_total", route="spec_prefill")
+        dcell = self._draft_prefill(self.draft_params, ids)
+
+        pos = np.full((B,), T0, np.int64)
+        # the prefill's greedy token is the first emission; every round
+        # then emits the tokens AFTER the current one
+        emitted: List[List[int]] = [[int(t)] for t in np.asarray(cur)]
+        rounds = proposed = accepted = 0
+        verify = self.model._decode_fn("verify", cache_len=None)
+        while min(len(e) for e in emitted) < steps:
+            # draft proposes k-1 tokens from cur (its positions synced to
+            # the target's accepted state), then one cache-fill step
+            # consumes the LAST proposal: on a fully-accepted round the
+            # next cur sits one past it, so without the fill the draft
+            # cache would keep a permanently-live all-zero row at every
+            # such round's final position — silently rotting proposal
+            # quality (the partial-acceptance rows are overwritten before
+            # they become readable, so only the last one needs this)
+            dcell = dict(dcell, pos=jnp.asarray(pos, jnp.int32))
+            d_cur, props = cur, []
+            for i in range(self.k if self.k > 1 else 0):
+                d_cur, dcell = self._draft_step(self.draft_params, dcell,
+                                                d_cur)
+                obs.count("decode.dispatches_total", route="spec_draft")
+                if i < self.k - 1:
+                    props.append(d_cur)    # the k-th output is discarded
+            span = jnp.stack([cur] + props, axis=1)        # [B, k]
+            t, cell = verify(self.params, cell, span)      # [B, k] greedy
+            obs.count("decode.dispatches_total", route="spec_verify")
+            t_np = np.asarray(t)
+            props_np = t_np[:, :0] if not props else \
+                np.stack([np.asarray(p) for p in props], axis=1)
+            next_cur = np.asarray(cur).copy()
+            for b in range(B):
+                if len(emitted[b]) >= steps:
+                    continue                       # frozen: pos/cur hold
+                m = 0
+                while m < self.k - 1 and props_np[b, m] == t_np[b, m]:
+                    m += 1
+                emitted[b].extend(int(x) for x in t_np[b, :m + 1])
+                next_cur[b] = t_np[b, m]
+                pos[b] += m + 1
+                proposed += self.k - 1
+                accepted += m
+            cell = dict(cell, pos=jnp.asarray(pos, jnp.int32))
+            cur = jnp.asarray(next_cur)
+            rounds += 1
+        obs.count("decode.spec_proposed_total", proposed)
+        obs.count("decode.spec_accepted_total", accepted)
+        obs.count("decode.tokens_total", B * steps, route="spec")
+        out = np.asarray([e[:steps] for e in emitted], np.int32)
+        return out, {"rounds": rounds, "proposed": proposed,
+                     "accepted": accepted,
+                     "acceptance_rate": (accepted / proposed if proposed
+                                         else 1.0)}

@@ -48,6 +48,30 @@ def test_cli_version():
     assert "paddle_tpu" in out
 
 
+def test_lint_bench_rows_schema(tmp_path):
+    """`paddle_tpu lint --bench-rows` (no --config needed): well-formed
+    rows pass; a row missing its family's roofline column (mfu for
+    *_train_*, hbm_bw_util for *_decode_*) or a required key fails with
+    B001 findings — malformed rows die in CI, not in the trend data."""
+    import json
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps(
+        {"metric": "x_train_ms_per_batch", "value": 1.0, "unit": "ms",
+         "vs_baseline": None, "mfu": 0.2}) + "\n")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(
+        {"metric": "y_decode_tokens_per_sec", "value": 5.0,
+         "unit": "tok/s", "vs_baseline": None}) + "\n")
+    out = _run("lint", "--bench-rows", str(good))
+    assert "0 problem(s)" in out
+    r = subprocess.run([sys.executable, "-m", "paddle_tpu", "lint",
+                        "--bench-rows", str(bad)],
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 1
+    assert "B001" in r.stdout and "hbm_bw_util" in r.stdout
+
+
 def test_cli_train_test_time_dump(config_file, tmp_path):
     save = str(tmp_path / "out")
     cc = str(tmp_path / "compile_cache")

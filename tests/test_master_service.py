@@ -93,6 +93,29 @@ def test_concurrent_clients(server):
     assert sorted(got) == sorted(f"c{i}" for i in range(40))
 
 
+def test_oversized_response_degrades_to_structured_error(server, monkeypatch):
+    """Responses are now checked against the frame limit (ADVICE r5): a
+    payload whose JSON escaping expands past it must come back as a
+    structured 'payload too large' error the client RAISES — not as a
+    >limit frame the client's guard silently drops as a dead connection.
+    $PTMS_MAX_RESPONSE_FRAME shrinks the bound so the test stays small."""
+    monkeypatch.setenv("PTMS_MAX_RESPONSE_FRAME", "200000")
+    c = _client(server)
+    # newlines escape 1 -> 2 bytes: 150 KB raw renders as a ~300 KB
+    # get_task response, over the armed 200 KB bound
+    c.set_dataset(["\n" * 150000, "small"])
+    with pytest.raises(RuntimeError, match="payload too large"):
+        while True:
+            t = c.get_task()      # big task may not be first in the queue
+            assert t is not None and t[1] == "small"
+            c.task_finished(t[0])
+    # the connection survived: the small task still round-trips
+    t = c.get_task()
+    if t is not None:
+        assert t[1] == "small"
+    c.close()
+
+
 def test_snapshot_written_and_recovered(server, tmp_path):
     c = _client(server)
     c.set_dataset(["a", "b", "c"])

@@ -72,7 +72,12 @@ def test_lenet_learns():
 @pytest.mark.parametrize("cls,kw", [
     (VGG, dict(classes=10, width_mult=0.125)),
     (ResNet, dict(depth=18, classes=10, width_mult=0.25, small_input=True)),
-    (ResNet, dict(depth=50, classes=10, width_mult=0.125, small_input=True)),
+    # slow: the depth-50 bottleneck variant is the single costliest tier-1
+    # case (~30s compile+grad); depth-18 keeps the ResNet path (incl.
+    # projection shortcuts) in tier-1 and benchmarks/resnet50.py exercises
+    # depth-50 on-chip (ROADMAP item 5)
+    pytest.param(ResNet, dict(depth=50, classes=10, width_mult=0.125,
+                              small_input=True), marks=pytest.mark.slow),
 ])
 def test_image_models_forward_and_grad(cls, kw):
     model = cls(**kw)

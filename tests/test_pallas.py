@@ -191,8 +191,8 @@ def test_flash_backward_no_dense_scores_in_jaxpr():
                         shape[-2] == T), f"dense [T,T] tensor in bwd: {eqn}"
 
 
-@pytest.mark.parametrize("block_b", [2, 5])
-def test_lstm_sequence_fused_matches_scan(block_b):
+@pytest.mark.parametrize("block_b,chunk_t", [(2, None), (5, 3)])
+def test_lstm_sequence_fused_matches_scan(block_b, chunk_t):
     """The fused whole-sequence LSTM kernel (hl_cuda_lstm.cu analog: u and
     h/c resident in VMEM across all T steps) must match the lax.scan LSTM
     bit-for-bit, including variable-length masking and padded batch tails."""
@@ -210,7 +210,8 @@ def test_lstm_sequence_fused_matches_scan(block_b):
     ref_out, ref_state = R.lstm(x, lens, w, u, b, forget_bias=1.0)
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, 4 * H)
     out, ht, ct = lstm_sequence_fused(xw, lens, u, b, forget_bias=1.0,
-                                      block_b=block_b, interpret=True)
+                                      block_b=block_b, chunk_t=chunk_t,
+                                      interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(ht), np.asarray(ref_state.h),
@@ -219,8 +220,8 @@ def test_lstm_sequence_fused_matches_scan(block_b):
                                rtol=1e-6, atol=1e-6)
 
 
-@pytest.mark.parametrize("block_b", [2, 5])
-def test_gru_sequence_fused_matches_scan(block_b):
+@pytest.mark.parametrize("block_b,chunk_t", [(2, None), (5, 3)])
+def test_gru_sequence_fused_matches_scan(block_b, chunk_t):
     """Fused whole-sequence GRU kernel (hl_gpu_gru.cuh analog) vs the
     lax.scan GRU: bit-exact incl. masking and padded batch tails."""
     from paddle_tpu.ops import rnn as R
@@ -237,15 +238,15 @@ def test_gru_sequence_fused_matches_scan(block_b):
     ref_out, ref_h = R.gru(x, lens, w, u, b)
     xw = jnp.matmul(x.reshape(B * T, D), w).reshape(B, T, 3 * H)
     out, ht = gru_sequence_fused(xw, lens, u, b, block_b=block_b,
-                                 interpret=True)
+                                 chunk_t=chunk_t, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(ht), np.asarray(ref_h),
                                rtol=1e-6, atol=1e-6)
 
 
-@pytest.mark.parametrize("block_b", [2, 5])
-def test_lstm_fused_backward_kernel_matches_scan_grads(block_b):
+@pytest.mark.parametrize("block_b,chunk_t", [(2, None), (5, 4)])
+def test_lstm_fused_backward_kernel_matches_scan_grads(block_b, chunk_t):
     """The hand-written reverse-recurrence LSTM kernel
     (hl_lstm_parallel_backward_data/_weight analog) must produce the same
     dx/dw/du/db/dh0/dc0 as autodiff through the scan, incl. variable
@@ -278,7 +279,8 @@ def test_lstm_fused_backward_kernel_matches_scan_grads(block_b):
                       fused=False)
 
     def fused_path(x, w, u, b, h0, c0):
-        out, ht, ct = R._lstm_fused(x, lens, w, u, b, h0, c0, 1.0, block_b)
+        out, ht, ct = R._lstm_fused(x, lens, w, u, b, h0, c0, 1.0, block_b,
+                                    chunk_t)
         return out, R.LSTMState(ht, ct)
 
     g_ref = jax.grad(loss(scan_path), argnums=(0, 1, 2, 3, 4, 5))(
@@ -290,8 +292,8 @@ def test_lstm_fused_backward_kernel_matches_scan_grads(block_b):
                                    rtol=2e-5, atol=2e-5, err_msg=name)
 
 
-@pytest.mark.parametrize("block_b", [2, 5])
-def test_gru_fused_backward_kernel_matches_scan_grads(block_b):
+@pytest.mark.parametrize("block_b,chunk_t", [(2, None), (5, 4)])
+def test_gru_fused_backward_kernel_matches_scan_grads(block_b, chunk_t):
     """Hand-written whole-sequence GRU backward kernel vs autodiff through
     the scan."""
     from paddle_tpu.ops import rnn as R
@@ -317,7 +319,7 @@ def test_gru_fused_backward_kernel_matches_scan_grads(block_b):
         return R.gru(x, lens, w, u, b, h0=h0, fused=False)
 
     def fused_path(x, w, u, b, h0):
-        return R._gru_fused(x, lens, w, u, b, h0, block_b)
+        return R._gru_fused(x, lens, w, u, b, h0, block_b, chunk_t)
 
     g_ref = jax.grad(loss(scan_path), argnums=(0, 1, 2, 3, 4))(x, w, u, b, h0)
     g_fused = jax.grad(loss(fused_path), argnums=(0, 1, 2, 3, 4))(

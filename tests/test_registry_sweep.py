@@ -478,10 +478,24 @@ def test_op_executes_finite(op_type):
 GRAD_CASES = [(op, slot, idx) for op, spec in SPECS.items()
               for slot, idx in spec.get("grad", [])]
 
+# tier-1 velocity (ROADMAP item 5): the costliest numeric-gradient sweeps
+# (multi-second central differences over recurrent/DP ops) duplicate
+# dedicated ANALYTIC grad tests — lstm/gru: test_rnn_ops + test_pallas
+# fused-vs-scan grads; crf/warpctc: test_crf_ctc; nested_lstm:
+# test_nested_seq — so they ride the slow lane; the sweep still runs the
+# cheap numeric cases and executes EVERY op forward in tier-1.
+SLOW_GRAD_CASES = {("warpctc", "Logits", 0),
+                   ("linear_chain_crf", "Emission", 0),
+                   ("linear_chain_crf", "Transition", 0),
+                   ("lstm", "W", 0), ("lstm", "U", 0),
+                   ("nested_lstm", "W", 0), ("gru", "W", 0)}
 
-@pytest.mark.parametrize("op_type,slot,idx",
-                         GRAD_CASES,
-                         ids=[f"{o}:{s}{i}" for o, s, i in GRAD_CASES])
+
+@pytest.mark.parametrize(
+    "op_type,slot,idx",
+    [pytest.param(*c, marks=pytest.mark.slow)
+     if c in SLOW_GRAD_CASES else c for c in GRAD_CASES],
+    ids=[f"{o}:{s}{i}" for o, s, i in GRAD_CASES])
 def test_op_numeric_gradient(op_type, slot, idx):
     spec = SPECS[op_type]
     compute = OpRegistry.get(op_type)

@@ -83,18 +83,26 @@ def test_bidirectional_concat(np_rng):
 
 
 def test_fused_lstm_vmem_guard_falls_back():
-    """Long sequences must fall back to the scan (whole-sequence tile would
-    blow the VMEM budget) instead of failing to compile."""
+    """Shapes with no VMEM-legal (batch-tile, time-chunk) plan must fall
+    back to the scan instead of failing to compile; shapes with one must
+    prefer MXU-feeding wide batch tiles (the widened-coverage contract)."""
     from paddle_tpu.ops import rnn as R
-    # bench shape fits; tiles are Mosaic-legal (multiple of 8, or == batch)
-    assert R._fused_block_b(100, 256) == 8
-    assert R._fused_block_b(1024, 512) is None      # 64MB tile -> scan
-    assert R._fused_block_b(100, 256, batch=5) == 5  # sub-8: single tile
-    # backward runs time-chunked: h256 splits T=100 into VMEM-sized chunks,
-    # h1280 can't fit even 8 steps (u alone is 26 MB) -> scan replay
-    c = R._bwd_chunk_len(100, 256, 4, 11)
-    assert c is not None and 8 <= c < 100
-    assert R._bwd_chunk_len(100, 1280, 4, 11) is None
+    # the textcls bench family (h256, len<=100, B=64+) now plans a WIDE
+    # batch tile — the whole point of the time-chunked widening: 8-row
+    # tiles starved the MXU and lost the B=64 crossover
+    blk, chunk = R._fused_plan(100, 256, seq_h_units=6, batch=64)
+    assert blk >= 32 and blk % 8 == 0 and 8 <= chunk <= 100
+    # long sequences fit by shrinking the chunk, not by falling back
+    blk, chunk = R._fused_plan(1024, 512, seq_h_units=6, batch=64)
+    assert blk % 8 == 0 and chunk < 1024
+    # sub-8 batches run a single exact-width tile
+    assert R._fused_plan(100, 256, batch=5)[0] == 5
+    # h1280: u alone is 26 MB -> no plan, scan
+    assert R._fused_plan(100, 1280, batch=64) is None
+    # backward: h256 chunks T=100 into wide-tile launches; h1280 replays
+    plan = R._fused_bwd_plan(100, 256, 4, 11, 64)
+    assert plan is not None and plan[0] >= 32 and 8 <= plan[1] <= 100
+    assert R._fused_bwd_plan(100, 1280, 4, 11, 64) is None
     # fused=True on a too-big shape silently uses the scan
     rs = np.random.RandomState(0)
     B, T, D, H = 2, 40, 3, 4

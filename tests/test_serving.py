@@ -8,8 +8,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from paddle_tpu import obs
 from paddle_tpu.models import TransformerLM
-from paddle_tpu.serving import ContinuousBatcher, Request
+from paddle_tpu.serving import ContinuousBatcher, Request, SpeculativeDecoder
 
 VOCAB, D, H, L, MAX_LEN = 97, 32, 4, 2, 128
 
@@ -22,10 +23,19 @@ def model_and_params():
     return model, params
 
 
-def _solo(model, params, prompt, steps):
+def _solo(model, params, prompt, steps, _bucket=12):
+    """Reference: the request decoded ALONE through generate_cached.
+
+    Tier-1 velocity (ROADMAP item 5, shared traced executables): `steps`
+    is padded to a bucket multiple and the stream truncated — greedy
+    continuation is prefix-stable, so tokens are identical while the
+    dozen distinct per-request scan lengths collapse onto ~3 compiled
+    scan programs (each request still pays its own ragged prefill)."""
+    padded = min(-(-steps // _bucket) * _bucket,
+                 model.max_len - len(prompt))
     out = model.generate_cached(params, jnp.asarray(prompt[None]),
-                                steps=steps)
-    return np.asarray(out)[0, len(prompt):]
+                                steps=padded)
+    return np.asarray(out)[0, len(prompt):len(prompt) + steps]
 
 
 def test_continuous_batching_matches_solo_decode(model_and_params):
@@ -108,6 +118,137 @@ def test_idle_slot_parking_near_max_len(model_and_params):
     got = b.serve([Request(0, prompt, gen)])
     want = _solo(model, params, prompt, gen)
     np.testing.assert_array_equal(got[0], want)
+
+
+def test_continuous_batching_int8_kv_matches_solo_int8(model_and_params):
+    """The quantized-KV exactness contract for serving: an int8-cache
+    batcher's tokens equal SOLO decode at the same kv_dtype (the
+    quantization error is the configuration's, batching adds none)."""
+    model, params = model_and_params
+    rs = np.random.RandomState(13)
+    reqs = [Request(rid, rs.randint(0, VOCAB, int(rs.randint(3, 30))),
+                    int(rs.randint(1, 25))) for rid in range(3)]
+    b = ContinuousBatcher(model, params, slots=2, segment=8,
+                          cache_bucket=32, kv_dtype="int8")
+    got = b.serve(reqs)
+    for r in reqs:
+        want = np.asarray(model.generate_fused(
+            params, jnp.asarray(r.prompt[None]), steps=r.max_new,
+            kv_dtype="int8"))[0, len(r.prompt):]
+        np.testing.assert_array_equal(got[r.rid], want,
+                                      err_msg=f"request {r.rid}")
+
+
+def test_continuous_batching_counts_segment_dispatches(model_and_params):
+    """One dispatch per SEGMENT (not per token, not per op) is the
+    batcher's economics — decode.dispatches_total proves it and
+    tokens_total matches the delivered stream."""
+    model, params = model_and_params
+    prompt = np.random.RandomState(3).randint(0, VOCAB, 9)
+    r = obs.MetricsRegistry()
+    with obs.ObsSession(registry=r).installed():
+        got = ContinuousBatcher(model, params, slots=2, segment=8,
+                                cache_bucket=32).serve(
+            [Request(0, prompt, 20)])
+    samples = r.collect()
+    segs = [s["value"] for s in samples
+            if s["name"] == "decode.dispatches_total"
+            and s["labels"].get("route") == "serve_segment"]
+    assert segs and segs[0] == -(-20 // 8)        # ceil(tokens/segment)
+    toks = [s["value"] for s in samples
+            if s["name"] == "decode.tokens_total"]
+    assert sum(toks) == len(got[0]) == 20
+
+
+# -- speculative decoding ---------------------------------------------------
+
+
+class _ScriptedDraft:
+    """A draft exposing the model interface but proposing SCRIPTED tokens —
+    the 'any acceptance pattern' adversary: constant garbage (never
+    accepted), or an oracle replay (always accepted)."""
+
+    def __init__(self, script, max_len):
+        self.script = script          # [B] -> proposal, called per step
+        self.max_len = max_len
+
+    def prefill(self, params, prompt):
+        return {"pos": jnp.zeros((prompt.shape[0],), jnp.int32)}, \
+            jnp.zeros((prompt.shape[0], VOCAB), jnp.float32)
+
+    def decode_step(self, params, cell, tokens):
+        tok = self.script(tokens)
+        onehot = jax.nn.one_hot(tok, VOCAB, dtype=jnp.float32)
+        return onehot, cell
+
+
+def _greedy(model, params, prompt, steps):
+    return np.asarray(model.generate_cached(
+        params, jnp.asarray(prompt), steps=steps))[:, prompt.shape[1]:]
+
+
+@pytest.mark.parametrize("k", [1, 3, 6])
+def test_speculative_self_draft_exact_and_fully_accepted(model_and_params,
+                                                         k):
+    """draft == target: every proposal accepted, output still exactly
+    greedy — and the acceptance stats say so."""
+    model, params = model_and_params
+    prompt = np.random.RandomState(17).randint(0, VOCAB, (2, 6))
+    want = _greedy(model, params, prompt, 18)
+    sd = SpeculativeDecoder(model, params, model, params, k=k)
+    got, stats = sd.generate(prompt, 18)
+    np.testing.assert_array_equal(got, want)
+    assert stats["acceptance_rate"] == 1.0
+    if k > 1:
+        assert stats["rounds"] < 18          # fewer target passes
+
+
+def test_speculative_adversarial_draft_still_exact(model_and_params):
+    """A draft that NEVER matches the target (constant garbage proposals):
+    zero acceptance, one token per round, output still exactly greedy —
+    the for-any-acceptance-pattern clause."""
+    model, params = model_and_params
+    prompt = np.random.RandomState(19).randint(0, VOCAB, (2, 5))
+    want = _greedy(model, params, prompt, 10)
+    # constant proposals can only collide with greedy by accident on 2
+    # fixed rows; pick a token neither row ever emits
+    bad = int((want.max() + 1) % VOCAB)
+    assert not (want == bad).any()
+    draft = _ScriptedDraft(lambda toks: jnp.full_like(toks, bad), MAX_LEN)
+    sd = SpeculativeDecoder(model, params, draft, None, k=4)
+    got, stats = sd.generate(prompt, 10)
+    np.testing.assert_array_equal(got, want)
+    assert stats["accepted"] == 0
+    # prefill emits token 1; each zero-acceptance round emits exactly one
+    assert stats["rounds"] == 9
+
+
+def test_speculative_mixed_draft_and_int8_self_draft(model_and_params):
+    """A weaker real draft (random tiny model) and the bench's int8
+    self-speculation draft: partial acceptance, exact output either way."""
+    model, params = model_and_params
+    prompt = np.random.RandomState(23).randint(0, VOCAB, (3, 7))
+    want = _greedy(model, params, prompt, 15)
+    tiny = TransformerLM(VOCAB, d_model=16, n_heads=2, n_layers=1,
+                         max_len=MAX_LEN)
+    tparams = tiny.init(jax.random.PRNGKey(9))
+    for draft, dparams, dkv in ((tiny, tparams, None),
+                                (model, params, "int8")):
+        sd = SpeculativeDecoder(model, params, draft, dparams,
+                                k=4, draft_kv_dtype=dkv)
+        got, stats = sd.generate(prompt, 15)
+        np.testing.assert_array_equal(got, want)
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+
+def test_speculative_budget_validation(model_and_params):
+    model, params = model_and_params
+    sd = SpeculativeDecoder(model, params, model, params, k=4)
+    long_prompt = np.zeros((1, MAX_LEN - 6), np.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        sd.generate(long_prompt, 10)
+    with pytest.raises(ValueError, match="empty prompt"):
+        sd.generate(np.zeros((1, 0), np.int32), 4)
 
 
 def test_zero_length_prompt_rejected(model_and_params):
