@@ -298,6 +298,12 @@ def main(full: bool = False):
                  "fromlist=['x']).run()", ROW_TIMEOUT))
     rows.append(("__import__('benchmarks.serving_decode', fromlist=['x'])"
                  ".run_continuous()", ROW_TIMEOUT))
+    # the serving-plane rows (ROADMAP item 2): paged-vs-pinned residency
+    # on the same mixed workload, and the daemon's client-measured SLOs
+    rows.append(("__import__('benchmarks.serving_decode', fromlist=['x'])"
+                 ".run_paged()", ROW_TIMEOUT))
+    rows.append(("__import__('benchmarks.serving_daemon', fromlist=['x'])"
+                 ".run()", ROW_TIMEOUT))
     if full:
         # the remaining BASELINE.md rows, so a --full session covers the
         # whole measured table in one output

@@ -1,0 +1,98 @@
+"""Serving-daemon SLO row — TTFT/TPOT through the WHOLE serve path.
+
+The decode rows measure the chip; this row measures the service: requests
+submitted over the native RPC plane into `paddle_tpu serve`'s engine
+(paged KV-cache, continuous batching, admission queue), tokens streamed
+back via srv_poll. TTFT (submit -> first token, queueing + prefill
+included) and TPOT (per-token cadence after the first) are measured
+CLIENT-side — what a caller actually experiences — and reported as p50/p95
+next to delivered tokens/sec. The `_serve_` bench-row family rule
+(analysis/bench_schema.py) makes the SLO pair mandatory for rows like
+this one.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .serving_decode import VOCAB, build
+
+
+def _pct(xs, q) -> float:
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else 0.0
+
+
+def run(n_requests: int = 48, slots: int = 16, segment: int = 32) -> dict:
+    from paddle_tpu.serving import ServingClient, ServingDaemon, ServingEngine
+
+    model, p16, _ = build(slots)
+    rs = np.random.RandomState(0)
+    workload = [(rs.randint(0, VOCAB, int(rs.randint(32, 257))),
+                 int(rs.randint(32, 257))) for _ in range(n_requests)]
+
+    engine = ServingEngine(model, p16, slots=slots, segment=segment,
+                           page_block=64, cache_bucket=512,
+                           prompt_buckets=(256,),
+                           queue_cap=max(2 * n_requests, 64))
+    daemon = ServingDaemon(engine).start()
+    try:
+        client = ServingClient(*daemon.address, call_timeout=120.0)
+        # warm every compiled program (admission tpad-256 + both cache-read
+        # buckets) before timing — a long-lived daemon serves warm
+        warm = [client.submit(rs.randint(0, VOCAB, 256), 256)
+                for _ in range(slots)]
+        for rid in warm:
+            while not client.poll(rid)[1]:
+                time.sleep(0.05)
+
+        t0 = time.perf_counter()
+        t_submit, t_first, t_done, counts = {}, {}, {}, {}
+        pending = []
+        for i, (prompt, gen) in enumerate(workload):
+            t_submit[i] = time.perf_counter()
+            pending.append((i, client.submit_with_backoff(prompt, gen)))
+        cursors = {i: 0 for i, _ in pending}
+        while pending:
+            for i, rid in list(pending):
+                toks, done, _ = client.poll(rid, cursors[i])
+                now = time.perf_counter()
+                if toks and i not in t_first:
+                    t_first[i] = now
+                cursors[i] += len(toks)
+                if done:
+                    t_done[i], counts[i] = now, cursors[i]
+                    pending.remove((i, rid))
+            time.sleep(0.01)
+        dt = time.perf_counter() - t0
+    finally:
+        daemon.stop()
+
+    delivered = sum(counts.values())
+    ttft = [(t_first[i] - t_submit[i]) * 1e3 for i in t_first]
+    tpot = [(t_done[i] - t_first[i]) / (counts[i] - 1) * 1e3
+            for i in t_done if counts[i] > 1 and i in t_first]
+    return {"metric": f"transformer_lm_serve_daemon_tokens_per_sec_"
+                      f"slots{slots}_seg{segment}_mixed32-256",
+            "value": round(delivered / dt, 1), "unit": "tokens/sec",
+            "vs_baseline": None,
+            "requests": n_requests, "delivered_tokens": delivered,
+            "ttft_p50_ms": round(_pct(ttft, 50), 1),
+            "ttft_p95_ms": round(_pct(ttft, 95), 1),
+            "tpot_p50_ms": round(_pct(tpot, 50), 2),
+            "tpot_p95_ms": round(_pct(tpot, 95), 2),
+            "note": "end-to-end over the native RPC plane (srv_submit/"
+                    "srv_poll): paged KV-cache engine, FIFO admission, "
+                    "client-measured SLOs incl. queue wait; TTFT counts "
+                    "queueing + ragged prefill, TPOT the segment-paced "
+                    "token cadence after the first"}
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    print(json.dumps(run()), flush=True)

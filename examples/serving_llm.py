@@ -3,7 +3,7 @@
 The modern serving loop on top of the incremental-decode path: a fixed pool
 of KV-cache slots, requests with MIXED prompt and generation lengths
 admitted into freed slots at segment boundaries, longest-first scheduling
-(paddle_tpu/serving.py). The 2017 reference's serving story stops at the C
+(paddle_tpu/serving/batcher.py). The 2017 reference's serving story stops at the C
 inference ABI (capi/gradient_machine.h:73 forward); this is the capability
 a 2024 deployment expects on top of it — every emitted token is exactly
 what solo greedy decode would produce (tests/test_serving.py).

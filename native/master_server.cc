@@ -516,6 +516,15 @@ void* ptms_start(void* master, const char* host, int port, int* out_port) {
 
 int ptms_port(void* h) { return static_cast<Server*>(h)->port; }
 
+// Live client connections — the serving daemon's drain/telemetry signal
+// (a long-lived `paddle_tpu serve` wants to know who is still attached
+// before stopping, and exports the count as a gauge).
+int ptms_active_conns(void* h) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  return (int)s->conns.size();
+}
+
 // Fencing flag, pushed from the Python control plane (lease/fence checks):
 // while set, mutating ops answer the "fenced: ..." error the client's
 // failover logic matches on; reads (stats) still serve.

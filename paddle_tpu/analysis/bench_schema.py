@@ -17,7 +17,10 @@ Family rules key on the metric NAME, which is itself part of the contract
 * ``*_train_*`` rows: ``mfu`` — the roofline campaign's target column
   (no training row below 15% MFU, ROADMAP item 3);
 * ``*_decode_*`` rows: ``hbm_bw_util`` — decode is bytes-bound, so its
-  roofline column is bandwidth, not FLOPs (target >= 0.30).
+  roofline column is bandwidth, not FLOPs (target >= 0.30);
+* ``*_serve_*`` rows: ``ttft_p50_ms`` + ``tpot_p50_ms`` — a serving row
+  without its SLO pair is throughput theater (time-to-first-token and
+  time-per-output-token are what callers experience; PR 8's daemon rows).
 """
 
 from __future__ import annotations
@@ -31,6 +34,7 @@ REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline")
 FAMILY_REQUIRED = {
     "_train_": ("mfu",),
     "_decode_": ("hbm_bw_util",),
+    "_serve_": ("ttft_p50_ms", "tpot_p50_ms"),
 }
 
 #: substrings exempting a row from family rules (comparative/meta rows

@@ -861,6 +861,110 @@ def cmd_obs_serve(args):
     return 0
 
 
+def cmd_serve(args):
+    """``paddle_tpu serve`` — the production serving daemon: a paged
+    KV-cache continuous-batching engine behind the native RPC plane
+    (srv_submit / srv_poll / srv_cancel / srv_stats; see
+    docs/design/serving.md and :class:`paddle_tpu.serving.ServingClient`).
+
+    The model comes from ``--config`` (a Python script exposing module-
+    level ``model`` — a TransformerLM-compatible object — and ``params``)
+    or, without one, a randomly-initialized TransformerLM built from the
+    ``--vocab/--d_model/...`` flags and ``--seed`` (the bring-up and e2e
+    test mode: the same flags + seed reproduce the exact weights).
+
+    The address line ``SERVING <host> <port>`` prints first and flushed
+    (machine-parseable, same contract as ``obs serve``); the process then
+    serves until SIGTERM/SIGINT, drains, and (with ``--obs_out``) saves
+    the metric/span dump — TTFT/TPOT histograms included.
+    """
+    import signal
+
+    from . import obs as _obs
+    from .serving import ServingDaemon, ServingEngine
+    if args.config:
+        cfg = _load_config(args.config)
+        if "model" not in cfg or "params" not in cfg:
+            print("serve: --config must expose module-level `model` and "
+                  "`params`", file=sys.stderr)
+            return 2
+        model, params = cfg["model"], cfg["params"]
+    else:
+        import jax
+
+        from .models import TransformerLM
+        model = TransformerLM(args.vocab, d_model=args.d_model,
+                              n_heads=args.n_heads, n_layers=args.n_layers,
+                              max_len=args.max_len)
+        params = model.init(jax.random.PRNGKey(args.seed))
+    session = _obs.ObsSession().install()
+    flight = None
+    if args.obs_out:
+        flight = _obs.FlightRecorder(session, args.obs_out).arm()
+    try:
+        engine = ServingEngine(
+            model, params, slots=args.slots, segment=args.segment,
+            page_block=args.page_block, pages=args.pages,
+            cache_bucket=args.cache_bucket, kv_dtype=args.kv_dtype,
+            queue_cap=args.queue_cap,
+            default_timeout_s=args.request_timeout)
+    except ValueError as e:
+        # bad flag combinations (page_block not dividing max_len, a
+        # cache_bucket off the page grid, ...) get the same structured
+        # refusal as a bad --config, not a construction traceback
+        if flight is not None:
+            flight.disarm()
+        session.uninstall()
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+    try:
+        daemon = ServingDaemon(engine, args.host, args.port).start()
+    except OSError as e:
+        # bind failures (port in use, bad host) get the structured refusal
+        # too — and nothing half-started may outlive it: the engine's
+        # scheduler thread stops, the armed recorder must not write a
+        # spurious death dump
+        engine.stop()
+        if flight is not None:
+            flight.disarm()
+        session.uninstall()
+        print(f"serve: cannot bind {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 2
+    host, port = daemon.address
+    print(f"SERVING {host} {port}", flush=True)
+    print(f"  slots={args.slots} segment={args.segment} "
+          f"page_block={args.page_block} "
+          f"pages={engine.pool.pages} queue_cap={args.queue_cap}"
+          + (f" kv_dtype={args.kv_dtype}" if args.kv_dtype else ""),
+          flush=True)
+    import threading
+    stop = threading.Event()
+
+    def _on_term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        daemon.stop(drain_s=args.drain)
+        if flight is not None:
+            flight.disarm()
+        session.uninstall()
+        if args.obs_out:
+            try:
+                session.save(args.obs_out)
+                print(f"observability dump written to {args.obs_out}",
+                      flush=True)
+            except Exception as e:
+                print(f"warning: could not write obs dump: {e}",
+                      file=sys.stderr)
+    return 0
+
+
 def cmd_version(args):
     from . import __version__
     import jax
@@ -1031,6 +1135,40 @@ def main(argv=None) -> int:
     osv.add_argument("--port", type=int, default=0,
                      help="0 binds an ephemeral port (printed on start)")
     osv.set_defaults(fn=cmd_obs_serve)
+
+    sv = sub.add_parser("serve", help="serving daemon: paged KV-cache "
+                        "continuous batching behind the native RPC plane "
+                        "(srv_submit/srv_poll/srv_cancel; "
+                        "docs/design/serving.md)")
+    sv.add_argument("--config", default=None,
+                    help="Python script exposing `model` and `params`; "
+                    "omitted = random-init TransformerLM from the flags")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=0)
+    sv.add_argument("--vocab", type=int, default=50257)
+    sv.add_argument("--d_model", type=int, default=768)
+    sv.add_argument("--n_heads", type=int, default=12)
+    sv.add_argument("--n_layers", type=int, default=12)
+    sv.add_argument("--max_len", type=int, default=1024)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--slots", type=int, default=8)
+    sv.add_argument("--segment", type=int, default=32)
+    sv.add_argument("--page_block", type=int, default=64)
+    sv.add_argument("--pages", type=int, default=None,
+                    help="pool pages incl. the null page (default: worst "
+                    "case slots*max_len/page_block + 1)")
+    sv.add_argument("--cache_bucket", type=int, default=256)
+    sv.add_argument("--kv_dtype", choices=["int8"], default=None)
+    sv.add_argument("--queue_cap", type=int, default=64)
+    sv.add_argument("--request_timeout", type=float, default=None,
+                    help="default per-request deadline (seconds); "
+                    "timed-out requests free their slot and pages")
+    sv.add_argument("--drain", type=float, default=10.0,
+                    help="seconds to let in-flight requests finish (and "
+                    "clients collect them) on SIGTERM before severing "
+                    "connections; 0 = stop immediately")
+    sv.add_argument("--obs_out", default=None)
+    sv.set_defaults(fn=cmd_serve)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=cmd_version)

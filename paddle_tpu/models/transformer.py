@@ -188,7 +188,8 @@ class TransformerLM(nn.Module):
 
     # -- incremental decoding (the serving path) ---------------------------
     def prefill(self, params, prompt, lengths=None, *,
-                kv_dtype: Optional[str] = None):
+                kv_dtype: Optional[str] = None,
+                pad_to: Optional[int] = None):
         """Run the prompt once, materializing per-layer KV caches padded to
         max_len. Returns (cell, last_logits [B, V]); cell carries the caches
         and the per-sample write position.
@@ -200,25 +201,36 @@ class TransformerLM(nn.Module):
         decode mask (j <= pos) never reads a row past ``pos``, and each
         generation step overwrites row ``pos`` before advancing — so the
         garbage is overwritten strictly before it becomes readable. This is
-        the slot-refill path of continuous batching (serving.py).
+        the slot-refill path of continuous batching (serving/batcher.py).
 
         ``kv_dtype="int8"`` stores the caches as symmetric int8 rows with
         per-(position, head) f32 scales (``k{i}_scale``/``v{i}_scale`` in
         the cell) — decode's HBM cache read halves; the prompt forward
         itself still runs full precision (the quantization error enters
         only through later cache READS; docs/design/kernels.md states the
-        numerics contract)."""
+        numerics contract).
+
+        ``pad_to`` (default max_len) bounds the returned cache padding —
+        the PAGED admission path (serving/paged.py) only scatters the
+        first prompt-bucket rows into its page pool, and padding the
+        transient cell to max_len would spike peak HBM to the pinned-pool
+        size paging exists to avoid. Must be >= the prompt width; the
+        dense decode paths keep the max_len default."""
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
                              "(None or 'int8')")
         B, T0 = prompt.shape
+        limit = self.max_len if pad_to is None else min(pad_to, self.max_len)
+        if limit < T0:
+            raise ValueError(f"prefill cache limit {limit} (pad_to/max_len) "
+                             f"is narrower than the prompt ({T0})")
         x = self.embed(params["embed"], prompt)
         x = x + params["pos_embed"][:T0].astype(x.dtype)
         if lengths is None:
             cell = {"pos": jnp.full((B,), T0, jnp.int32)}
         else:
             cell = {"pos": jnp.asarray(lengths, jnp.int32)}
-        pad = self.max_len - T0
+        pad = limit - T0
         pad4 = ((0, 0), (0, pad), (0, 0), (0, 0))
         for i in range(len(self.blocks)):
             blk = self.blocks[i]
@@ -302,6 +314,62 @@ class TransformerLM(nn.Module):
                 k_scale=None if ksc is None else ksc[:, :L],
                 v_scale=None if vsc is None else vsc[:, :L],
                 route=attn_route)
+            x = blk.finish(params[f"blocks_{i}"], x, o[:, None])
+        x = self.ln_f(params["ln_f"], x)
+        logits = (x @ params["embed"]["w"].T.astype(x.dtype)
+                  if self.tie_head else self.head(params["head"], x))
+        return logits[:, 0], new_cell
+
+    def decode_step_paged(self, params, cell, tokens, tables, *,
+                          attn_route: Optional[str] = None):
+        """One incremental step against a PAGED cache: tokens [B] ->
+        (logits [B, V], new cell). The cell holds per-layer page POOLS
+        (``k{i}``/``v{i}`` [P, bs, H, Dh], plus ``k{i}_scale``/``v{i}_scale``
+        [P, bs, H] when int8) shared by every request, and ``tables``
+        [B, NB] names which pages hold each request's positions
+        j*bs..(j+1)*bs-1 — HBM holds live tokens, not max_len padding
+        (serving/paged.py owns allocation).
+
+        The step's k/v row is appended at page ``tables[b, pos//bs]``, row
+        ``pos % bs`` (callers guarantee the page exists and that live
+        requests never share a page; the reserved null page 0 absorbs
+        drained-slot writes), then the read goes through
+        :func:`ops.pallas_kernels.paged_decode_attention` — the same
+        masked-softmax formulation as the dense-row path, so paged and
+        pinned greedy tokens agree bit-for-bit on the same cache contents.
+        ``tables`` is sliced by the CALLER to the live read bound (NB
+        pages), the paged twin of ``decode_step``'s ``cache_len``."""
+        pos = cell["pos"]                                  # [B]
+        B = tokens.shape[0]
+        bs = cell["k0"].shape[1]
+        page = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                                   axis=1)[:, 0]           # [B]
+        row = pos % bs
+        x = self.embed(params["embed"], tokens[:, None])   # [B, 1, D]
+        x = x + params["pos_embed"][pos][:, None, :].astype(x.dtype)
+        new_cell = {"pos": pos + 1}
+        quant = "k0_scale" in cell
+        for i in range(len(self.blocks)):
+            blk = self.blocks[i]
+            q, k, v = blk.heads(params[f"blocks_{i}"], x)  # [B, 1, H, Dh]
+            k1, v1 = k[:, 0], v[:, 0]                      # [B, H, Dh]
+            if quant:
+                k1, ks = pk.quantize_kv(k1)
+                v1, vs = pk.quantize_kv(v1)
+                ksp = cell[f"k{i}_scale"].at[page, row].set(ks)
+                vsp = cell[f"v{i}_scale"].at[page, row].set(vs)
+                new_cell[f"k{i}_scale"], new_cell[f"v{i}_scale"] = ksp, vsp
+            else:
+                ksp = vsp = None
+            kp = cell[f"k{i}"].at[page, row].set(
+                k1.astype(cell[f"k{i}"].dtype))
+            vp = cell[f"v{i}"].at[page, row].set(
+                v1.astype(cell[f"v{i}"].dtype))
+            new_cell[f"k{i}"], new_cell[f"v{i}"] = kp, vp
+            o = pk.paged_decode_attention(
+                q[:, 0], kp, vp, tables, pos,
+                scale=blk.d_head ** -0.5,
+                k_scale=ksp, v_scale=vsp, route=attn_route)
             x = blk.finish(params[f"blocks_{i}"], x, o[:, None])
         x = self.ln_f(params["ln_f"], x)
         logits = (x @ params["embed"]["w"].T.astype(x.dtype)

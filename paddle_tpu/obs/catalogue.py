@@ -49,7 +49,7 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "data.retries_total": ("counter", "cloud_reader idle-poll retries"),
     "data.giveups_total": ("counter", "cloud_reader starvation deadlines"),
     "data.backoff_seconds_total": ("counter", "total poll backoff slept"),
-    # -- decode: models/transformer.py generate_fused, serving.py -------
+    # -- decode: models/transformer.py generate_fused, serving/ -------
     "decode.dispatches_total": ("counter", "compiled decode-step programs "
                                            "dispatched from the host (ONE "
                                            "serves a whole token / segment "
@@ -164,6 +164,35 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "rpc.retries_total": ("counter", "retry attempts across clients"),
     "rpc.giveups_total": ("counter", "retry budgets exhausted"),
     "rpc.backoff_seconds_total": ("counter", "total backoff delay slept"),
+    # -- serving: serving/engine.py, serving/paged.py -------------------
+    "serving.requests_total": ("counter", "requests finished, labels: "
+                                          "outcome (length | eos | "
+                                          "cancelled | timeout | error — "
+                                          "error = the engine failed and "
+                                          "abandoned it)",
+                               ("outcome",)),
+    "serving.rejected_total": ("counter", "submissions refused structured "
+                                          "at admission, labels: reason "
+                                          "(overloaded = queue cap; "
+                                          "draining = shutdown gate)",
+                               ("reason",)),
+    "serving.queue_depth": ("gauge", "requests waiting for a slot (the "
+                                     "admission queue)"),
+    "serving.slots_live": ("gauge", "slots holding an in-flight request"),
+    "serving.pages_used": ("gauge", "KV-cache pages currently allocated "
+                                    "out of the pool"),
+    "serving.pages_reserved": ("gauge", "pages reserved by admitted "
+                                        "requests (worst-case; >= used)"),
+    "serving.page_occupancy": ("gauge", "live tokens / allocated page "
+                                        "capacity — 1.0 means HBM holds "
+                                        "only live tokens (the paged-"
+                                        "cache residency win)"),
+    "serving.ttft_seconds": ("histogram", "submit -> first token (queueing "
+                                          "+ prefill) — the SLO pair's "
+                                          "first half"),
+    "serving.tpot_seconds": ("histogram", "per-output-token time after "
+                                          "the first (completion - first "
+                                          "token) / (n - 1)"),
     # -- trainer: trainer/trainer.py ------------------------------------
     "trainer.steps_total": ("counter", "train batches executed"),
     "trainer.examples_total": ("counter", "samples consumed (leading dim "
@@ -196,6 +225,10 @@ SPANS: Dict[str, str] = {
                        "remote = the client's rpc.call span)",
     "coord.dispatch": "server-side handling of one coord RPC (args: op; "
                       "remote = the client's rpc.call span)",
+    "serving.prefill": "one admission batch: ragged prefill + page "
+                       "placement (args: batch)",
+    "serving.segment": "one batched decode segment across live slots "
+                       "(args: live)",
     "ckpt.publish": "atomic pass-dir publication (args: pass_id)",
     "ckpt.member": "one member write+fsync (args: member, bytes)",
     "ckpt.fsync": "file or directory fsync",

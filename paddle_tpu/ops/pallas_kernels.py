@@ -675,6 +675,140 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Paged decode attention — the KV cache as a shared BLOCK POOL instead of one
+# max_len-padded row per slot: pools [P, bs, H, D] hold fixed-size pages, a
+# per-request block table [B, NB] names which pages hold positions
+# j*bs..(j+1)*bs-1, and only LIVE pages move. HBM then holds tokens, not
+# padding — the serving plane's mixed-length sessions share one pool and
+# freed requests return pages immediately (paddle_tpu/serving/paged.py).
+# The kernel streams each sample's live pages through VMEM exactly once
+# (scalar-prefetched table indices drive the page DMA), assembles the
+# contiguous [L, H, D] view there, and runs the SAME masked-softmax body as
+# decode_attention — so the paged read and the dense-row read agree to the
+# bit on the same cache contents.
+# ---------------------------------------------------------------------------
+
+def _paged_attn_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                       k_all, v_all, *, scale: float, block: int):
+    """One (sample, page) program: k_ref/v_ref [1, bs, H, D] is the page the
+    scalar-prefetched table names for (b, j); pages accumulate into the
+    k_all/v_all [NB*bs, H, D] VMEM scratch, and the LAST page program runs
+    the shared masked-softmax body over the assembled contiguous view."""
+    b, j = pl.program_id(0), pl.program_id(1)
+    k_all[pl.ds(j * block, block)] = k_ref[0].astype(jnp.float32)
+    v_all[pl.ds(j * block, block)] = v_ref[0].astype(jnp.float32)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        q = q_ref[0].astype(jnp.float32) * scale
+        _decode_attn_body(q, k_all[...], v_all[...], pos_ref[b], o_ref)
+
+
+def _paged_attn_q_kernel(tbl_ref, pos_ref, q_ref, k_ref, ks_ref, v_ref,
+                         vs_ref, o_ref, k_all, v_all, *, scale: float,
+                         block: int):
+    """int8 pool variant: pages dequantize in VMEM from per-(row, head)
+    scales [1, bs, H] while assembling the f32 view — the f32 cache never
+    exists in HBM."""
+    b, j = pl.program_id(0), pl.program_id(1)
+    k_all[pl.ds(j * block, block)] = (k_ref[0].astype(jnp.float32)
+                                      * ks_ref[0][..., None])
+    v_all[pl.ds(j * block, block)] = (v_ref[0].astype(jnp.float32)
+                                      * vs_ref[0][..., None])
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        q = q_ref[0].astype(jnp.float32) * scale
+        _decode_attn_body(q, k_all[...], v_all[...], pos_ref[b], o_ref)
+
+
+def gather_pages(pool: jax.Array, tables: jax.Array) -> jax.Array:
+    """Materialize the dense per-sample view of a page pool: pool
+    [P, bs, ...] gathered by tables [B, NB] -> [B, NB*bs, ...]. The dense
+    reference route (and tests) read through this; the kernel route never
+    materializes it in HBM."""
+    B, NB = tables.shape
+    g = pool[tables]                       # [B, NB, bs, ...]
+    return g.reshape((B, NB * pool.shape[1]) + pool.shape[2:])
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, tables: jax.Array,
+                           pos: jax.Array, *, scale: Optional[float] = None,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None,
+                           route: Optional[str] = None,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Single-token attention read through a block table — the paged twin
+    of :func:`decode_attention`.
+
+    q: [B, H, D]; k_pool/v_pool: [P, bs, H, D] page pools (bf16/f32, or
+    int8 with k_scale/v_scale [P, bs, H] f32 pools); tables: [B, NB] int32
+    page indices covering positions 0..NB*bs-1 (entries past a request's
+    live pages point at the reserved null page — rows there sit past
+    ``pos`` and are masked exactly like dense padding); pos: [B] int32,
+    rows j <= pos[b] are live. Returns o [B, H, D] f32.
+
+    Routing matches decode_attention: the Pallas kernel for long on-TPU
+    reads (pages stream through VMEM once, driven by the scalar-prefetched
+    table), the dense gather + reference math for short reads / off-TPU.
+    Both routes share one masked-softmax formulation over the SAME
+    assembled row order, so route choice never changes greedy tokens."""
+    B, NB = tables.shape
+    P, bs, H, D = k_pool.shape
+    L = NB * bs
+    scale_v = scale if scale is not None else D ** -0.5
+    if route is None:
+        route = ("kernel" if _on_tpu() and L >= SHORT_SEQ_DENSE
+                 else "dense")
+    from .. import obs
+    obs.count("kernels.routes_total", kernel="paged_decode_attention",
+              route=route)
+    if route == "dense":
+        k = gather_pages(k_pool, tables)
+        v = gather_pages(v_pool, tables)
+        ks = None if k_scale is None else gather_pages(k_scale, tables)
+        vs = None if v_scale is None else gather_pages(v_scale, tables)
+        return _dense_decode_attention(q, k, v, pos, scale_v, ks, vs)
+    if route != "kernel":
+        raise ValueError(f"unknown paged_decode_attention route {route!r}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    from jax.experimental.pallas import tpu as pltpu
+    q_spec = pl.BlockSpec((1, H, D), lambda b, j, tbl, p: (b, 0, 0))
+    page_spec = pl.BlockSpec((1, bs, H, D),
+                             lambda b, j, tbl, p: (tbl[b, j], 0, 0, 0))
+    sc_spec = pl.BlockSpec((1, bs, H),
+                           lambda b, j, tbl, p: (tbl[b, j], 0, 0))
+    out_spec = pl.BlockSpec((1, H, D), lambda b, j, tbl, p: (b, 0, 0))
+    scratch = [pltpu.VMEM((L, H, D), jnp.float32),
+               pltpu.VMEM((L, H, D), jnp.float32)]
+    out_shape = jax.ShapeDtypeStruct((B, H, D), jnp.float32)
+    tables32 = tables.astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32)
+    if k_scale is not None:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2, grid=(B, NB),
+            in_specs=[q_spec, page_spec, sc_spec, page_spec, sc_spec],
+            out_specs=out_spec, scratch_shapes=scratch)
+        kernel = functools.partial(_paged_attn_q_kernel, scale=scale_v,
+                                   block=bs)
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape,
+            interpret=bool(interpret),
+        )(tables32, pos32, q, k_pool, k_scale, v_pool, v_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(B, NB),
+        in_specs=[q_spec, page_spec, page_spec],
+        out_specs=out_spec, scratch_shapes=scratch)
+    kernel = functools.partial(_paged_attn_kernel, scale=scale_v, block=bs)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape,
+        interpret=bool(interpret),
+    )(tables32, pos32, q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
 # Fused LSTM sequence kernel — the hl_cuda_lstm.cu analog: the entire T-step
 # recurrence runs inside ONE kernel with the recurrent weights and the h/c
 # state resident in VMEM, so the per-step state never round-trips HBM the way

@@ -102,6 +102,9 @@ def _configure(lib: ctypes.CDLL):
                                c.POINTER(c.c_int)]
     lib.ptms_port.restype = c.c_int
     lib.ptms_port.argtypes = [c.c_void_p]
+    if hasattr(lib, "ptms_active_conns"):   # absent in a stale packaged .so
+        lib.ptms_active_conns.restype = c.c_int
+        lib.ptms_active_conns.argtypes = [c.c_void_p]
     lib.ptms_set_fenced.argtypes = [c.c_void_p, c.c_int]
     lib.ptms_set_fallback.argtypes = [c.c_void_p, PTMS_FALLBACK_FN]
     lib.ptms_reply.argtypes = [c.c_void_p, c.POINTER(c.c_char), c.c_int]

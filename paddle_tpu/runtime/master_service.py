@@ -153,6 +153,13 @@ class MasterServer:
         from ..obs.aggregate import ClusterAggregator
         self.aggregator = ClusterAggregator()
         self._fallback_cb = None  # keepalive for the ctypes callback
+        # control-plane extension ops (the serving daemon's srv_submit /
+        # srv_poll / srv_cancel ride here): served through the native
+        # unknown-op fallback exactly like obs_push — the C++ data plane
+        # never learns their payloads. Registered BEFORE start() so no
+        # request can observe a half-wired op table.
+        self._ext_ops = {}
+        self._known_ops = set(self._KNOWN_OPS)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -271,13 +278,17 @@ class MasterServer:
             self._keeper = None
         # native stop severs the listener AND every live connection — a
         # deposed master must not keep answering connected clients. The
-        # swap-and-call happens under the handle lock so a concurrent
-        # stop() or housekeeping tick can never double-free or fence a
-        # freed handle
+        # handle SWAP happens under the lock (a concurrent stop() or
+        # housekeeping tick can never double-free or fence a freed
+        # handle), but ptms_stop itself runs OUTSIDE it: it drains the
+        # handler threads, and a handler that takes _srv_lock
+        # (active_connections via srv_stats) would otherwise deadlock the
+        # shutdown. After the swap `h` is privately owned — no other path
+        # can reach it.
         with self._srv_lock:
             h, self._srv_h = self._srv_h, None
-            if h:
-                self._lib.ptms_stop(h)
+        if h:
+            self._lib.ptms_stop(h)
 
     def try_snapshot(self) -> bool:
         """Fenced snapshot write: refused (False) once a newer master has
@@ -364,9 +375,40 @@ class MasterServer:
     # obs_stats) fall back here via ptms_set_fallback. This Python twin is
     # also the readable protocol reference and the in-process entry the
     # fencing tests drive directly.
+    def register_op(self, name: str, handler) -> None:
+        """Register a control-plane op served via the native fallback path:
+        ``handler(req dict) -> resp dict``. The op joins the requests_total
+        label allowlist (a registered name is bounded by construction).
+        Raises if the name would shadow a built-in or an earlier
+        registration — op names are a wire contract, not a namespace to
+        last-write-win over."""
+        if name in self._known_ops or name in self._ext_ops:
+            raise ValueError(f"op {name!r} already registered")
+        self._ext_ops[name] = handler
+        self._known_ops.add(name)
+
+    def active_connections(self) -> int:
+        """Live client connections on the native server (0 when stopped) —
+        the serving daemon's drain/telemetry signal. Check
+        :attr:`conn_count_supported` before treating 0 as authoritative:
+        a stale packaged .so without the symbol also reads 0."""
+        with self._srv_lock:
+            if self._srv_h is None or self._lib is None or \
+                    not hasattr(self._lib, "ptms_active_conns"):
+                return 0
+            return int(self._lib.ptms_active_conns(self._srv_h))
+
+    @property
+    def conn_count_supported(self) -> bool:
+        """True when the loaded native library actually exports
+        ``ptms_active_conns`` and the server is running."""
+        with self._srv_lock:
+            return (self._srv_h is not None and self._lib is not None
+                    and hasattr(self._lib, "ptms_active_conns"))
+
     def _dispatch(self, req):
         op = str(req.get("op"))
-        label = op if op in self._KNOWN_OPS else "unknown"
+        label = op if op in self._known_ops else "unknown"
         obs.count("master.requests_total", type=label)
         # server-side span parented on the client's rpc.call via the wire
         # context — the cross-process edge the merged Chrome trace stitches
@@ -390,6 +432,9 @@ class MasterServer:
         if op in self._MUTATING_OPS and self._fenced_out():
             return {"ok": False,
                     "error": f"fenced: stale master token {self.fence_token}"}
+        ext = self._ext_ops.get(op)
+        if ext is not None:
+            return ext(req)
         if op == "obs_push":
             # telemetry is read-only w.r.t. task state: accepted even from
             # a fenced master's clients (the fleet view must survive

@@ -1,0 +1,31 @@
+"""paddle_tpu.serving — the serving plane.
+
+Layers (each file is one altitude):
+
+* :mod:`.batcher` — in-process continuous batching over the PINNED slot
+  pool (per-slot max_len cache rows) + speculative decoding; the
+  exact-greedy parity baseline.
+* :mod:`.paged` — the paged KV-cache: a shared page pool + per-request
+  block tables, so HBM holds live tokens instead of padding
+  (:class:`PagePool`, :class:`PagedBatcher`).
+* :mod:`.engine` — :class:`ServingEngine`: the long-lived scheduler with
+  submit/poll/cancel, admission control + backpressure, cancel/timeout
+  page reclamation, and TTFT/TPOT SLO telemetry.
+* :mod:`.daemon` — ``paddle_tpu serve``: the engine exposed over the
+  native RPC plane (srv_submit/srv_poll/srv_cancel via the unknown-op
+  fallback) + :class:`ServingClient`.
+
+The import surface is flat (``from paddle_tpu.serving import
+ContinuousBatcher``) — PR 8 turned the module into a package without
+moving any public name.
+"""
+
+from .batcher import (ContinuousBatcher, Request, SpeculativeDecoder,
+                      validate_request)
+from .daemon import ServingClient, ServingDaemon
+from .engine import Overloaded, ServingEngine
+from .paged import PagedBatcher, PagePool
+
+__all__ = ["ContinuousBatcher", "Request", "SpeculativeDecoder",
+           "validate_request", "PagePool", "PagedBatcher", "ServingEngine",
+           "Overloaded", "ServingDaemon", "ServingClient"]

@@ -32,8 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import obs
-from .core.lod import bucket_length
+from .. import obs
+from ..core.lod import bucket_length
 
 
 @dataclass
@@ -44,6 +44,45 @@ class Request:
     prompt: np.ndarray
     max_new: int
     eos_id: Optional[int] = None
+
+
+def validate_request(r: Request, model) -> None:
+    """Normalize + reject a malformed request AT SUBMIT TIME with a precise
+    ValueError — before PR 8 these surfaced as shape errors deep inside the
+    ragged prefill (an empty prompt's pos==0 gather wraps; max_new<=0 used
+    to idle a slot forever). Mutates ``r.prompt`` to a flat int32 array.
+    The paged pool's stronger page-budget check layers on top
+    (serving/paged.py PagedBatcher.validate)."""
+    r.prompt = np.asarray(r.prompt, np.int32).reshape(-1)
+    # engine submissions validate BEFORE a rid exists (placeholder -1);
+    # their errors must not name a bogus id to the caller
+    who = f"request {r.rid}" if r.rid >= 0 else "request"
+    if r.prompt.size == 0:
+        # prefill's ragged gather reads logits[b, pos-1]; pos==0 wraps to
+        # the last padded position and the "first token" would be silent
+        # garbage — exactness demands a real prompt
+        raise ValueError(f"{who}: empty prompt (prefill needs at least "
+                         "one token)")
+    if r.max_new <= 0:
+        raise ValueError(f"{who}: max_new must be >= 1, got {r.max_new}")
+    if r.prompt.size + 1 > model.max_len:
+        raise ValueError(f"{who}: prompt longer than max_len "
+                         f"{model.max_len}")
+
+
+def clip_emission(row, left: int, eos_id: Optional[int]):
+    """Budget-cap + EOS-truncate one slot's emitted token row — the ONE
+    owner of the take/done/reason decision every serving loop shares
+    (pinned batcher, paged batcher, engine), so the exact-greedy contract
+    cannot drift between them. Returns ``(take, done, reason)``; EOS stops
+    BEFORE emitting ``eos_id`` (it is never returned)."""
+    take = row[:min(int(left), len(row))]
+    done, reason = len(take) >= left, "length"
+    if eos_id is not None:
+        hits = np.nonzero(take == eos_id)[0]
+        if hits.size:
+            take, done, reason = take[:hits[0]], True, "eos"
+    return take, done, reason
 
 
 @dataclass
@@ -138,16 +177,7 @@ class ContinuousBatcher:
         Order of completion depends on scheduling; results do not."""
         queue = list(requests)
         for r in queue:
-            r.prompt = np.asarray(r.prompt, np.int32).reshape(-1)
-            if r.prompt.size == 0:
-                # prefill's ragged gather reads logits[b, pos-1]; pos==0
-                # wraps to the last padded position and the "first token"
-                # would be silent garbage — exactness demands a real prompt
-                raise ValueError(f"request {r.rid}: empty prompt (prefill "
-                                 "needs at least one token)")
-            if r.prompt.size + 1 > self.model.max_len:
-                raise ValueError(f"request {r.rid}: prompt longer than "
-                                 f"max_len {self.model.max_len}")
+            validate_request(r, self.model)
         if self.schedule == "longest_first":
             # sort by the EFFECTIVE budget (max_len caps it) — the work a
             # slot will actually hold
@@ -232,16 +262,12 @@ class ContinuousBatcher:
             for i, s in enumerate(slots):
                 if s.req is None:
                     continue
-                take = block[i, :min(s.left, block.shape[1])]
-                done = len(take) >= s.left         # budget reached
-                if s.req.eos_id is not None:
-                    hits = np.nonzero(take == s.req.eos_id)[0]
-                    if hits.size:
-                        take, done = take[:hits[0]], True
+                take, done, _ = clip_emission(block[i], s.left,
+                                              s.req.eos_id)
                 s.out.extend(int(t) for t in take)
                 obs.count("decode.tokens_total", len(take), route="serve")
                 s.left -= len(take)
-                if done or s.left <= 0:
+                if done:
                     results[s.req.rid] = np.asarray(s.out, np.int32)
                     slots[i] = _Slot()             # free the slot
             admit()

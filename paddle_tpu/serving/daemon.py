@@ -1,0 +1,347 @@
+"""The serving daemon + client — ``paddle_tpu serve`` over the native RPC
+plane.
+
+The daemon is a :class:`~paddle_tpu.runtime.master_service.MasterServer`
+whose control plane grew three ops (``register_op`` — they ride the
+``ptms_set_fallback`` unknown-op path, so the C++ data plane never learns
+their payloads):
+
+* ``srv_submit {prompt, max_new, eos_id?, timeout_s?}`` -> ``{rid}``, or a
+  STRUCTURED refusal: ``code="overloaded"`` (+ ``retry_after_s``) when the
+  admission queue is full — backpressure is a reply, never a dead
+  connection — and ``code="invalid_argument"`` for requests the
+  validation-hardening layer rejects at submit time;
+* ``srv_poll {rid, cursor}`` -> ``{tokens, done, reason}`` — token
+  STREAMING is cursor-based polling (tokens materialize at segment
+  boundaries, so poll cadence ~ segment cadence loses nothing);
+* ``srv_cancel {rid}`` -> frees the request's slot and pages at the next
+  segment boundary.
+
+``srv_stats`` rides along for load visibility, and the engine's metric
+registry is pushed into the master-side ClusterAggregator (worker label
+``serving``) so ``obs_stats`` / ``paddle_tpu obs serve --master`` expose
+the TTFT/TPOT histograms exactly like any worker's metrics (PR 4
+contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..runtime.master_service import MasterServer, _RpcClient
+from ..utils.retry import RetryPolicy
+from .engine import Overloaded, ServingEngine
+
+
+class ServingDaemon:
+    """Long-lived serving process: engine + RPC surface + telemetry push.
+
+    ``start()`` registers the srv_* ops, starts the native server and the
+    engine's scheduler thread. ``stop(drain_s=N)`` gives in-flight and
+    queued requests up to N seconds to finish (and connected clients to
+    collect them — ``ptms_active_conns`` is the signal) before tearing
+    the server down; the default ``drain_s=0`` stops immediately
+    (in-process tests)."""
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0, *, obs_interval_s: float = 1.0):
+        self.engine = engine
+        self.server = MasterServer(host, port)
+        self.server.register_op("srv_submit", self._srv_submit)
+        self.server.register_op("srv_poll", self._srv_poll)
+        self.server.register_op("srv_cancel", self._srv_cancel)
+        self.server.register_op("srv_stats", self._srv_stats)
+        self._obs_interval = obs_interval_s
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._obs_thread: Optional[threading.Thread] = None
+        # submit idempotency: srv_submit rides the transport's at-least-
+        # once retry, so a lost REPLY must not duplicate the admission —
+        # replays of a client's submit_key return the original rid
+        self._submit_lock = threading.Lock()
+        self._submit_seen: "OrderedDict[str, dict]" = OrderedDict()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    def start(self) -> "ServingDaemon":
+        self.engine.start()
+        self.server.start()
+        self._obs_thread = threading.Thread(target=self._push_obs,
+                                            daemon=True, name="serving-obs")
+        self._obs_thread.start()
+        return self
+
+    def stop(self, drain_s: float = 0.0) -> None:
+        self._draining.set()     # refuse new submissions from here on
+        if drain_s > 0:
+            # drain: let the scheduler finish live + queued work, then let
+            # clients poll the finished results home, all inside one
+            # deadline — only then sever connections. The second signal is
+            # UNDELIVERED RESULTS, not raw connection count: an idle-but-
+            # connected client must not make every shutdown burn the full
+            # window (active_connections stays a telemetry signal)
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline:
+                st = self.engine.stats()
+                if st["slots_live"] == 0 and st["queue_depth"] == 0:
+                    break
+                time.sleep(0.05)
+            while time.monotonic() < deadline \
+                    and self.engine.pending_results() > 0:
+                # early-out only on an AUTHORITATIVE zero: a stale .so
+                # without ptms_active_conns also reads 0, and skipping the
+                # collection wait there would sever mid-stream clients
+                if self.server.conn_count_supported \
+                        and self.server.active_connections() == 0:
+                    break
+                time.sleep(0.05)
+        self._stop.set()
+        if self._obs_thread is not None:
+            self._obs_thread.join(timeout=5.0)
+            self._obs_thread = None
+        self.server.stop()
+        self.engine.stop()
+
+    # -- telemetry ---------------------------------------------------------
+    def _push_obs(self) -> None:
+        """Push the installed session's registry into the in-process
+        aggregator under worker="serving" — the same snapshots a remote
+        worker would obs_push, without a loopback RPC."""
+        from ..obs.aggregate import wire_safe_samples
+        while not self._stop.wait(self._obs_interval):
+            s = obs.session()
+            if s is None:
+                continue
+            try:
+                self.server.aggregator.push(
+                    "serving", wire_safe_samples(s.registry.collect()))
+            except Exception:
+                pass    # telemetry must never take the daemon down
+
+    # -- op handlers (RPC fallback threads) --------------------------------
+    def _srv_submit(self, req):
+        key = req.get("submit_key")
+        if key is None:
+            if self._draining.is_set():
+                return self._refuse_draining()
+            return self._do_submit(req)
+        # check + admit + record under ONE lock: a transport-retry replay
+        # racing the slow original would otherwise find the cache empty
+        # and double-admit. engine.submit is host-side bookkeeping (no
+        # device work), so serializing submits here is cheap.
+        with self._submit_lock:
+            # replay lookup BEFORE the drain gate: a retry of an ALREADY-
+            # admitted submit (lost reply) must learn its rid even during
+            # shutdown — its result is exactly what the drain window is
+            # waiting for the client to collect
+            seen = self._submit_seen.get(str(key))
+            if seen is not None:
+                return dict(seen)      # replay: same rid
+            if self._draining.is_set():
+                return self._refuse_draining()
+            resp = self._do_submit(req)
+            if resp.get("ok"):
+                # capacity refusals are NOT remembered: the retry that
+                # matters there is the deliberate backoff one (must re-ask)
+                self._submit_seen[str(key)] = dict(resp)
+                while len(self._submit_seen) > 4096:
+                    self._submit_seen.popitem(last=False)
+            return resp
+
+    def _refuse_draining(self):
+        # shutdown gate: new work is refused structured (clients back
+        # off / fail over) so the drain window can actually drain
+        obs.count("serving.rejected_total", reason="draining")
+        return {"ok": False, "error": "overloaded: daemon is draining "
+                "for shutdown", "code": "overloaded",
+                "retry_after_s": 2.0}
+
+    def _do_submit(self, req):
+        try:
+            prompt = np.asarray(req.get("prompt", ()), np.int32)
+            max_new = int(req.get("max_new", 0))
+            eos = req.get("eos_id")
+            timeout = req.get("timeout_s")
+            rid = self.engine.submit(
+                prompt, max_new, eos_id=None if eos is None else int(eos),
+                timeout_s=None if timeout is None else float(timeout))
+        except Overloaded as e:
+            return {"ok": False, "error": f"overloaded: {e}",
+                    "code": "overloaded", "retry_after_s": e.retry_after_s}
+        except (ValueError, TypeError, RuntimeError) as e:
+            code = ("unavailable" if isinstance(e, RuntimeError)
+                    else "invalid_argument")
+            return {"ok": False, "error": str(e), "code": code}
+        return {"ok": True, "rid": rid}
+
+    def _srv_poll(self, req):
+        try:
+            rid = int(req["rid"])
+            cursor = int(req.get("cursor", 0))
+        except (KeyError, TypeError, ValueError):
+            return {"ok": False, "error": "srv_poll needs an integer rid "
+                    "(+ optional integer cursor)",
+                    "code": "invalid_argument"}
+        try:
+            tokens, done, reason = self.engine.poll(rid, cursor)
+        except KeyError:
+            return {"ok": False, "error": f"unknown rid {rid}",
+                    "code": "not_found"}
+        return {"ok": True, "tokens": [int(t) for t in tokens],
+                "done": bool(done), "reason": reason}
+
+    def _srv_cancel(self, req):
+        try:
+            rid = int(req["rid"])
+        except (KeyError, TypeError, ValueError):
+            return {"ok": False, "error": "srv_cancel needs an integer rid",
+                    "code": "invalid_argument"}
+        return {"ok": True, "cancelled": self.engine.cancel(rid)}
+
+    def _srv_stats(self, req):
+        stats = self.engine.stats()
+        stats["rpc_conns"] = self.server.active_connections()
+        return {"ok": True, **stats}
+
+
+class ServingClient(_RpcClient):
+    """Client for the serving daemon. Reuses the runtime's reconnecting
+    frame plumbing (per-call deadline, endpoint failover, RetryPolicy on
+    transport errors); ADMISSION backpressure is handled one level up —
+    ``submit`` surfaces the structured ``overloaded`` reply as
+    :class:`Overloaded`, and :meth:`generate`/:meth:`stream` retry it
+    through a client-side RetryPolicy honoring the server's
+    ``retry_after_s`` hint."""
+
+    _rpc_name = "serving rpc"
+
+    def submit(self, prompt, max_new: int, *, eos_id: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> int:
+        # submit_key makes the op idempotent across the transport's
+        # at-least-once retry: a lost reply re-sends the SAME key and the
+        # daemon answers with the original rid instead of admitting twice
+        req = {"op": "srv_submit",
+               "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+               "max_new": int(max_new),
+               "submit_key": uuid.uuid4().hex}
+        if eos_id is not None:
+            req["eos_id"] = int(eos_id)
+        if timeout_s is not None:
+            req["timeout_s"] = float(timeout_s)
+        r = self._call(req)
+        if not r.get("ok"):
+            if r.get("code") == "overloaded":
+                raise Overloaded(str(r.get("error")),
+                                 float(r.get("retry_after_s", 0.2)))
+            if r.get("code") == "unavailable":
+                # server fault (engine failed/stopped), not a malformed
+                # request — surface as the connection-class error callers
+                # failover on, never as ValueError
+                raise ConnectionError(str(r.get("error", "unavailable")))
+            raise ValueError(str(r.get("error", "submit failed")))
+        return int(r["rid"])
+
+    def poll(self, rid: int, cursor: int = 0) -> Tuple[List[int], bool, str]:
+        r = self._call({"op": "srv_poll", "rid": int(rid),
+                        "cursor": int(cursor)})
+        if not r.get("ok"):
+            raise KeyError(str(r.get("error", "poll failed")))
+        return list(r.get("tokens", ())), bool(r.get("done")), \
+            str(r.get("reason", ""))
+
+    def cancel(self, rid: int) -> bool:
+        r = self._call({"op": "srv_cancel", "rid": int(rid)})
+        return bool(r.get("cancelled"))
+
+    def serving_stats(self) -> dict:
+        r = self._call({"op": "srv_stats"})
+        if not r.get("ok"):
+            raise ConnectionError(str(r.get("error", "srv_stats failed")))
+        return {k: v for k, v in r.items() if k != "ok"}
+
+    def submit_with_backoff(self, prompt, max_new: int, *,
+                            eos_id: Optional[int] = None,
+                            timeout_s: Optional[float] = None,
+                            policy: Optional[RetryPolicy] = None) -> int:
+        """Submit, retrying structured ``overloaded`` refusals — the client
+        half of the backpressure contract. Each retry sleeps the LONGER of
+        the policy's capped-exponential delay and the server's
+        ``retry_after_s`` hint (the server knows its drain rate better
+        than our schedule does); the policy supplies the attempt budget
+        and the injectable sleep/clock."""
+        policy = policy or RetryPolicy(
+            max_attempts=16, base_delay=0.1, multiplier=1.5, max_delay=2.0,
+            jitter=0.25, retryable=lambda e: isinstance(e, Overloaded))
+        attempt = 0
+        while True:
+            try:
+                return self.submit(prompt, max_new, eos_id=eos_id,
+                                   timeout_s=timeout_s)
+            except Overloaded as e:
+                attempt += 1
+                if policy.max_attempts is not None \
+                        and attempt >= policy.max_attempts:
+                    raise Overloaded(
+                        f"server still overloaded after {attempt} submit "
+                        f"attempt(s): {e}") from e
+                policy.sleep(max(policy.delay_for(attempt - 1),
+                                 e.retry_after_s))
+
+    def stream(self, prompt, max_new: int, *, eos_id: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               poll_interval_s: float = 0.02,
+               policy: Optional[RetryPolicy] = None):
+        """Generator: submit (with backpressure backoff) then yield tokens
+        as poll exposes them, until the request finishes. Tokens arrive in
+        segment-sized bursts — the streaming granularity the decode loop
+        actually has."""
+        rid = self.submit_with_backoff(prompt, max_new, eos_id=eos_id,
+                                       timeout_s=timeout_s, policy=policy)
+        cursor = 0
+        finished = False
+        try:
+            while True:
+                tokens, done, reason = self.poll(rid, cursor)
+                for t in tokens:
+                    yield t
+                cursor += len(tokens)
+                if done:
+                    finished = True
+                    # length/eos are the normal completions; an interrupted
+                    # request must surface, not read as a short generation
+                    if reason == "timeout":
+                        raise TimeoutError(
+                            f"request {rid} timed out server-side")
+                    if reason in ("cancelled", "error"):
+                        raise RuntimeError(
+                            f"request {rid} ended server-side with reason="
+                            f"{reason} after {cursor} token(s)")
+                    return
+                time.sleep(poll_interval_s)
+        finally:
+            # an abandoned stream (break / GeneratorExit / error mid-yield)
+            # must not keep decoding server-side: the slot and its reserved
+            # pages would stay pinned for the full budget or timeout
+            if not finished:
+                try:
+                    self.cancel(rid)
+                except Exception:
+                    pass    # best effort; the server timeout still bounds it
+
+    def generate(self, prompt, max_new: int, *,
+                 eos_id: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 poll_interval_s: float = 0.02) -> np.ndarray:
+        """Blocking convenience: the full generated id array."""
+        return np.asarray(list(self.stream(
+            prompt, max_new, eos_id=eos_id, timeout_s=timeout_s,
+            poll_interval_s=poll_interval_s)), np.int32)

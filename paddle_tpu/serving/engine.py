@@ -1,0 +1,370 @@
+"""The serving engine — a long-lived continuous-batching scheduler with an
+async submit/poll/cancel surface, admission control, and SLO telemetry.
+
+Threading model (the actor discipline): ONE scheduler thread owns every
+device dispatch and every :class:`~paddle_tpu.serving.paged.PagePool`
+mutation. RPC handler threads (daemon.py) only touch engine records under
+``_lock`` — submit appends to the queue, poll reads a token buffer, cancel
+marks a flag the scheduler honors at the next segment boundary. Device
+work (prefill admission, decode segments) runs OUTSIDE the lock, so a poll
+never waits on a dispatch.
+
+The scheduler loop is deliberately split into two phases with no shared
+state beyond the pool —
+
+* :meth:`admit_prefill`: queue -> slots (page-budget check, ragged prefill,
+  first-token emission, TTFT);
+* :meth:`decode_segment`: one batched decode dispatch + collection
+  (budget/EOS/cancel/timeout finalization, page free);
+
+— the prefill/decode DISAGGREGATION seam: running the two phases on
+different workers (prefill nodes shipping pages to decode nodes) changes
+the transport between them, not the scheduler contract
+(docs/design/serving.md).
+
+Backpressure is structured: a full queue raises :class:`Overloaded`
+(carrying ``retry_after_s``), which the daemon answers as a structured
+reply and the client retries through the shared RetryPolicy — never a
+dead connection.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import obs
+from .batcher import Request, clip_emission
+from .paged import PagePool
+
+
+class Overloaded(RuntimeError):
+    """Admission refused for capacity (queue cap) — retryable; the server
+    keeps serving. ``retry_after_s`` is the server's backoff hint."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.2):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class _Rec:
+    """One request's lifecycle record (engine-internal)."""
+
+    __slots__ = ("rid", "prompt", "eos_id", "left", "deadline", "t_submit",
+                 "t_first", "tokens", "done", "reason", "slot", "skip",
+                 "cancelled", "collected")
+
+    def __init__(self, rid, prompt, left, eos_id, deadline, t_submit):
+        self.rid, self.prompt, self.left = rid, prompt, left
+        self.eos_id, self.deadline, self.t_submit = eos_id, deadline, t_submit
+        self.t_first: Optional[float] = None
+        self.tokens: List[int] = []
+        self.done = False
+        self.reason = ""
+        self.slot: Optional[int] = None
+        self.skip = 0              # segment tokens already delivered early
+        self.cancelled = False
+        self.collected = False     # a poll has observed done=True
+
+
+class ServingEngine:
+    """Continuous-batching scheduler over the paged pool with an async
+    request surface. ``start()`` spawns the scheduler thread; in-process
+    tests may instead drive :meth:`step` directly (deterministic)."""
+
+    def __init__(self, model, params, *, slots: int = 8, segment: int = 32,
+                 page_block: int = 64, pages: Optional[int] = None,
+                 cache_bucket: int = 256,
+                 prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
+                 kv_dtype: Optional[str] = None, queue_cap: int = 64,
+                 default_timeout_s: Optional[float] = None,
+                 clock=time.monotonic):
+        self.pool = PagePool(model, params, slots=slots, segment=segment,
+                             page_block=page_block, pages=pages,
+                             cache_bucket=cache_bucket,
+                             prompt_buckets=prompt_buckets,
+                             kv_dtype=kv_dtype)
+        self.model = model
+        self.queue_cap = queue_cap
+        self.default_timeout_s = default_timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._queue: List[_Rec] = []
+        self._live: Dict[int, _Rec] = {}      # slot -> record
+        self._recs: Dict[int, _Rec] = {}      # rid -> record (incl. done)
+        self._done_order: List[int] = []      # finished rids, oldest first
+        self._next_rid = 0
+        self._stop = False
+        self._failed: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- client surface (any thread) ---------------------------------------
+    def submit(self, prompt, max_new: int, *, eos_id: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> int:
+        """Queue one request; returns its rid. Raises ValueError for a
+        malformed/unservable request (structured at submit time — the
+        validation-hardening contract) and :class:`Overloaded` when the
+        queue cap is reached (backpressure)."""
+        r = Request(-1, np.asarray(prompt), int(max_new), eos_id)
+        self.pool.validate(r)                  # mutates r.prompt to int32
+        left = self.pool.effective_budget(r.prompt.size, r.max_new)
+        timeout = timeout_s if timeout_s is not None else \
+            self.default_timeout_s
+        now = self._clock()
+        deadline = None if timeout is None else now + float(timeout)
+        with self._lock:
+            if self._failed is not None:
+                raise RuntimeError(
+                    f"serving engine failed and stopped: {self._failed}")
+            if len(self._queue) >= self.queue_cap:
+                obs.count("serving.rejected_total", reason="overloaded")
+                raise Overloaded(
+                    f"queue full ({self.queue_cap} waiting); retry later")
+            rid = self._next_rid
+            self._next_rid += 1
+            rec = _Rec(rid, r.prompt, left, eos_id, deadline, now)
+            self._recs[rid] = rec
+            self._queue.append(rec)
+            obs.gauge_set("serving.queue_depth", len(self._queue))
+            self._wake.notify_all()
+            return rid
+
+    def poll(self, rid: int, cursor: int = 0):
+        """Tokens generated so far from ``cursor`` on: returns
+        (tokens list, done, reason). Raises KeyError for an unknown rid.
+        A poll that observes done marks the result COLLECTED — only
+        collected records are eligible for the done-record purge, so a
+        finished result is never dropped before its client has seen it."""
+        with self._lock:
+            rec = self._recs[rid]
+            if rec.done:
+                rec.collected = True
+            return list(rec.tokens[cursor:]), rec.done, rec.reason
+
+    def pending_results(self) -> int:
+        """Finished results no poll has collected yet — the daemon's drain
+        signal (live/queued work is a separate, earlier drain phase)."""
+        with self._lock:
+            return sum(1 for rid in self._done_order
+                       if rid in self._recs
+                       and not self._recs[rid].collected)
+
+    def cancel(self, rid: int) -> bool:
+        """Request cancellation; True if the request was still running (or
+        queued). A live slot's pages free at the next segment boundary."""
+        with self._lock:
+            rec = self._recs.get(rid)
+            if rec is None or rec.done:
+                return False
+            rec.cancelled = True
+            if rec.slot is None and rec in self._queue:
+                self._queue.remove(rec)
+                self._finalize_locked(rec, "cancelled")
+            self._wake.notify_all()
+            return True
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            live = len(self._live)
+            queued = len(self._queue)
+        pool = self.pool
+        return {"queue_depth": queued, "slots_live": live,
+                "slots_total": pool.n_slots,
+                "pages_used": pool.pages_used,
+                "pages_reserved": pool.reserved,
+                "pages_total": pool.capacity_pages,
+                "page_block": pool.bs,
+                "peak_pages_used": pool.peak_pages_used}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._stop and not self._queue and not self._live:
+                    self._wake.wait(timeout=1.0)
+                if self._stop:
+                    return
+            try:
+                self.step()
+            except Exception as e:   # a dead scheduler must not look alive
+                self._fail_all(e)
+                return
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """A dispatch blew up (device OOM, a bug in a jitted path). After a
+        failed donated call the pool buffers are unreliable, so don't limp:
+        finalize EVERY outstanding request with reason="error" (pollers see
+        done instead of hanging forever), refuse new submissions with the
+        cause, and stop scheduling."""
+        import traceback
+        traceback.print_exc()
+        with self._lock:
+            self._failed = f"{type(exc).__name__}: {exc}"
+            for rec in list(self._queue):
+                self._finalize_locked(rec, "error")
+            self._queue.clear()
+            for slot, rec in list(self._live.items()):
+                self._release_locked(rec, "error")
+            self._set_gauges_locked()
+
+    # -- the scheduler (scheduler thread only) -----------------------------
+    def step(self) -> None:
+        """One scheduling iteration: reap -> admit/prefill -> decode."""
+        self._reap()
+        self.admit_prefill()
+        if self._live:
+            self.decode_segment()
+
+    def _reap(self) -> None:
+        """Honor cancels and deadlines at the segment boundary: queued
+        victims just finalize; live victims free their slot AND pages
+        immediately — mid-flight cancel is a first-class path."""
+        now = self._clock()
+        with self._lock:
+            for rec in list(self._queue):
+                if rec.cancelled or (rec.deadline is not None
+                                     and now >= rec.deadline):
+                    self._queue.remove(rec)
+                    self._finalize_locked(
+                        rec, "cancelled" if rec.cancelled else "timeout")
+            for slot, rec in list(self._live.items()):
+                if rec.cancelled or (rec.deadline is not None
+                                     and now >= rec.deadline):
+                    self._release_locked(
+                        rec, "cancelled" if rec.cancelled else "timeout")
+            self._set_gauges_locked()
+
+    def admit_prefill(self) -> int:
+        """Phase 1: move queued requests into free slots while the page
+        budget holds (FIFO — arrival order is the latency contract a
+        service owes its callers), run the batched ragged prefill, and
+        emit each admission's first token (TTFT stops here). Returns the
+        number admitted."""
+        with self._lock:
+            group, members, pending = [], [], 0
+            busy = set(self._live)
+            for slot in range(self.pool.n_slots):
+                if slot in busy or not self._queue:
+                    continue
+                rec = self._queue[0]
+                need = self.pool.required_pages(rec.prompt.size, rec.left)
+                if not self.pool.fits(need, pending):
+                    break               # pages free at segment boundaries
+                pending += need
+                self._queue.pop(0)
+                rec.slot = slot
+                self._live[slot] = rec
+                busy.add(slot)
+                group.append((slot, rec.prompt, rec.left))
+                members.append(rec)
+        if not group:
+            return 0
+        with obs.span("serving.prefill", batch=len(group)):
+            first = self.pool.admit(group)      # device work, lock released
+        now = self._clock()
+        with self._lock:
+            for rec in members:
+                # a cancel landing during the prefill only sets the flag
+                # (this thread owns finalization); the next _reap honors it
+                rec.t_first = now
+                obs.observe("serving.ttft_seconds", now - rec.t_submit)
+                tok = first[rec.slot]
+                if rec.eos_id is not None and tok == rec.eos_id:
+                    self._release_locked(rec, "eos")
+                    continue
+                rec.tokens.append(tok)
+                obs.count("decode.tokens_total", route="serve")
+                rec.left -= 1
+                rec.skip = 1        # the next segment re-emits this token
+                if rec.left <= 0:
+                    self._release_locked(rec, "length")
+            self._set_gauges_locked()
+        return len(group)
+
+    def decode_segment(self) -> None:
+        """Phase 2: one batched decode dispatch over every live slot, then
+        collect tokens / finish requests / return pages."""
+        with self._lock:
+            live = sorted(self._live)
+        if not live:
+            return
+        with obs.span("serving.segment", live=len(live)):
+            block = self.pool.run_segment(live)  # device work, lock released
+        now = self._clock()
+        with self._lock:
+            for slot in live:
+                rec = self._live.get(slot)
+                if rec is None or rec.done:
+                    continue
+                usable = block[slot, rec.skip:]
+                rec.skip = 0
+                take, done, reason = clip_emission(usable, rec.left,
+                                                   rec.eos_id)
+                rec.tokens.extend(int(t) for t in take)
+                obs.count("decode.tokens_total", len(take), route="serve")
+                rec.left -= len(take)
+                if done:
+                    self._release_locked(rec, reason)
+            self._set_gauges_locked()
+
+    # -- internals (call with _lock held) ----------------------------------
+    def _release_locked(self, rec: _Rec, reason: str) -> None:
+        if rec.slot is not None:
+            self._live.pop(rec.slot, None)
+            self.pool.free_slot(rec.slot)
+        self._finalize_locked(rec, reason)
+
+    def _finalize_locked(self, rec: _Rec, reason: str) -> None:
+        rec.done, rec.reason = True, reason
+        obs.count("serving.requests_total", outcome=reason)
+        if rec.t_first is not None and len(rec.tokens) > 1:
+            # time-per-output-token over the tokens AFTER the first (TTFT
+            # owns the first) — the SLO pair dashboards alert on
+            obs.observe("serving.tpot_seconds",
+                        (self._clock() - rec.t_first)
+                        / (len(rec.tokens) - 1))
+        self._done_order.append(rec.rid)
+        # bound the finished-record memory of a long-lived daemon without
+        # dropping results nobody has read: purge COLLECTED records first,
+        # and touch uncollected ones only past a hard cap (a client that
+        # polls a purged rid gets the same KeyError an unknown rid does)
+        cap = max(4 * self.queue_cap, 256)
+        while len(self._done_order) > cap:
+            victim = next((rid for rid in self._done_order
+                           if rid not in self._recs
+                           or self._recs[rid].collected), None)
+            if victim is None:
+                if len(self._done_order) <= 4 * cap:
+                    break
+                victim = self._done_order[0]
+            self._done_order.remove(victim)
+            self._recs.pop(victim, None)
+
+    def _set_gauges_locked(self) -> None:
+        pool = self.pool
+        obs.gauge_set("serving.queue_depth", len(self._queue))
+        obs.gauge_set("serving.slots_live", len(self._live))
+        obs.gauge_set("serving.pages_used", pool.pages_used)
+        obs.gauge_set("serving.pages_reserved", pool.reserved)
+        used = pool.pages_used * pool.bs
+        obs.gauge_set("serving.page_occupancy",
+                      pool.live_tokens(list(self._live)) / used
+                      if used else 0.0)

@@ -1,0 +1,392 @@
+"""Paged KV-cache — the serving plane's block-pool memory manager.
+
+The pinned batcher (batcher.py) gives every slot a max_len-padded cache row:
+a 9-token request in a 1024-position pool pins 1024 rows of HBM for its
+whole life. Here the cache is a shared POOL of fixed-size pages
+(``page_block`` positions each, vLLM-style) plus a per-slot block table
+naming which pages hold positions ``j*bs .. (j+1)*bs-1`` — HBM holds live
+tokens instead of padding, mixed-length sessions share one pool, pages
+allocate as positions grow, and a finished/cancelled request returns its
+pages to the free list immediately.
+
+Invariants the exactness contract rides on:
+
+* live slots never share a page (allocation pops unique pages);
+* page 0 is the reserved NULL page: padded block-table entries and
+  drained-slot writes land there, and no live read is ever unmasked into
+  it (assembled position ``j*bs + r`` of a padded entry is > ``pos``);
+* admission RESERVES each request's worst-case page count up front
+  (prompt + capped budget + one segment of overshoot), so a live slot can
+  never fail a mid-flight allocation — backpressure happens at admission,
+  not in the decode loop;
+* the paged read (ops/pallas_kernels.paged_decode_attention) shares the
+  dense-row masked-softmax formulation, so greedy tokens are bit-equal to
+  the pinned pool and to solo decode (tests/test_serving_paged.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..core.lod import bucket_length
+from .batcher import Request, clip_emission, validate_request
+
+
+class PagePool:
+    """Device page pools + host page accounting + the jitted admit/segment
+    programs. Compile surface is bounded exactly like the pinned batcher:
+    one admission program per prompt-pad bucket, one segment program per
+    cache-read bucket (in pages)."""
+
+    def __init__(self, model, params, *, slots: int, segment: int = 32,
+                 page_block: int = 64, pages: Optional[int] = None,
+                 cache_bucket: int = 256,
+                 prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
+                 kv_dtype: Optional[str] = None):
+        if model.max_len % page_block:
+            raise ValueError(f"page_block {page_block} must divide "
+                             f"max_len {model.max_len}")
+        if cache_bucket % page_block:
+            raise ValueError(f"cache_bucket {cache_bucket} must be a "
+                             f"multiple of page_block {page_block}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r}")
+        self.model, self.params = model, params
+        self.n_slots, self.segment = slots, segment
+        self.bs = page_block
+        self.cache_bucket = cache_bucket
+        self.prompt_buckets = prompt_buckets
+        self.kv_dtype = kv_dtype
+        self.nb_max = model.max_len // page_block
+        # pool sizing: default worst case (every slot at max_len) + null
+        # page — callers shrink it for the residency win and let admission
+        # control queue what no longer fits
+        self.pages = (slots * self.nb_max + 1) if pages is None else pages
+        if self.pages < 2:
+            raise ValueError("pages must be >= 2 (null page + one live)")
+        self.capacity_pages = self.pages - 1
+        self.capacity_tokens = self.capacity_pages * self.bs
+
+        H = model.blocks[0].n_heads
+        Dh = model.blocks[0].d_head
+        dt = jnp.int8 if kv_dtype == "int8" else model._compute_dtype(params)
+        pools = {}
+        for i in range(len(model.blocks)):
+            pools[f"k{i}"] = jnp.zeros((self.pages, self.bs, H, Dh), dt)
+            pools[f"v{i}"] = jnp.zeros((self.pages, self.bs, H, Dh), dt)
+            if kv_dtype == "int8":
+                # scale 1.0 everywhere so dequant of (masked) null/garbage
+                # rows stays finite — the prefill padded-scale convention
+                pools[f"k{i}_scale"] = jnp.ones((self.pages, self.bs, H),
+                                                jnp.float32)
+                pools[f"v{i}_scale"] = jnp.ones((self.pages, self.bs, H),
+                                                jnp.float32)
+        self.pools = pools
+        self._row_bytes = H * (Dh + 4 if kv_dtype == "int8"
+                               else Dh * jnp.dtype(dt).itemsize)
+
+        # host accounting
+        self.free: List[int] = list(range(self.pages - 1, 0, -1))
+        self.tables = np.zeros((slots, self.nb_max), np.int32)
+        self.pos = np.zeros((slots,), np.int64)
+        self.cur = np.zeros((slots,), np.int32)
+        self.slot_pages: List[List[int]] = [[] for _ in range(slots)]
+        self.slot_reserve = np.zeros((slots,), np.int64)
+        self.reserved = 0
+        self.peak_pages_used = 0
+        # roofline/occupancy tallies (plain host ints — always on, the
+        # bench rows read them without an obs session)
+        self.segments_total = 0
+        self.read_bytes_total = 0
+        self.occupancy_num = 0      # live tokens, summed per segment
+        self.occupancy_den = 0      # allocated page capacity, ditto
+        self._admit_fns = {}        # (tpad, nbp) -> jitted admission
+        self._seg_fns = {}          # nb -> jitted segment scan
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def pages_used(self) -> int:
+        return self.capacity_pages - len(self.free)
+
+    def reset_tallies(self) -> None:
+        """Zero the always-on measurement tallies (peak pages, segment and
+        byte counts, occupancy sums) — benches call this between a warm-up
+        pass and the measured pass so warm-up traffic never leaks into the
+        reported row."""
+        self.peak_pages_used = 0
+        self.segments_total = 0
+        self.read_bytes_total = 0
+        self.occupancy_num = 0
+        self.occupancy_den = 0
+
+    def required_pages(self, plen: int, left: int) -> int:
+        """Worst-case pages a (prompt, capped budget) request can touch:
+        positions up to plen + left - 1 live, plus up to one segment of
+        discarded overshoot in its final dispatch, all capped at max_len
+        (overshoot past max_len clamps into already-owned pages)."""
+        hi = min(plen + left + self.segment - 1, self.model.max_len)
+        return -(-hi // self.bs)
+
+    def fits(self, need_pages: int, pending: int = 0) -> bool:
+        """Can a request needing ``need_pages`` be admitted? ``pending`` is
+        the page count the CURRENT admission wave has already claimed:
+        ``reserved`` only updates inside :meth:`admit`, so a wave checking
+        each request against the pre-wave value alone would over-commit
+        the pool and exhaust the free list mid-decode — exactly the
+        failure reservations exist to prevent."""
+        return self.reserved + pending + need_pages <= self.capacity_pages
+
+    def effective_budget(self, prompt_len: int, max_new: int) -> int:
+        """The max_len-capped token budget a (prompt, max_new) can hold."""
+        return min(max_new, self.model.max_len - prompt_len)
+
+    def validate(self, r: Request) -> int:
+        """Submit-time validation; returns the request's worst-case page
+        need. Raises ValueError for malformed requests AND for requests no
+        empty pool could ever hold (the page-budget check)."""
+        validate_request(r, self.model)
+        need = self.required_pages(
+            r.prompt.size, self.effective_budget(r.prompt.size, r.max_new))
+        if need > self.capacity_pages:
+            who = f"request {r.rid}" if r.rid >= 0 else "request"
+            raise ValueError(
+                f"{who}: needs {need} pages (prompt "
+                f"{r.prompt.size} + budget "
+                f"{self.effective_budget(r.prompt.size, r.max_new)} at "
+                f"page_block {self.bs}) but the pool holds "
+                f"{self.capacity_pages}; shrink max_new or grow pages")
+        return need
+
+    def _alloc(self) -> int:
+        if not self.free:       # reservation accounting makes this a bug
+            raise RuntimeError("page pool exhausted past its reservations")
+        page = self.free.pop()
+        self.peak_pages_used = max(self.peak_pages_used, self.pages_used)
+        return page
+
+    def _ensure(self, slot: int, upto_pos: int) -> None:
+        """Grow ``slot``'s table to cover positions < upto_pos."""
+        need = -(-min(upto_pos, self.model.max_len) // self.bs)
+        pages = self.slot_pages[slot]
+        while len(pages) < need:
+            self.tables[slot, len(pages)] = self._alloc()
+            pages.append(int(self.tables[slot, len(pages)]))
+
+    def free_slot(self, slot: int) -> None:
+        """Return every page immediately and park the slot: table -> null
+        page, pos -> 0, so its idle decode writes/reads only ever touch
+        page 0 (no park_idle dance — pos is host-owned here)."""
+        self.free.extend(self.slot_pages[slot])
+        self.slot_pages[slot] = []
+        self.reserved -= int(self.slot_reserve[slot])
+        self.slot_reserve[slot] = 0
+        self.tables[slot, :] = 0
+        self.pos[slot] = 0
+
+    # -- jitted programs ---------------------------------------------------
+    def _admit_fn(self, tpad: int, nbp: int):
+        fn = self._admit_fns.get((tpad, nbp))
+        if fn is None:
+            model, kv_dtype, bs = self.model, self.kv_dtype, self.bs
+            tpp = nbp * bs
+
+            def admit(params, pools, prompts, lens, pages):
+                # pad_to=tpp: the transient cell holds prompt-bucket rows,
+                # not a max_len-padded (pinned-pool-sized) cache — the
+                # admission HBM spike stays proportional to the prompts
+                cell, last = model.prefill(params, prompts, lens,
+                                           kv_dtype=kv_dtype,
+                                           pad_to=tpp)
+                first = jnp.argmax(last, axis=-1).astype(prompts.dtype)
+                out = {}
+                for i in range(len(model.blocks)):
+                    for nm in (f"k{i}", f"v{i}"):
+                        rows = cell[nm][:, :tpp].reshape(
+                            (prompts.shape[0], nbp, bs) + cell[nm].shape[2:])
+                        out[nm] = pools[nm].at[pages].set(
+                            rows.astype(pools[nm].dtype))
+                    if kv_dtype == "int8":
+                        for nm in (f"k{i}_scale", f"v{i}_scale"):
+                            rows = cell[nm][:, :tpp].reshape(
+                                prompts.shape[0], nbp, bs, -1)
+                            out[nm] = pools[nm].at[pages].set(rows)
+                return out, first
+            fn = jax.jit(admit, donate_argnums=(1,))
+            self._admit_fns[(tpad, nbp)] = fn
+        return fn
+
+    def _seg_fn(self, nb: int):
+        fn = self._seg_fns.get(nb)
+        if fn is None:
+            model, segment = self.model, self.segment
+
+            def seg(params, pools, tables, pos, cur):
+                cell = dict(pools, pos=pos)
+
+                def body(carry, _):
+                    cell, cur = carry
+                    logits, cell = model.decode_step_paged(params, cell,
+                                                           cur, tables)
+                    nxt = jnp.argmax(logits, axis=-1).astype(cur.dtype)
+                    return (cell, nxt), cur
+                (cell, cur), toks = jax.lax.scan(body, (cell, cur), None,
+                                                 length=segment)
+                pools_out = {k: v for k, v in cell.items() if k != "pos"}
+                return pools_out, cur, jnp.moveaxis(toks, 0, 1)
+            fn = jax.jit(seg, donate_argnums=(1,))
+            self._seg_fns[nb] = fn
+        return fn
+
+    # -- the two scheduler-visible operations ------------------------------
+    def admit(self, group: List[Tuple[int, np.ndarray, int]]) -> Dict[int, int]:
+        """Prefill + page placement for ``group`` = [(slot, prompt, left)]
+        (left = the CAPPED token budget). Reserves worst-case pages,
+        allocates the prompt's pages, runs ONE full-pool-width jitted
+        prefill-and-scatter, and returns {slot: first generated token}.
+        Caller has checked :meth:`fits` per request."""
+        if not group:
+            return {}
+        for slot, prompt, left in group:
+            need = self.required_pages(prompt.size, left)
+            self.slot_reserve[slot] = need
+            self.reserved += need
+            self._ensure(slot, prompt.size)
+        tpad = bucket_length(max(p.size for _, p, _ in group),
+                             self.prompt_buckets)
+        tpad = min(tpad, self.model.max_len - 1)
+        nbp = -(-tpad // self.bs)
+        prompts = np.zeros((self.n_slots, tpad), np.int32)
+        lens = np.zeros((self.n_slots,), np.int32)
+        pages = np.zeros((self.n_slots, nbp), np.int32)
+        for slot, prompt, _ in group:
+            prompts[slot, :prompt.size] = prompt
+            lens[slot] = prompt.size
+            n = min(nbp, len(self.slot_pages[slot]))
+            pages[slot, :n] = self.slot_pages[slot][:n]
+        self.pools, first = self._admit_fn(tpad, nbp)(
+            self.params, self.pools, jnp.asarray(prompts), jnp.asarray(lens),
+            jnp.asarray(pages))
+        first = np.asarray(first)
+        out = {}
+        for slot, prompt, _ in group:
+            self.pos[slot] = prompt.size
+            self.cur[slot] = int(first[slot])
+            out[slot] = int(first[slot])
+        return out
+
+    def run_segment(self, live: Sequence[int]) -> np.ndarray:
+        """One decode segment across the whole pool; returns the emitted
+        token block [slots, segment] (drained slots' rows are garbage).
+        Grows live slots' tables first, so no mid-scan allocation exists."""
+        for i in live:
+            self._ensure(i, int(self.pos[i]) + self.segment)
+        max_pos = max((int(self.pos[i]) for i in live), default=0)
+        cache_len = min(
+            -(-(max_pos + self.segment + 1) // self.cache_bucket)
+            * self.cache_bucket, self.model.max_len)
+        nb = cache_len // self.bs
+        self.pools, cur, toks = self._seg_fn(nb)(
+            self.params, self.pools, jnp.asarray(self.tables[:, :nb]),
+            jnp.asarray(self.pos, jnp.int32).clip(0, self.model.max_len - 1),
+            jnp.asarray(self.cur))
+        obs.count("decode.dispatches_total", route="serve_segment")
+        read = (2 * self.n_slots * nb * self.bs * self._row_bytes
+                * len(self.model.blocks) * self.segment)
+        obs.count("kernels.bytes_total", read,
+                  kernel="paged_decode_attention")
+        self.segments_total += 1
+        self.read_bytes_total += read
+        self.occupancy_num += self.live_tokens(live)
+        self.occupancy_den += max(self.pages_used, 1) * self.bs
+        self.pos += self.segment
+        self.cur = np.array(cur)    # writable copy: admit() merges into it
+        return np.asarray(toks)                       # [slots, segment]
+
+    def live_tokens(self, live: Sequence[int]) -> int:
+        """Cache rows written across ``live`` slots (occupancy numerator).
+        Rows 0..pos-1 exist (each step writes AT pos then advances), so the
+        count is pos, capped at max_len where overshoot writes clamp."""
+        return int(sum(min(int(self.pos[i]), self.model.max_len)
+                       for i in live))
+
+
+class PagedBatcher:
+    """Continuous batching over the paged pool — same serve() contract as
+    :class:`~paddle_tpu.serving.batcher.ContinuousBatcher` (greedy outputs
+    token-for-token equal to solo decode; schedule is a throughput knob
+    only), with cache residency proportional to LIVE tokens instead of
+    slots * max_len."""
+
+    def __init__(self, model, params, *, slots: int = 8, segment: int = 32,
+                 page_block: int = 64, pages: Optional[int] = None,
+                 cache_bucket: int = 256,
+                 prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
+                 schedule: str = "longest_first",
+                 kv_dtype: Optional[str] = None):
+        if schedule not in ("longest_first", "fifo"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        self.model, self.params = model, params
+        self.schedule = schedule
+        self.pool = PagePool(model, params, slots=slots, segment=segment,
+                             page_block=page_block, pages=pages,
+                             cache_bucket=cache_bucket,
+                             prompt_buckets=prompt_buckets,
+                             kv_dtype=kv_dtype)
+
+    def _effective_budget(self, r: Request) -> int:
+        return self.pool.effective_budget(r.prompt.size, r.max_new)
+
+    def validate(self, r: Request) -> int:
+        return self.pool.validate(r)
+
+    def serve(self, requests: Sequence[Request]) -> Dict[int, np.ndarray]:
+        pool = self.pool
+        queue = list(requests)
+        for r in queue:
+            self.validate(r)
+        if self.schedule == "longest_first":
+            queue.sort(key=lambda r: -self._effective_budget(r))
+        slots: List[Optional[Request]] = [None] * pool.n_slots
+        left = np.zeros((pool.n_slots,), np.int64)
+        outs: List[List[int]] = [[] for _ in range(pool.n_slots)]
+        results: Dict[int, np.ndarray] = {}
+
+        def admit():
+            group, pending = [], 0
+            for i in range(pool.n_slots):
+                if slots[i] is not None or not queue:
+                    continue
+                need = pool.required_pages(
+                    queue[0].prompt.size, self._effective_budget(queue[0]))
+                if not pool.fits(need, pending):
+                    break          # head-of-line: wait for pages to free
+                pending += need
+                r = queue.pop(0)
+                slots[i] = r
+                left[i] = self._effective_budget(r)
+                outs[i] = []
+                group.append((i, r.prompt, int(left[i])))
+            pool.admit(group)
+
+        admit()
+        while any(s is not None for s in slots):
+            live = [i for i, s in enumerate(slots) if s is not None]
+            block = pool.run_segment(live)
+            for i in live:
+                r = slots[i]
+                take, done, _ = clip_emission(block[i], int(left[i]),
+                                              r.eos_id)
+                outs[i].extend(int(t) for t in take)
+                obs.count("decode.tokens_total", len(take), route="serve")
+                left[i] -= len(take)
+                if done:
+                    results[r.rid] = np.asarray(outs[i], np.int32)
+                    slots[i] = None
+                    pool.free_slot(i)   # pages return BEFORE next admit
+            admit()
+        return results

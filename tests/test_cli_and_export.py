@@ -48,6 +48,38 @@ def test_cli_version():
     assert "paddle_tpu" in out
 
 
+def test_cli_serve_bad_flags_structured_error():
+    """`serve` answers an invalid flag combination (page_block off the
+    max_len grid) with the same structured stderr + exit 2 as a bad
+    --config, not a construction traceback."""
+    r = subprocess.run([sys.executable, "-m", "paddle_tpu", "serve",
+                        "--vocab", "67", "--d_model", "16",
+                        "--n_heads", "2", "--n_layers", "1",
+                        "--max_len", "128", "--page_block", "48"],
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 2
+    assert "serve: page_block 48" in r.stderr
+    assert "Traceback" not in r.stderr
+    # a bind failure (port already in use) gets the same structured
+    # refusal, not a traceback with a half-started engine behind it
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)
+    try:
+        r = subprocess.run([sys.executable, "-m", "paddle_tpu", "serve",
+                            "--vocab", "67", "--d_model", "16",
+                            "--n_heads", "2", "--n_layers", "1",
+                            "--max_len", "128",
+                            "--port", str(s.getsockname()[1])],
+                           capture_output=True, text=True, timeout=240)
+    finally:
+        s.close()
+    assert r.returncode == 2
+    assert "serve: cannot bind" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
 def test_lint_bench_rows_schema(tmp_path):
     """`paddle_tpu lint --bench-rows` (no --config needed): well-formed
     rows pass; a row missing its family's roofline column (mfu for
@@ -56,13 +88,19 @@ def test_lint_bench_rows_schema(tmp_path):
     import json
 
     good = tmp_path / "good.jsonl"
-    good.write_text(json.dumps(
-        {"metric": "x_train_ms_per_batch", "value": 1.0, "unit": "ms",
-         "vs_baseline": None, "mfu": 0.2}) + "\n")
+    good.write_text(
+        json.dumps({"metric": "x_train_ms_per_batch", "value": 1.0,
+                    "unit": "ms", "vs_baseline": None, "mfu": 0.2}) + "\n"
+        + json.dumps({"metric": "z_serve_daemon_tokens_per_sec",
+                      "value": 9.0, "unit": "tok/s", "vs_baseline": None,
+                      "ttft_p50_ms": 12.0, "tpot_p50_ms": 3.0}) + "\n")
     bad = tmp_path / "bad.jsonl"
-    bad.write_text(json.dumps(
-        {"metric": "y_decode_tokens_per_sec", "value": 5.0,
-         "unit": "tok/s", "vs_baseline": None}) + "\n")
+    bad.write_text(
+        json.dumps({"metric": "y_decode_tokens_per_sec", "value": 5.0,
+                    "unit": "tok/s", "vs_baseline": None}) + "\n"
+        + json.dumps({"metric": "z_serve_daemon_tokens_per_sec",
+                      "value": 9.0, "unit": "tok/s",
+                      "vs_baseline": None}) + "\n")
     out = _run("lint", "--bench-rows", str(good))
     assert "0 problem(s)" in out
     r = subprocess.run([sys.executable, "-m", "paddle_tpu", "lint",
@@ -70,6 +108,9 @@ def test_lint_bench_rows_schema(tmp_path):
                        capture_output=True, text=True, timeout=240)
     assert r.returncode == 1
     assert "B001" in r.stdout and "hbm_bw_util" in r.stdout
+    # the _serve_ family rule (PR 8): a serving row without its SLO pair
+    # (ttft_p50_ms / tpot_p50_ms) is rejected
+    assert "ttft_p50_ms" in r.stdout and "tpot_p50_ms" in r.stdout
 
 
 def test_cli_train_test_time_dump(config_file, tmp_path):

@@ -1,4 +1,4 @@
-"""Continuous batching (paddle_tpu/serving.py): requests with MIXED prompt
+"""Continuous batching (paddle_tpu/serving/batcher.py): requests with MIXED prompt
 and generation lengths share a fixed slot pool; each request's greedy
 continuation must be token-for-token identical to decoding it ALONE through
 generate_cached — in-flight batching must not change anyone's tokens."""

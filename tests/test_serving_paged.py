@@ -1,0 +1,348 @@
+"""Paged KV-cache serving (paddle_tpu/serving/paged.py + engine.py): the
+cache is a shared page pool + per-request block tables instead of per-slot
+max_len rows. Contracts under test:
+
+* EXACTNESS — greedy tokens through the paged pool are bit-equal to solo
+  decode (generate_cached / generate_fused at the same kv_dtype), mixed
+  lengths, incl. int8 KV;
+* RECLAMATION — finished/cancelled/timed-out requests return their pages
+  immediately and the freed slot re-admits queued work;
+* the paged read's kernel and dense routes share one formulation
+  (ops/pallas_kernels.paged_decode_attention);
+* validation hardening — malformed requests die structured at submit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.ops import pallas_kernels as pk
+from paddle_tpu.serving import (ContinuousBatcher, Overloaded, PagedBatcher,
+                                Request, ServingEngine)
+
+VOCAB, D, H, L, MAX_LEN = 97, 32, 4, 2, 128
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TransformerLM(VOCAB, d_model=D, n_heads=H, n_layers=L,
+                          max_len=MAX_LEN)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _solo(model, params, prompt, steps, _bucket=12):
+    """Solo-decode reference, steps padded onto shared scan compiles
+    (greedy is prefix-stable; same trick as test_serving.py)."""
+    padded = min(-(-steps // _bucket) * _bucket,
+                 model.max_len - len(prompt))
+    out = model.generate_cached(params, jnp.asarray(prompt[None]),
+                                steps=padded)
+    return np.asarray(out)[0, len(prompt):len(prompt) + steps]
+
+
+def test_paged_matches_solo_decode(model_and_params):
+    """The tentpole contract: mixed prompt/gen lengths through the paged
+    pool, every request's greedy continuation token-for-token equal to
+    decoding it alone — and every page back in the free list after."""
+    model, params = model_and_params
+    rs = np.random.RandomState(3)
+    reqs = []
+    for rid in range(9):          # more requests than slots -> churn
+        plen = int(rs.randint(3, 40))
+        gen = int(rs.randint(1, 37))
+        reqs.append(Request(rid, rs.randint(0, VOCAB, plen), gen))
+    b = PagedBatcher(model, params, slots=4, segment=8, page_block=8,
+                     cache_bucket=32)
+    got = b.serve(reqs)
+    assert sorted(got) == [r.rid for r in reqs]
+    for r in reqs:
+        want = _solo(model, params, r.prompt, r.max_new)
+        np.testing.assert_array_equal(
+            got[r.rid], want,
+            err_msg=f"request {r.rid} (prompt {len(r.prompt)}, gen "
+                    f"{r.max_new}) diverged under the paged cache")
+    assert b.pool.pages_used == 0 and b.pool.reserved == 0
+    assert 0 < b.pool.peak_pages_used <= b.pool.capacity_pages
+
+
+def test_paged_matches_pinned_batcher(model_and_params):
+    """Paged and pinned pools run the same masked-softmax read: identical
+    outputs on an identical workload (the memory manager is invisible)."""
+    model, params = model_and_params
+    rs = np.random.RandomState(9)
+    reqs = [Request(i, rs.randint(0, VOCAB, int(rs.randint(3, 30))),
+                    int(rs.randint(1, 25))) for i in range(5)]
+    pinned = ContinuousBatcher(model, params, slots=3, segment=8,
+                               cache_bucket=32, schedule="fifo").serve(
+        [Request(r.rid, r.prompt.copy(), r.max_new) for r in reqs])
+    paged = PagedBatcher(model, params, slots=3, segment=8, page_block=8,
+                         cache_bucket=32, schedule="fifo").serve(
+        [Request(r.rid, r.prompt.copy(), r.max_new) for r in reqs])
+    for r in reqs:
+        np.testing.assert_array_equal(paged[r.rid], pinned[r.rid])
+
+
+def test_paged_int8_matches_solo_int8(model_and_params):
+    """Quantized-KV exactness carries over: int8 paged tokens equal SOLO
+    decode at the same kv_dtype (batching and paging add no error)."""
+    model, params = model_and_params
+    rs = np.random.RandomState(13)
+    reqs = [Request(rid, rs.randint(0, VOCAB, int(rs.randint(3, 30))),
+                    int(rs.randint(1, 25))) for rid in range(3)]
+    b = PagedBatcher(model, params, slots=2, segment=8, page_block=8,
+                     cache_bucket=32, kv_dtype="int8")
+    got = b.serve(reqs)
+    for r in reqs:
+        want = np.asarray(model.generate_fused(
+            params, jnp.asarray(r.prompt[None]), steps=r.max_new,
+            kv_dtype="int8"))[0, len(r.prompt):]
+        np.testing.assert_array_equal(got[r.rid], want,
+                                      err_msg=f"request {r.rid}")
+
+
+def test_paged_eos_and_small_pool_queueing(model_and_params):
+    """EOS truncation works through pages, and a pool too small for every
+    request at once queues the tail (admission control) without changing
+    anyone's tokens."""
+    model, params = model_and_params
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(0, VOCAB, 9)
+    full = _solo(model, params, prompt, 24)
+    eos = int(full[7])
+    # pool sized so ~one request fits at a time: (9 + 24 + 8 - 1) / 8 -> 5
+    # pages; 8 usable pages hold one live request + change
+    b = PagedBatcher(model, params, slots=3, segment=8, page_block=8,
+                     pages=9, cache_bucket=32)
+    reqs = [Request(0, prompt, 24, eos_id=eos),
+            Request(1, rs.randint(0, VOCAB, 7), 11),
+            Request(2, rs.randint(0, VOCAB, 5), 9)]
+    got = b.serve(reqs)
+    first_hit = int(np.nonzero(full == eos)[0][0])
+    np.testing.assert_array_equal(got[0], full[:first_hit])
+    for r in reqs[1:]:
+        np.testing.assert_array_equal(
+            got[r.rid], _solo(model, params, r.prompt, r.max_new))
+    assert b.pool.pages_used == 0
+
+
+def test_admission_wave_cannot_overcommit_pool(model_and_params):
+    """Regression: fits() must count pages the SAME admission wave already
+    claimed. Two free slots + two requests each reserving 5 pages against
+    an 8-page pool used to both pass fits(5) (pool.reserved only updates
+    inside pool.admit), then exhaust the free list mid-decode with
+    'page pool exhausted past its reservations'. Now the second queues,
+    both finish exactly, and the reservation invariant holds throughout."""
+    model, params = model_and_params
+    rs = np.random.RandomState(41)
+    reqs = [Request(0, rs.randint(0, VOCAB, 8), 25),
+            Request(1, rs.randint(0, VOCAB, 8), 25)]   # 5 pages each
+    b = PagedBatcher(model, params, slots=2, segment=8, page_block=8,
+                     pages=9, cache_bucket=32)         # capacity 8 < 2*5
+    got = b.serve(reqs)
+    for r in reqs:
+        np.testing.assert_array_equal(
+            got[r.rid], _solo(model, params, r.prompt, r.max_new))
+    assert b.pool.pages_used == 0
+    assert b.pool.peak_pages_used <= b.pool.capacity_pages
+    # engine path shares the fix
+    eng = ServingEngine(model, params, slots=2, segment=8, page_block=8,
+                        pages=9, cache_bucket=32, queue_cap=4)
+    rids = [eng.submit(r.prompt, r.max_new) for r in reqs]
+    eng.step()
+    assert eng.pool.reserved <= eng.pool.capacity_pages
+    while not all(eng.poll(r)[1] for r in rids):
+        eng.step()
+        assert eng.pool.reserved <= eng.pool.capacity_pages
+    assert eng.pool.pages_used == 0
+
+
+def test_paged_attention_routes_agree(model_and_params):
+    """paged_decode_attention: the scalar-prefetch kernel (pages assembled
+    in VMEM) vs the dense gather route — same formulation, f32/int8 —
+    and the dense route is bit-equal to the dense-ROW decode_attention on
+    the gathered cache (the pinned-parity building block)."""
+    del model_and_params
+    B, Hh, Dh, bs, NB, P = 3, 4, 16, 8, 4, 14
+    rs = np.random.RandomState(0)
+    k_pool = jnp.asarray(rs.randn(P, bs, Hh, Dh), jnp.float32)
+    v_pool = jnp.asarray(rs.randn(P, bs, Hh, Dh), jnp.float32)
+    tables = jnp.asarray(np.stack(
+        [rs.choice(np.arange(1, P), NB, replace=False) for _ in range(B)]),
+        jnp.int32)
+    q = jnp.asarray(rs.randn(B, Hh, Dh), jnp.float32)
+    pos = jnp.asarray([3, 17, 30], jnp.int32)
+    dense = pk.paged_decode_attention(q, k_pool, v_pool, tables, pos,
+                                      route="dense")
+    kern = pk.paged_decode_attention(q, k_pool, v_pool, tables, pos,
+                                     route="kernel", interpret=True)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(dense),
+                               rtol=2e-6, atol=2e-6)
+    row = pk.decode_attention(q, pk.gather_pages(k_pool, tables),
+                              pk.gather_pages(v_pool, tables), pos,
+                              route="dense")
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(row))
+    k8, ks = pk.quantize_kv(k_pool)
+    v8, vs = pk.quantize_kv(v_pool)
+    d8 = pk.paged_decode_attention(q, k8, v8, tables, pos, k_scale=ks,
+                                   v_scale=vs, route="dense")
+    k8o = pk.paged_decode_attention(q, k8, v8, tables, pos, k_scale=ks,
+                                    v_scale=vs, route="kernel",
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(k8o), np.asarray(d8),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_validation_hardening(model_and_params):
+    """Malformed requests die AT SUBMIT with precise errors (not as shape
+    errors deep in prefill): max_new <= 0, empty prompt, prompt past the
+    page budget — for both batchers and the engine."""
+    model, params = model_and_params
+    b = PagedBatcher(model, params, slots=2, segment=8, page_block=8,
+                     cache_bucket=32)
+    with pytest.raises(ValueError, match="max_new"):
+        b.serve([Request(0, np.array([3, 5], np.int32), 0)])
+    with pytest.raises(ValueError, match="empty prompt"):
+        b.serve([Request(0, np.zeros((0,), np.int32), 4)])
+    pinned = ContinuousBatcher(model, params, slots=2, segment=8,
+                               cache_bucket=32)
+    with pytest.raises(ValueError, match="max_new"):
+        pinned.serve([Request(1, np.array([3], np.int32), -2)])
+    # page budget: a 6-usable-page pool (48 positions) cannot ever hold
+    # prompt 60 — rejected structured at submit, nothing queued
+    tiny = PagedBatcher(model, params, slots=2, segment=8, page_block=8,
+                        pages=7, cache_bucket=32)
+    with pytest.raises(ValueError, match="pages"):
+        tiny.serve([Request(2, np.arange(60, dtype=np.int32) % VOCAB, 4)])
+    eng = ServingEngine(model, params, slots=2, segment=8, page_block=8,
+                        cache_bucket=32, queue_cap=2)
+    with pytest.raises(ValueError, match="max_new"):
+        eng.submit(np.array([3], np.int32), 0)
+
+
+def test_engine_cancel_frees_pages_and_readmits(model_and_params):
+    """Mid-flight cancel: the request finalizes with reason=cancelled, its
+    pages return at the next segment boundary, and the freed slot admits
+    queued work — driven deterministically via engine.step()."""
+    model, params = model_and_params
+    rs = np.random.RandomState(21)
+    eng = ServingEngine(model, params, slots=1, segment=8, page_block=8,
+                        cache_bucket=32, queue_cap=4)
+    long_rid = eng.submit(rs.randint(0, VOCAB, 9), 100)
+    short_prompt = rs.randint(0, VOCAB, 7)
+    short_rid = eng.submit(short_prompt, 9)
+    eng.step()                       # admit long (slot 0) + one segment
+    toks, done, _ = eng.poll(long_rid)
+    assert toks and not done
+    used_live = eng.pool.pages_used
+    assert used_live > 0
+    assert eng.poll(short_rid)[0] == []          # still queued (1 slot)
+    assert eng.cancel(long_rid) is True
+    eng.step()                       # reap: free pages, admit the short
+    toks, done, reason = eng.poll(long_rid)
+    assert done and reason == "cancelled"
+    eng.step()
+    while not eng.poll(short_rid)[1]:
+        eng.step()
+    toks, done, reason = eng.poll(short_rid)
+    assert done and reason == "length"
+    np.testing.assert_array_equal(
+        np.asarray(toks, np.int32), _solo(model, params, short_prompt, 9))
+    assert eng.pool.pages_used == 0 and eng.pool.reserved == 0
+    # cancel of a finished request is a no-op, not an error
+    assert eng.cancel(short_rid) is False
+
+
+def test_engine_timeout_frees_pages(model_and_params):
+    """Deadlines: a queued request times out without touching the pool; a
+    LIVE request's timeout frees slot + pages (fake clock, no sleeps)."""
+    model, params = model_and_params
+    rs = np.random.RandomState(23)
+    t = [0.0]
+    eng = ServingEngine(model, params, slots=1, segment=8, page_block=8,
+                        cache_bucket=32, queue_cap=4, clock=lambda: t[0])
+    live = eng.submit(rs.randint(0, VOCAB, 9), 100, timeout_s=50.0)
+    queued = eng.submit(rs.randint(0, VOCAB, 5), 10, timeout_s=10.0)
+    eng.step()                                   # live admitted
+    assert eng.pool.pages_used > 0
+    t[0] = 20.0                                  # queued deadline passes
+    eng.step()
+    assert eng.poll(queued)[1:] == (True, "timeout")
+    t[0] = 60.0                                  # live deadline passes
+    eng.step()
+    assert eng.poll(live)[1:] == (True, "timeout")
+    assert eng.pool.pages_used == 0 and eng.pool.reserved == 0
+
+
+def test_engine_backpressure_structured(model_and_params):
+    """Queue-cap admission control raises the STRUCTURED Overloaded (with
+    a retry hint) — and the engine keeps serving afterwards."""
+    model, params = model_and_params
+    rs = np.random.RandomState(29)
+    eng = ServingEngine(model, params, slots=1, segment=8, page_block=8,
+                        cache_bucket=32, queue_cap=1)
+    first = eng.submit(rs.randint(0, VOCAB, 5), 3)   # fills the 1-deep queue
+    with pytest.raises(Overloaded) as ei:
+        eng.submit(rs.randint(0, VOCAB, 5), 3)
+    assert ei.value.retry_after_s > 0
+    while not eng.poll(first)[1]:                    # still serving after
+        eng.step()
+    second = eng.submit(rs.randint(0, VOCAB, 5), 3)  # queue drained: admits
+    while not eng.poll(second)[1]:
+        eng.step()
+    assert eng.pool.pages_used == 0
+
+
+def test_engine_dispatch_failure_fails_loudly(model_and_params):
+    """A dispatch blowing up must not leave a daemon that LOOKS alive:
+    outstanding requests finalize with reason=error (pollers see done, not
+    an infinite hang) and new submissions carry the cause."""
+    import time as _time
+    model, params = model_and_params
+    rs = np.random.RandomState(37)
+    eng = ServingEngine(model, params, slots=1, segment=8, page_block=8,
+                        cache_bucket=32, queue_cap=4)
+
+    def boom(live):
+        raise RuntimeError("synthetic device failure")
+    eng.pool.run_segment = boom
+    eng.start()
+    try:
+        rid = eng.submit(rs.randint(0, VOCAB, 5), 10)
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline and not eng.poll(rid)[1]:
+            _time.sleep(0.02)
+        toks, done, reason = eng.poll(rid)
+        assert done and reason == "error"
+        with pytest.raises(RuntimeError, match="synthetic device failure"):
+            eng.submit(rs.randint(0, VOCAB, 5), 10)
+    finally:
+        eng.stop()
+
+
+def test_engine_slo_metrics_and_gauges(model_and_params):
+    """TTFT/TPOT histograms and the queue/page gauges land in the metric
+    registry (the obs summary the acceptance criterion names)."""
+    model, params = model_and_params
+    rs = np.random.RandomState(31)
+    reg = obs.MetricsRegistry()
+    with obs.ObsSession(registry=reg).installed():
+        eng = ServingEngine(model, params, slots=2, segment=8, page_block=8,
+                            cache_bucket=32, queue_cap=8)
+        rids = [eng.submit(rs.randint(0, VOCAB, int(rs.randint(3, 20))),
+                           int(rs.randint(2, 20))) for _ in range(4)]
+        while not all(eng.poll(r)[1] for r in rids):
+            eng.step()
+    samples = reg.collect()
+    names = {s["name"] for s in samples}
+    assert "serving.ttft_seconds" in names
+    assert "serving.tpot_seconds" in names
+    assert "serving.page_occupancy" in names
+    done = [s for s in samples if s["name"] == "serving.requests_total"]
+    assert sum(s["value"] for s in done) == len(rids)
+    occ = [s["value"] for s in samples
+           if s["name"] == "serving.page_occupancy"]
+    assert all(0.0 <= v <= 1.0 for v in occ)
