@@ -82,6 +82,7 @@ def run(n_requests: int = 48, slots: int = 16, segment: int = 32) -> dict:
             "ttft_p95_ms": round(_pct(ttft, 95), 1),
             "tpot_p50_ms": round(_pct(tpot, 50), 2),
             "tpot_p95_ms": round(_pct(tpot, 95), 2),
+            "methodology": "measured",    # client-clock SLOs, real wire
             "note": "end-to-end over the native RPC plane (srv_submit/"
                     "srv_poll): paged KV-cache engine, FIFO admission, "
                     "client-measured SLOs incl. queue wait; TTFT counts "

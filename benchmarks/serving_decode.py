@@ -21,7 +21,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-HBM_GBPS = 819.0          # v5e HBM bandwidth (public spec)
 VOCAB = 50257
 D_MODEL, N_HEADS, N_LAYERS, MAX_LEN = 768, 12, 12, 1024
 PROMPT, STEPS = 128, 256
@@ -49,18 +48,25 @@ def build(batch: int):
 def _avg_step_bytes(model, params, batch: int, bucket,
                     kv_dtype=None) -> float:
     """Average HBM bytes per decode step: weights + live cache rows.
-    int8 KV reads 1 byte/element plus one f32 scale per (row, head) —
-    the quantized cache term is ~(1/2 + 4/(2*Dh)) of the bf16 one."""
+
+    The cache term resolves through the ONE registered kernel byte model
+    (obs/roofline.py, registered by ops/pallas_kernels.py) — the same
+    resolution the live ``fluid.device_bytes_total`` accounting and the
+    ``kernels.bytes_total`` dispatch counters use, so this row and the
+    live ``roofline.hbm_bw_util`` gauge can never disagree on the bytes
+    side of the formula."""
+    from paddle_tpu.obs import roofline
+
     w = _param_bytes(params)
     d_head = D_MODEL // N_HEADS
-    row_bytes = (N_HEADS * (d_head + 4) if kv_dtype == "int8"
-                 else N_HEADS * d_head * 2)
     total_cache = 0.0
     for i in range(STEPS):
         pos = PROMPT + i
         read = (MAX_LEN if bucket is None
                 else min(-(-(pos + 1) // bucket) * bucket, MAX_LEN))
-        total_cache += 2 * batch * read * row_bytes * N_LAYERS  # k + v
+        total_cache += roofline.kernel_cost(
+            "decode_attention", batch=batch, read=read, n_heads=N_HEADS,
+            d_head=d_head, layers=N_LAYERS, kv_dtype=kv_dtype, itemsize=2)
     return w + total_cache / STEPS
 
 
@@ -82,11 +88,14 @@ def run_config(batch: int, bucket=256, kv_dtype=None) -> dict:
     dt = time.perf_counter() - t0
     ms_tok = dt / STEPS * 1e3
     toks_sec = batch * STEPS / dt
+    from benchmarks.mfu import attach_hbm_bw
+
     step_bytes = _avg_step_bytes(model, p16, batch, bucket, kv_dtype)
     bw = step_bytes / (ms_tok / 1e3) / 1e9
     note = ("GPT-2-small KV-cache greedy decode; bytes/step = bf16 "
-            "weights + live cache rows (bucketed reads); util vs "
-            f"{HBM_GBPS:.0f} GB/s v5e HBM")
+            "weights + live cache rows (bucketed reads, shared kernel "
+            "byte model); util vs the chip HBM peak "
+            "(obs/roofline.PEAK_HBM_GBPS — null off-TPU)")
     row = {"metric": f"transformer_lm_decode_tokens_per_sec_bs{batch}"
                      f"_prompt{PROMPT}_gen{STEPS}"
                      + ("" if bucket is None else f"_bucket{bucket}")
@@ -96,8 +105,10 @@ def run_config(batch: int, bucket=256, kv_dtype=None) -> dict:
            "ms_per_token": round(ms_tok, 3),
            "step_bytes_mb": round(step_bytes / 1e6, 1),
            "hbm_bw_gbps": round(bw, 1),
-           "hbm_bw_util": round(bw / HBM_GBPS, 3),
            "note": note}
+    # bytes are an analytic model (Pallas cache reads are invisible to
+    # XLA), so the row is honest about it: methodology="modeled"
+    attach_hbm_bw(row, step_bytes, ms_tok / 1e3, methodology="modeled")
     if kv_dtype is not None:
         full = _avg_step_bytes(model, p16, batch, bucket, None)
         row["projected_bytes_reduction"] = round(full / step_bytes, 3)
@@ -202,6 +213,8 @@ def run_paged(n_requests: int = 128, slots: int = 64,
     got = b.serve(reqs)
     dt = time.perf_counter() - t0
     delivered = sum(len(v) for v in got.values())
+    from benchmarks.mfu import attach_hbm_bw
+
     w = _param_bytes(p16)
     total_bytes = (pool.segments_total * segment * w
                    + pool.read_bytes_total)
@@ -210,13 +223,12 @@ def run_paged(n_requests: int = 128, slots: int = 64,
                  if pool.occupancy_den else 0.0)
     pinned_rows = slots * MAX_LEN
     peak_rows = max(pool.peak_pages_used, 1) * block
-    return {"metric": f"transformer_lm_continuous_batching_paged_tokens_"
-                      f"per_sec_slots{slots}_seg{segment}_mixed32-256",
+    row = {"metric": f"transformer_lm_continuous_batching_paged_tokens_"
+                     f"per_sec_slots{slots}_seg{segment}_mixed32-256",
             "value": round(delivered / dt, 1), "unit": "tokens/sec",
             "vs_baseline": None,
             "requests": n_requests, "delivered_tokens": delivered,
             "hbm_bw_gbps": round(bw, 1),
-            "hbm_bw_util": round(bw / HBM_GBPS, 3),
             "page_occupancy": round(occupancy, 3),
             "peak_pages": pool.peak_pages_used,
             "cache_rows_pinned": pinned_rows,
@@ -230,6 +242,10 @@ def run_paged(n_requests: int = 128, slots: int = 64,
                     "pinned cache rows / paged peak rows — cache bytes "
                     "per resident token shrink by that factor, the "
                     "headroom for bigger live batches"}
+    # per-delivered-token bytes/time (ratio-invariant vs the run totals) so
+    # gbytes_per_step is comparable with run_config's per-token figure
+    return attach_hbm_bw(row, total_bytes / max(delivered, 1),
+                         dt / max(delivered, 1), methodology="modeled")
 
 
 if __name__ == "__main__":

@@ -127,6 +127,7 @@ def run(iters: int = 12, repeats: int = 2, batch: int = BATCH,
            "value": round(tokens / (ms / 1e3), 1), "unit": "tokens/sec",
            "vs_baseline": None,
            "mfu": None,           # overwritten below when peak is known
+           "methodology": "measured",   # XLA-analyzed FLOPs, real timing
            "note": note}
     peak = peak_flops_per_sec()
     if flops and peak:

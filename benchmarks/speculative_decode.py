@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.serving_decode import (HBM_GBPS, MAX_LEN, N_HEADS, N_LAYERS,
+from benchmarks.mfu import attach_hbm_bw
+from benchmarks.serving_decode import (MAX_LEN, N_HEADS, N_LAYERS,
                                        D_MODEL, PROMPT, VOCAB, build,
                                        _param_bytes)
 
@@ -53,16 +54,21 @@ def _spec_row(tag, model, p16, draft_model, draft_params, draft_kv, prompt,
     # modeled HBM bytes per EMITTED token (batch-wide tokens, consistent
     # with toks_sec): every round streams the draft's weights + cache k
     # times (k-1 proposals + the cache-fill step) and the target's weights
-    # + cache once (the verify), then yields batch*(1 + accepted) tokens
+    # + cache once (the verify), then yields batch*(1 + accepted) tokens.
+    # Cache terms resolve through the ONE registered kernel byte model
+    # (obs/roofline.py) — same resolution as the live gauges
+    from paddle_tpu.obs import roofline
+
     d_head = D_MODEL // N_HEADS
     read = MAX_LEN                                        # unbucketed reads
-    t_row = N_HEADS * d_head * 2
-    d_row = (N_HEADS * (d_head + 4) if draft_kv == "int8"
-             else t_row)
-    t_bytes = _param_bytes(p16) + 2 * batch * read * t_row * N_LAYERS
-    dm_layers = len(draft_model.blocks)
-    d_bytes = (_param_bytes(draft_params)
-               + 2 * batch * read * d_row * dm_layers)
+    t_bytes = _param_bytes(p16) + roofline.kernel_cost(
+        "decode_attention", batch=batch, read=read, n_heads=N_HEADS,
+        d_head=d_head, layers=N_LAYERS, kv_dtype=None, itemsize=2)
+    dm = draft_model.blocks[0]
+    d_bytes = _param_bytes(draft_params) + roofline.kernel_cost(
+        "decode_attention", batch=batch, read=read, n_heads=dm.n_heads,
+        d_head=dm.d_head, layers=len(draft_model.blocks),
+        kv_dtype=draft_kv, itemsize=2)
     per_round = (K if K > 1 else 0) * d_bytes + t_bytes
     toks_per_round = delivered / max(stats["rounds"], 1)  # batch-wide
     bytes_per_tok = per_round / toks_per_round
@@ -70,23 +76,26 @@ def _spec_row(tag, model, p16, draft_model, draft_params, draft_kv, prompt,
     # tokens — so per emitted token it costs t_bytes / batch
     plain_per_tok = t_bytes / batch
     bw = bytes_per_tok * toks_sec / 1e9                   # total bytes/sec
-    return {"metric": f"transformer_lm_decode_speculative_tokens_per_sec_"
-                      f"{tag}_k{K}_bs{batch}_prompt{PROMPT}_gen{STEPS}",
-            "value": round(toks_sec, 1), "unit": "tokens/sec",
-            "vs_baseline": None,
-            "acceptance_rate": round(stats["acceptance_rate"], 3),
-            "rounds": stats["rounds"],
-            "tokens_per_round": round(toks_per_round / batch, 2),
-            "bytes_per_token_mb": round(bytes_per_tok / 1e6, 2),
-            "projected_bytes_reduction": round(plain_per_tok
-                                               / bytes_per_tok, 3),
-            "hbm_bw_gbps": round(bw, 1),
-            "hbm_bw_util": round(bw / HBM_GBPS, 3),
-            "note": "greedy speculative decode, output exactly equals "
-                    "plain greedy (verify pass, tests/test_serving.py); "
-                    "bytes model: k draft streams (k-1 proposals + cache "
-                    "fill) + 1 target verify per round, amortized over "
-                    "emitted tokens" + note_extra}
+    row = {"metric": f"transformer_lm_decode_speculative_tokens_per_sec_"
+                     f"{tag}_k{K}_bs{batch}_prompt{PROMPT}_gen{STEPS}",
+           "value": round(toks_sec, 1), "unit": "tokens/sec",
+           "vs_baseline": None,
+           "acceptance_rate": round(stats["acceptance_rate"], 3),
+           "rounds": stats["rounds"],
+           "tokens_per_round": round(toks_per_round / batch, 2),
+           "bytes_per_token_mb": round(bytes_per_tok / 1e6, 2),
+           "projected_bytes_reduction": round(plain_per_tok
+                                              / bytes_per_tok, 3),
+           "hbm_bw_gbps": round(bw, 1),
+           "note": "greedy speculative decode, output exactly equals "
+                   "plain greedy (verify pass, tests/test_serving.py); "
+                   "bytes model: k draft streams (k-1 proposals + cache "
+                   "fill) + 1 target verify per round, amortized over "
+                   "emitted tokens" + note_extra}
+    # per-token bytes over per-token time: same utilization ratio as the
+    # whole-run totals, but gbytes_per_step stays an honest per-token figure
+    return attach_hbm_bw(row, bytes_per_tok, dt / max(delivered, 1),
+                         methodology="modeled")
 
 
 def run(batch: int = 8) -> dict:

@@ -21,7 +21,6 @@ printed below (and pasted into docs/design/conv_mfu.md).
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 from collections import defaultdict
@@ -30,7 +29,6 @@ from collections import defaultdict
 # (jax pulls it in): xprof's generated protos need the pure-python impl
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
-PEAK_HBM_GBPS = 819.0    # v5e HBM (no device_kind table exists for BW yet)
 STEPS = 20
 
 
@@ -39,6 +37,13 @@ def _peak_tflops() -> float:
 
     peak = peak_flops_per_sec()
     return peak / 1e12 if peak else 197.0   # v5e fallback off-device
+
+
+def _peak_hbm_gbps() -> float:
+    from benchmarks.mfu import peak_hbm_bytes_per_sec
+
+    peak = peak_hbm_bytes_per_sec()   # the obs/roofline device_kind table
+    return peak / 1e9 if peak else 819.0    # v5e fallback off-device
 
 
 def capture(logdir: str = "/tmp/rn50_trace", model: str = "resnet50",
@@ -75,17 +80,24 @@ def capture(logdir: str = "/tmp/rn50_trace", model: str = "resnet50",
 
 
 def hlo_rows(xplane_path: str):
-    from xprof.convert import raw_to_tool_data as r
+    # the parsing lives in the obs plane now (obs/xplane.py): this rich
+    # per-HLO path needs xprof; the raw wire parser + `paddle_tpu
+    # profile` carry the toolchain-free path
+    from paddle_tpu.obs.xplane import hlo_stats_rows, read_xspace, \
+        top_ops_report
 
-    data, _ = r.xspace_to_tool_data([xplane_path], "hlo_stats", {})
-    d = json.loads(data)
-    cols = [c["id"] for c in d["cols"]]
-    return [dict(zip(cols, [c.get("v") for c in row["c"]]))
-            for row in d["rows"]]
+    rows = hlo_stats_rows(xplane_path)
+    if rows is None:
+        print("xprof unavailable — falling back to the raw-parse per-op "
+              "report (no flop-rate/bw columns):\n")
+        print(top_ops_report(read_xspace(xplane_path), steps=STEPS))
+        sys.exit(0)
+    return rows
 
 
 def analyze(rows, steps: int = STEPS):
     peak_tflops = _peak_tflops()
+    peak_hbm_gbps = _peak_hbm_gbps()
     total_us = sum(r["total_self_time"] for r in rows)
     step_ms = total_us / 1e3 / steps
     # model_flop_rate is GFLOP/s and self time is us: GFLOP = rate * t * 1e-6
@@ -124,7 +136,7 @@ def analyze(rows, steps: int = STEPS):
         print(f"conv fusions {bound:8s}: {100 * t / conv_t:5.1f}% of conv "
               f"time at {fr / 1e3:5.1f} TFLOP/s "
               f"({100 * fr / 1e3 / peak_tflops:.0f}% MXU) / {bw:.0f} GB/s "
-              f"({100 * bw / PEAK_HBM_GBPS:.0f}% HBM)")
+              f"({100 * bw / peak_hbm_gbps:.0f}% HBM)")
 
     # roofline-perfect bound: every op at min(its achieved time scaled to
     # 100% of whichever roof binds it) — what the step would cost if XLA
@@ -133,8 +145,8 @@ def analyze(rows, steps: int = STEPS):
     for r in rows:
         t = r["total_self_time"]
         fr = (r["model_flop_rate"] or 0.0) / 1e3 / peak_tflops
-        bw = min((r["measured_memory_bw"] or 0.0), PEAK_HBM_GBPS) \
-            / PEAK_HBM_GBPS
+        bw = min((r["measured_memory_bw"] or 0.0), peak_hbm_gbps) \
+            / peak_hbm_gbps
         util = max(fr, bw)
         ideal_us += t * min(util, 1.0)
     ideal_ms = ideal_us / 1e3 / steps

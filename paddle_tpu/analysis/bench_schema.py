@@ -30,12 +30,20 @@ from typing import Dict, List
 #: keys every row must carry
 REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline")
 
-#: metric-name substring -> additionally required keys
+#: metric-name substring -> additionally required keys. ``methodology``
+#: ("measured" | "modeled") says whether the roofline/SLO columns come
+#: from on-chip measurement of the real executable or from an analytic
+#: projection — so on-chip vs projected numbers are distinguishable in
+#: the trajectory (attach_mfu defaults it to "measured"; the decode
+#: rows' hand byte models stamp "modeled")
 FAMILY_REQUIRED = {
-    "_train_": ("mfu",),
-    "_decode_": ("hbm_bw_util",),
-    "_serve_": ("ttft_p50_ms", "tpot_p50_ms"),
+    "_train_": ("mfu", "methodology"),
+    "_decode_": ("hbm_bw_util", "methodology"),
+    "_serve_": ("ttft_p50_ms", "tpot_p50_ms", "methodology"),
 }
+
+#: the only legal methodology stamps
+METHODOLOGIES = ("measured", "modeled")
 
 #: substrings exempting a row from family rules (comparative/meta rows
 #: that are not themselves roofline measurements)
@@ -57,6 +65,9 @@ def validate_row(row) -> List[str]:
         if key in row and row[key] is not None \
                 and not isinstance(row[key], (int, float)):
             problems.append(f"'{key}' must be a number or null")
+    if "methodology" in row and row["methodology"] not in METHODOLOGIES:
+        problems.append(f"'methodology' must be one of {METHODOLOGIES}, "
+                        f"got {row['methodology']!r}")
     if isinstance(metric, str) and not any(t in metric
                                            for t in FAMILY_EXEMPT):
         for tag, extra in FAMILY_REQUIRED.items():

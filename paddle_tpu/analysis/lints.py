@@ -39,6 +39,15 @@ Lints are advisory by default (WARNING/INFO); the CLI's ``--fail-on`` and
   with ``Executor(buckets=None)``.  Fix: pass a
   :class:`~paddle_tpu.data.feeder.BucketSpec`
   (docs/design/executor_perf.md).
+- **L007 catalogue-drift** (warning): an emit site in ``paddle_tpu/``
+  (``obs.count/gauge_set/observe``, ``registry.counter/gauge/histogram``,
+  a span's ``metric=``) passes a string-literal metric name that is not
+  declared in ``obs/catalogue.py`` — or, vice versa, a catalogue entry no
+  emit site ever names (an orphan that documents a series which cannot
+  exist).  The catalogue is the metrics API surface; drift in either
+  direction means dashboards and docs lie.  Runs over the source tree in
+  the ``paddle_tpu lint`` CLI and the obs test-suite
+  (:func:`lint_catalogue_drift`).
 """
 
 from __future__ import annotations
@@ -57,6 +66,7 @@ LINT_CATALOGUE = {
     # L006 is runtime-emitted by fluid.Executor (cache-miss streak with no
     # bucket spec) — catalogued here so the id/severity live in one table
     "L006": ("shape-churn", Severity.WARNING),
+    "L007": ("catalogue-drift", Severity.WARNING),
 }
 
 # control-flow / executor-lowered ops act through sub-blocks, not outputs
@@ -301,6 +311,118 @@ def lint_metric_names(catalogue, severity: Severity = None,
                      f"distinct values (> {_MAX_LABEL_CARDINALITY}): "
                      "series space looks unbounded", mname,
                      "bucket the value or move it out of labels")
+    return diags
+
+
+#: method names whose first string argument (or ``metric=`` kwarg) is a
+#: metric name: the obs facade's emitters and the registry constructors
+_EMIT_ATTRS = frozenset(("count", "gauge_set", "observe",
+                         "counter", "gauge", "histogram"))
+
+
+def _metric_literals(tree):
+    """(literals, patterns) of metric names an AST emits: plain string
+    constants, plus regexes for f-string names (``f"goodput.{b}_total"``
+    -> ``goodput\\..*_total``) so dynamically-assembled families still
+    anchor their catalogue entries."""
+    import ast
+    import re as _re
+    literals: Set[str] = set()
+    patterns: List = []
+
+    def _collect(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            literals.add(node.value)
+        elif isinstance(node, ast.JoinedStr):
+            parts = []
+            for v in node.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(_re.escape(str(v.value)))
+                else:
+                    parts.append(".*")
+            patterns.append(_re.compile("^" + "".join(parts) + "$"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # `obs.count(...)`, `self._count(...)`, and the imported-alias
+        # forms `count(...)` / `_gauge_set(...)` all emit; leading
+        # underscores are the module-private alias convention
+        fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                 else node.func.id if isinstance(node.func, ast.Name)
+                 else "")
+        if fname.lstrip("_") in _EMIT_ATTRS and node.args:
+            _collect(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "metric":            # obs.span(..., metric=...)
+                _collect(kw.value)
+    return literals, patterns
+
+
+def lint_catalogue_drift(root=None, catalogue=None,
+                         severity: Severity = None) -> List[Diagnostic]:
+    """L007: cross-check emit sites in the source tree against the metric
+    catalogue — both directions.
+
+    Walks every ``.py`` under ``root`` (default: the installed
+    ``paddle_tpu`` package) collecting string-literal metric names passed
+    to the obs emitters (``count``/``gauge_set``/``observe``, the
+    registry's ``counter``/``gauge``/``histogram``, a span's ``metric=``
+    kwarg). A literal that *looks like* a metric name (matches the L005
+    shape — guards against ``str.count(...)`` false positives) but is
+    missing from the catalogue is flagged with its file; a catalogue
+    entry no site ever names (literally or via an f-string family) is
+    flagged as an orphan."""
+    import ast
+    import os
+
+    from ..obs.metrics import METRIC_NAME_RE
+    if catalogue is None:
+        from ..obs import CATALOGUE as catalogue
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sev = severity if severity is not None else LINT_CATALOGUE["L007"][1]
+    diags: List[Diagnostic] = []
+    literals: Dict[str, str] = {}          # name -> first file emitting it
+    patterns: List = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError):
+                continue                    # unreadable: not this lint's job
+            lits, pats = _metric_literals(tree)
+            rel = os.path.relpath(path, root)
+            for name in lits:
+                literals.setdefault(name, rel)
+            patterns.extend(pats)
+    for name in sorted(literals):
+        if not METRIC_NAME_RE.match(name):
+            continue                        # not a metric-shaped literal
+        if name not in catalogue:
+            diags.append(Diagnostic(
+                "L007", sev,
+                f"emit site passes metric '{name}' "
+                f"({literals[name]}) but obs/catalogue.py does not "
+                "declare it", var=name,
+                hint="add a CATALOGUE entry (kind, help[, labels]) — the "
+                     "catalogue is the metrics API surface"))
+    for name in sorted(catalogue):
+        if name in literals:
+            continue
+        if any(p.match(name) for p in patterns):
+            continue                        # an f-string family emits it
+        diags.append(Diagnostic(
+            "L007", sev,
+            f"catalogue entry '{name}' has no emit site in the tree "
+            "(orphan)", var=name,
+            hint="delete the entry, or wire the metric where it was "
+                 "meant to be observed"))
     return diags
 
 

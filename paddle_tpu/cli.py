@@ -177,26 +177,113 @@ def cmd_test(args):
     return 0
 
 
-def cmd_time(args):
-    """--job=time analog (TrainerBenchmark.cpp): steady-state ms/batch."""
-    cfg = _load_config(args.config)
+def _config_workload(config_path, n_batches):
+    """The shared --config training-step setup ``time`` and ``profile``
+    drive: load the config, build its trainer, materialize up to
+    ``n_batches`` reader batches, and close over feeder + fetch list.
+    Returns ``(one, batches)`` where ``one(i)`` runs step *i* (batches
+    recycle)."""
+    cfg = _load_config(config_path)
     trainer = _make_trainer(cfg)
-    batches = list(cfg["train_reader"]())[: max(args.iters + args.warmup, 1)]
+    batches = list(cfg["train_reader"]())[: max(n_batches, 1)]
     from .v2.trainer import _V2Feeder
     feeder = _V2Feeder(cfg["feeding"]) if cfg.get("feeding") else None
     fetch = [cfg["cost"].var]
+
+    def one(i):
+        rows = batches[i % len(batches)]
+        trainer.exe.run(feed=feeder(rows) if feeder else rows,
+                        fetch_list=fetch)
+    return one, batches
+
+
+def cmd_time(args):
+    """--job=time analog (TrainerBenchmark.cpp): steady-state ms/batch."""
+    one, _ = _config_workload(args.config, args.iters + args.warmup)
     i = 0
     for _ in range(args.warmup):
-        feed = feeder(batches[i % len(batches)]) if feeder else batches[i % len(batches)]
-        trainer.exe.run(feed=feed, fetch_list=fetch)
+        one(i)
         i += 1
     t0 = time.perf_counter()
     for _ in range(args.iters):
-        feed = feeder(batches[i % len(batches)]) if feeder else batches[i % len(batches)]
-        trainer.exe.run(feed=feed, fetch_list=fetch)
+        one(i)
         i += 1
     ms = (time.perf_counter() - t0) / args.iters * 1e3
     print(json.dumps({"ms_per_batch": round(ms, 3)}))
+    return 0
+
+
+def cmd_profile(args):
+    """``paddle_tpu profile`` — run N profiled steps of a workload under
+    ``jax.profiler.trace`` and print the top-k per-op device-time report,
+    HLO ops attributed back to the analysis plane's ``block B, op #I
+    (type)`` sites (the fluid Executor's named-scope stamps, inverted by
+    obs/xplane.py).
+
+    Workloads: ``--config cfg.py`` profiles the config's training step
+    (the ``time`` command's loop, traced); ``--decode B,PROMPT,NEW``
+    profiles a fused-decode serve workload on a randomly-initialized
+    TransformerLM built from the model flags + ``--seed``.
+
+    Warmup steps run before the trace so compiles stay out of the
+    profile. The raw ``.xplane.pb`` path prints at the end — feed it to
+    ``paddle_tpu obs export --xplane`` to merge the device lanes into a
+    host-span Perfetto timeline.
+    """
+    import glob
+    import os
+    import tempfile
+
+    import jax
+
+    if not args.config and not args.decode:
+        print("profile: pass --config cfg.py or --decode B,PROMPT,NEW",
+              file=sys.stderr)
+        return 2
+    if args.config:
+        one, batches = _config_workload(args.config,
+                                        args.steps + args.warmup)
+        if not batches:
+            print(f"profile: {args.config!r} train_reader yielded no "
+                  "batches — nothing to profile", file=sys.stderr)
+            return 2
+    else:
+        try:
+            b, prompt_len, new = (int(x) for x in args.decode.split(","))
+        except ValueError:
+            print(f"profile: --decode must be B,PROMPT,NEW integers, got "
+                  f"{args.decode!r}", file=sys.stderr)
+            return 2
+        from .models import TransformerLM
+        model = TransformerLM(args.vocab, d_model=args.d_model,
+                              n_heads=args.n_heads, n_layers=args.n_layers,
+                              max_len=args.max_len)
+        params = model.init(jax.random.PRNGKey(args.seed))
+        prompt = jax.random.randint(jax.random.PRNGKey(args.seed + 1),
+                                    (b, prompt_len), 0, args.vocab)
+
+        def one(i):
+            model.generate_fused(params, prompt, new,
+                                 kv_dtype=args.kv_dtype)
+
+    for i in range(args.warmup):          # compiles stay out of the trace
+        one(i)
+    out_dir = args.trace_dir or tempfile.mkdtemp(prefix="paddle_tpu_profile_")
+    with jax.profiler.trace(out_dir):
+        for j in range(args.steps):
+            one(args.warmup + j)
+    pbs = sorted(glob.glob(os.path.join(out_dir, "**", "*.xplane.pb"),
+                           recursive=True), key=os.path.getmtime)
+    if not pbs:
+        print(f"profile: profiler wrote no .xplane.pb under {out_dir}",
+              file=sys.stderr)
+        return 2
+    from .obs import xplane as _xp
+    space = _xp.read_xspace(pbs[-1])
+    print(_xp.top_ops_report(space, topk=args.topk, steps=args.steps))
+    print(f"\ntrace: {pbs[-1]}")
+    print("merge: paddle_tpu obs export --format=chrome "
+          f"--xplane {pbs[-1]} [--input obs.jsonl] --output trace.json")
     return 0
 
 
@@ -265,6 +352,11 @@ def cmd_lint(args):
     # adding an off-contract metric name fails here, not on a dashboard
     from . import obs as _obs
     for d in analysis.lint_metric_names(_obs.CATALOGUE):
+        d.program = "obs"
+        all_diags.append(d)
+    # L007: catalogue drift — emit sites and catalogue.py must agree in
+    # both directions (an undeclared emit or an orphaned entry fails CI)
+    for d in analysis.lint_catalogue_drift():
         d.program = "obs"
         all_diags.append(d)
     n_err = len(analysis.errors(all_diags))
@@ -730,9 +822,9 @@ def cmd_make_diagram(args):
 
 
 def _read_obs_inputs(inputs):
-    """Load one or more JSONL dumps; several merge into the stitched
-    cluster view (per-process events keep their pids, metric series get
-    worker labels — obs.merge_dumps). Errors name the failing file."""
+    """Load one or more JSONL dumps as a list (the caller merges —
+    cmd_obs appends xplane-derived dumps first). Errors name the
+    failing file."""
     from . import obs
     dumps = []
     for p in inputs:
@@ -740,7 +832,7 @@ def _read_obs_inputs(inputs):
             dumps.append(obs.read_jsonl(p))
         except (OSError, ValueError) as e:
             raise OSError(f"{p}: {e}") from e
-    return dumps[0] if len(dumps) == 1 else obs.merge_dumps(dumps)
+    return dumps
 
 
 def cmd_obs(args):
@@ -761,8 +853,32 @@ def cmd_obs(args):
       dump; useful to strip a corrupt tail or persist a merge).
     """
     from . import obs
+    inputs = list(args.input or ())
+    xplanes = list(getattr(args, "xplane", None) or ())
+    if not inputs and not xplanes:
+        print("obs: pass --input dump.jsonl (repeatable) and/or "
+              "--xplane trace.xplane.pb", file=sys.stderr)
+        return 2
     try:
-        dump = _read_obs_inputs(args.input)
+        dumps = _read_obs_inputs(inputs)
+        if xplanes:
+            # device timelines: each .xplane.pb becomes one dump whose
+            # lanes merge beside the host spans. Anchored at the earliest
+            # host dump's clock origin — XLine clocks are backend-
+            # dependent, so the alignment is coarse but the lanes always
+            # render (obs/xplane.py states the contract)
+            from .obs import xplane as _xp
+            origins = [(d.get("meta") or {}).get("clock_origin_unix")
+                       for d in dumps]
+            origins = [o for o in origins if o is not None]
+            anchor = min(origins) if origins else None
+            for path in xplanes:
+                try:
+                    space = _xp.read_xspace(path)
+                except (OSError, ValueError) as e:
+                    raise OSError(f"{path}: {e}") from e
+                dumps.append(_xp.xplane_dump(space, anchor_unix=anchor))
+        dump = dumps[0] if len(dumps) == 1 else obs.merge_dumps(dumps)
     except (OSError, ValueError) as e:
         print(f"obs: cannot read dump: {e}", file=sys.stderr)
         return 2
@@ -1049,6 +1165,33 @@ def main(argv=None) -> int:
     md.add_argument("--output", default=None)
     md.set_defaults(fn=cmd_make_diagram)
 
+    pf = sub.add_parser("profile", help="run N profiled steps and print a "
+                        "top-k per-op device report with Program-site "
+                        "attribution (obs/xplane.py; docs/design/"
+                        "observability.md)")
+    pf.add_argument("--config", default=None,
+                    help="profile this config's training step")
+    pf.add_argument("--decode", default=None, metavar="B,PROMPT,NEW",
+                    help="profile a fused-decode serve workload instead: "
+                         "batch, prompt length, new tokens (random-init "
+                         "TransformerLM from the model flags + --seed)")
+    pf.add_argument("--steps", type=int, default=3,
+                    help="profiled steps (the report amortizes over them)")
+    pf.add_argument("--warmup", type=int, default=2,
+                    help="unprofiled steps first, so compiles stay out")
+    pf.add_argument("--topk", type=int, default=15)
+    pf.add_argument("--trace-dir", default=None, dest="trace_dir",
+                    help="keep the raw profiler output here (default: a "
+                         "fresh temp dir; the .xplane.pb path prints)")
+    pf.add_argument("--vocab", type=int, default=256)
+    pf.add_argument("--d_model", type=int, default=128)
+    pf.add_argument("--n_heads", type=int, default=4)
+    pf.add_argument("--n_layers", type=int, default=2)
+    pf.add_argument("--max_len", type=int, default=512)
+    pf.add_argument("--kv_dtype", choices=["int8"], default=None)
+    pf.add_argument("--seed", type=int, default=0)
+    pf.set_defaults(fn=cmd_profile)
+
     cg = sub.add_parser("checkgrad")
     common(cg)
     cg.add_argument("--eps", type=float, default=5e-3)
@@ -1112,10 +1255,14 @@ def main(argv=None) -> int:
     os_.set_defaults(fn=cmd_obs)
     oe = obsub.add_parser("export", help="convert the dump(s) for other "
                                          "tools")
-    oe.add_argument("--input", required=True, action="append",
+    oe.add_argument("--input", action="append",
                     help="JSONL dump to convert (repeat to merge: one "
                          "Chrome lane per process + client->server flow "
                          "arrows)")
+    oe.add_argument("--xplane", action="append", metavar="TRACE.xplane.pb",
+                    help="merge a jax.profiler trace's device planes as "
+                         "extra process lanes beside the host spans "
+                         "(paddle_tpu profile writes one)")
     oe.add_argument("--format", choices=["chrome", "prom", "jsonl"],
                     default="chrome",
                     help="chrome: trace_event JSON for Perfetto; prom: "

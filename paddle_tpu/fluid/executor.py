@@ -42,6 +42,21 @@ class _OpTraceError(RuntimeError):
     the chain leading to it (CustomStackTrace.h:51 crash-stack analog)."""
 
 
+import re as _re
+
+_SCOPE_SAFE = _re.compile(r"[^A-Za-z0-9_]")
+
+
+def _scope_tag(op, idx: int) -> str:
+    """The jax.named_scope stamp for one op site — the machine-parseable
+    twin of analysis.diagnostics.op_site ('block B, op #I (type)'):
+    obs/xplane.py's `site_of` inverts it when attributing profiled HLO
+    ops back to Program sites."""
+    bidx = getattr(op.block, "idx", None)
+    b = bidx if bidx is not None else 0
+    return f"b{b}_op{idx}_{_SCOPE_SAFE.sub('_', op.type)}"
+
+
 class Scope:
     """Runtime variable store (scope.h analog); persistables live here across
     run() calls. Child scopes see parent vars."""
@@ -117,7 +132,13 @@ def _trace_ops(ops, env: Dict[str, Any], ctx: TraceContext):
                 continue
             compute = OpRegistry.get(op.type)
             ins = {k: [env[n] for n in vs] for k, vs in op.inputs.items()}
-            outs = compute(ins, op.attrs)
+            # per-op-site name scope: HLO ops lowered from this compute
+            # carry "b{B}_op{I}_{type}" in their metadata, so a device
+            # profile (obs/xplane.py, `paddle_tpu profile`) attributes
+            # hot ops back to the analysis plane's `block B, op #I
+            # (type)` site — the same site runtime trace errors cite
+            with jax.named_scope(_scope_tag(op, idx)):
+                outs = compute(ins, op.attrs)
             for k, names in op.outputs.items():
                 vals = outs[k]
                 for n, v in zip(names, vals):
@@ -329,6 +350,77 @@ def _trace_beam_search_gen(op, env, ctx: TraceContext):
         constraint_fn=constraint_fn)
     env[op.outputs["Tokens"][0]] = toks
     env[op.outputs["Scores"][0]] = scores
+
+
+class _CompiledEntry:
+    """One compiled-fn cache entry: the jitted callable plus the cost
+    record the roofline ledger reads (docs/design/observability.md
+    "Device timelines & roofline").
+
+    The first call under an installed obs session lowers + compiles AOT
+    (``jitted.lower(...).compile()``
+    — the same compile jit would pay, just held where
+    ``cost_analysis()`` / ``memory_analysis()`` are reachable) and
+    records the executable's :class:`~paddle_tpu.obs.roofline.Cost`.
+    Installing obs AFTER an entry warmed up on the plain jit path makes
+    that first session call re-pay one compile for the signature (jit's
+    internal executable is not reachable for cost analysis); the
+    persistent XLA compile cache turns it into a deserialize when
+    enabled.
+    The executor's cache key pins the argument signature, so one
+    executable serves the entry for its lifetime. Any AOT
+    lowering/compile failure — or the stricter AOT argument check
+    rejecting a call the polymorphic jit would have accepted — falls
+    back to the plain jitted callable (counted as a cost-analysis
+    failure; cost stays an honest None)."""
+
+    __slots__ = ("_jitted", "_call", "cost", "kernel_bytes")
+
+    def __init__(self, jitted):
+        self._jitted = jitted
+        self._call = None
+        self.cost = None
+        #: {kernel: modeled bytes per dispatch} collected at trace time
+        #: from note_kernel_bytes launch sites (Pallas routes) inside the
+        #: program — re-emitted per run by the executor
+        self.kernel_bytes = None
+
+    def __call__(self, feed, kept_vals, donated_vals):
+        call = self._call
+        if call is None:
+            if not obs.is_active():
+                # plane off: stay on the plain jit path — no AOT compile,
+                # no cost-analysis warnings in processes that never
+                # installed obs (CostInstrumentedJit's discipline; an
+                # entry first hit under a session records its cost)
+                return self._jitted(feed, kept_vals, donated_vals)
+            roofline = obs.roofline
+            try:
+                with roofline.collect_kernel_bytes() as col:
+                    lowered = self._jitted.lower(feed, kept_vals,
+                                                 donated_vals)
+                if col.per_kernel:
+                    self.kernel_bytes = col.per_kernel
+                compiled = lowered.compile()
+                self.cost = roofline.compiled_cost(compiled,
+                                                   "fluid.Executor")
+                call = compiled
+            except Exception as e:
+                roofline.cost_failure("fluid.Executor lower/compile", e)
+                call = self._jitted
+            self._call = call
+        try:
+            return call(feed, kept_vals, donated_vals)
+        except TypeError as e:
+            if call is self._jitted:
+                raise
+            # AOT argument strictness (weak types, committed devices) the
+            # shape-keyed cache cannot see; the check fires BEFORE
+            # dispatch, so donated buffers are intact and the jit retry
+            # is safe
+            obs.roofline.cost_failure("fluid.Executor (aot call)", e)
+            self._call = self._jitted
+            return self._jitted(feed, kept_vals, donated_vals)
 
 
 #: consecutive compiled-fn cache misses before the executor warns that the
@@ -598,6 +690,12 @@ class Executor:
         feed = dict(feed or {})
         bucketed = self.buckets is not None and self._apply_buckets(feed,
                                                                     block)
+        # weak_type rides the cache key (below) instead of being stripped
+        # from the value: a python-scalar feed keeps jit's exact promotion
+        # semantics (weak f32 * bf16 -> bf16), and the AOT-compiled entries
+        # (cost ledger) never see a weak/strong aval mismatch because the
+        # weak and strong variants compile separate entries — the same
+        # retrace jit itself would do
         feed = {k: jnp.asarray(v) for k, v in feed.items()}
         # anything with a .name (Variable, v2 LayerOutput) or a plain string
         fetch_names = [v if isinstance(v, str) else v.name
@@ -698,7 +796,9 @@ class Executor:
         bflag = "true" if bucketed else "false"
         key = (program._serial, program.version, block.idx, tuple(fetch_names),
                tuple(persist_in), bool(donate), mesh_key,
-               tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed.items())))
+               tuple((k, v.shape, str(v.dtype),
+                      bool(getattr(v, "weak_type", False)))
+                     for k, v in sorted(feed.items())))
         fn = self._cache.get(key) if use_cache else None
         obs.count("fluid.runs_total")
         churn_key = (program._serial, block.idx, tuple(fetch_names))
@@ -754,6 +854,18 @@ class Executor:
                     "or use donate=False while debugging.",
                     RuntimeWarning, stacklevel=3)
             raise
+        # device cost ledger — AFTER the dispatch try/except: telemetry
+        # must never discard a successful run's fetches or dress its own
+        # failure up as the donated-buffer post-dispatch warning. No-op
+        # when the plane is off or the analysis resolved to None.
+        cost = getattr(fn, "cost", None)
+        kb = getattr(fn, "kernel_bytes", None)
+        if (cost is not None or kb) and obs.is_active():
+            # Pallas launches inside the program are zero to XLA's
+            # analysis: re-emit the trace-collected models once per run —
+            # the same per-dispatch semantics as the decode sites
+            obs.roofline.account(
+                cost, extra_bytes=obs.roofline.emit_kernel_bytes(kb))
         for n, v in zip(written, new_persist):
             self.scope.set(n, v)
         if return_numpy:
@@ -797,7 +909,7 @@ class Executor:
         # params/BN stats update in place instead of allocating a second copy
         donate_args = (2,) if donated_in else ()
         if shardings is None:
-            return jax.jit(raw, donate_argnums=donate_args)
+            return _CompiledEntry(jax.jit(raw, donate_argnums=donate_args))
         # GSPMD lowering: argument/result shardings pin the layout the
         # resolver chose; XLA's SPMD partitioner inserts the collectives.
         # Donated sharded buffers keep the same out-sharding, so the alias
@@ -811,6 +923,6 @@ class Executor:
                         [spec_of[n] for n in kept_in],
                         [spec_of[n] for n in donated_in])
         out_shardings = ([replicated] * len(fetch_names), out_sh)
-        return jax.jit(raw, in_shardings=in_shardings,
-                       out_shardings=out_shardings,
-                       donate_argnums=donate_args)
+        return _CompiledEntry(jax.jit(raw, in_shardings=in_shardings,
+                                      out_shardings=out_shardings,
+                                      donate_argnums=donate_args))

@@ -489,6 +489,7 @@ class TransformerLM(nn.Module):
         fn = cache.get(key)
         if fn is not None:
             return fn
+        extra_bytes = None
         if kind == "prefill":
             kv_dtype = static["kv_dtype"]
             sample = static.get("sample", "greedy")
@@ -513,6 +514,7 @@ class TransformerLM(nn.Module):
                 nxt, rng = _sample_token(logits, rng, sample, top_k, temp)
                 return cell, nxt.astype(cur.dtype), rng
             fn = jax.jit(step)
+            extra_bytes = self._step_kernel_bytes(cache_len, attn_route)
         elif kind == "verify":
             cache_len = static["cache_len"]
 
@@ -523,8 +525,35 @@ class TransformerLM(nn.Module):
             fn = jax.jit(vf)
         else:
             raise ValueError(kind)
+        # cost-instrumented: the first call AOT-compiles and records the
+        # executable's FLOPs/bytes in the roofline ledger, so a decode
+        # loop under an obs session feeds the derived roofline gauges
+        # exactly like a fluid Executor run does; extra_bytes contributes
+        # the Pallas cache-read model where XLA's analysis sees zero
+        fn = obs.roofline.instrument(fn, f"decode.{kind}",
+                                     extra_bytes=extra_bytes)
         cache[key] = fn
         return fn
+
+    def _step_kernel_bytes(self, cache_len, attn_route):
+        """Per-call modeled HBM bytes of one fused decode step's cache
+        read — non-zero only on the Pallas kernel route, where the bytes
+        are invisible to XLA's cost analysis (the dense route's read is
+        already in the executable's own 'bytes accessed')."""
+        L = self.max_len if cache_len is None else cache_len
+        if pk.decode_route(L, attn_route) != "kernel":
+            return None
+        n_heads = self.blocks[0].n_heads
+        d_head = self.blocks[0].d_head
+
+        def extra(params, cell, cur, rng):
+            kv_dtype = "int8" if "k0_scale" in cell else None
+            itemsize = jnp.dtype(cell["k0"].dtype).itemsize
+            return obs.roofline.kernel_cost(
+                "decode_attention", batch=cur.shape[0], read=L,
+                n_heads=n_heads, d_head=d_head, layers=len(self.blocks),
+                kv_dtype=kv_dtype, itemsize=itemsize) or 0.0
+        return extra
 
     def generate_fused(self, params, prompt, steps: int, *,
                        bucket: Optional[int] = None,
@@ -562,8 +591,8 @@ class TransformerLM(nn.Module):
             temperature=temperature)(params, prompt, rng)
         obs.count("decode.dispatches_total", route="prefill")
         toks = [cur]
-        kv_bytes = 1 if kv_dtype == "int8" else \
-            jnp.dtype(self._compute_dtype(params)).itemsize
+        itemsize = (1 if kv_dtype == "int8" else
+                    jnp.dtype(self._compute_dtype(params)).itemsize)
         n_heads = self.blocks[0].n_heads
         d_head = self.blocks[0].d_head
         for j in range(1, steps):
@@ -581,11 +610,15 @@ class TransformerLM(nn.Module):
             cell, cur, rng = step(params, cell, cur, rng)
             toks.append(cur)
             obs.count("decode.dispatches_total", route="step")
+            # modeled cache-read bytes through the ONE registered model
+            # (ops/pallas_kernels._decode_attention_bytes) — the same
+            # resolution the bench rows and the roofline ledger use
             obs.count("kernels.bytes_total",
-                      2 * B * L * n_heads * (d_head * kv_bytes
-                                             + (4 if kv_dtype == "int8"
-                                                else 0))
-                      * len(self.blocks),
+                      obs.roofline.kernel_cost(
+                          "decode_attention", batch=B, read=L,
+                          n_heads=n_heads, d_head=d_head,
+                          layers=len(self.blocks), kv_dtype=kv_dtype,
+                          itemsize=itemsize) or 0.0,
                       kernel="decode_attention")
         obs.count("decode.tokens_total", B * steps, route="fused")
         return jnp.concatenate([prompt, jnp.stack(toks, axis=1)], axis=1)

@@ -102,16 +102,64 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "fluid.run_seconds": ("histogram", "whole Executor.run duration"),
     "fluid.verify_seconds": ("histogram", "static pre-flight "
                                           "(analysis.check_or_raise)"),
+    "fluid.device_flops_total": ("counter", "FLOPs dispatched through "
+                                            "cost-instrumented executables "
+                                            "(fluid Executor, trainer step, "
+                                            "fused decode) per XLA "
+                                            "cost_analysis — the numerator "
+                                            "of the derived roofline.mfu"),
+    "fluid.device_bytes_total": ("counter", "HBM bytes streamed by cost-"
+                                            "instrumented executables: XLA "
+                                            "'bytes accessed' plus "
+                                            "registered Pallas kernel "
+                                            "models (custom calls report "
+                                            "zero to XLA) — the numerator "
+                                            "of roofline.hbm_bw_util"),
+    # -- goodput: obs/goodput.py (trainer / v2 SGD / serving drivers) ----
+    "goodput.compile_seconds_total": ("counter", "wall seconds inside XLA "
+                                                 "backend compiles (stolen "
+                                                 "from the enclosing "
+                                                 "bucket), labels: "
+                                                 "component", ("component",)),
+    "goodput.host_input_seconds_total": ("counter", "wall seconds waiting "
+                                                    "on readers/feeders/"
+                                                    "admission assembly, "
+                                                    "labels: component",
+                                         ("component",)),
+    "goodput.device_seconds_total": ("counter", "wall seconds dispatching "
+                                                "device work and blocking "
+                                                "on its results — the "
+                                                "goodput numerator, "
+                                                "labels: component",
+                                     ("component",)),
+    "goodput.host_sync_seconds_total": ("counter", "wall seconds in host-"
+                                                   "side result handling "
+                                                   "(loss reads, token "
+                                                   "collection), labels: "
+                                                   "component",
+                                        ("component",)),
+    "goodput.idle_seconds_total": ("counter", "window wall time no bucket "
+                                              "claimed (event handlers, "
+                                              "logging, scheduler waits), "
+                                              "labels: component",
+                                   ("component",)),
+    "goodput.ratio": ("gauge", "device_seconds / wall over the open "
+                               "window — the goodput fraction, labels: "
+                               "component", ("component",)),
     # -- jax: obs/jaxhooks.py (jax.monitoring bridge) -------------------
     "jax.compiles_total": ("counter", "XLA backend compiles observed "
                                       "(one per executable built)"),
     "jax.compile_seconds": ("histogram", "XLA backend-compile durations"),
     # -- kernels: ops/pallas_kernels.py, ops/rnn.py entry points --------
     "kernels.bytes_total": ("counter", "modeled HBM bytes streamed by "
-                                       "Pallas-kernel reads, counted at "
-                                       "host-dispatched call sites (decode: "
-                                       "live cache rows, halved under int8 "
-                                       "KV), labels: kernel", ("kernel",)),
+                                       "Pallas-kernel reads, one increment "
+                                       "per dispatch (host decode loops "
+                                       "count directly; launches inside a "
+                                       "traced program are collected at "
+                                       "trace time and re-emitted per run; "
+                                       "decode: live cache rows, halved "
+                                       "under int8 KV), labels: kernel",
+                            ("kernel",)),
     "kernels.routes_total": ("counter", "auto-route decisions at the "
                                         "kernel entry points; counted when "
                                         "the routing Python runs — once "
@@ -156,6 +204,30 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
                                     "master (obs_push RPC)"),
     "obs.push_failures_total": ("counter", "obs_push RPCs that failed "
                                            "(master unreachable)"),
+    # -- roofline: obs/roofline.py (the device cost ledger) --------------
+    "roofline.mfu": ("gauge", "derived model-FLOPs utilization over the "
+                              "most recent accounting window: "
+                              "fluid.device_flops_total delta / elapsed / "
+                              "chip dense peak (set only when the peak is "
+                              "known — on TPU or under "
+                              "PADDLE_TPU_PEAK_TFLOPS; updated on "
+                              "dispatch, so an idle chip HOLDS its last "
+                              "busy window's value — cross-check the "
+                              "counter deltas for liveness)"),
+    "roofline.hbm_bw_util": ("gauge", "derived HBM-bandwidth utilization "
+                                      "over the most recent accounting "
+                                      "window: fluid.device_bytes_total "
+                                      "delta / elapsed / chip HBM peak "
+                                      "(null + staleness semantics as "
+                                      "roofline.mfu)"),
+    "roofline.cost_analysis_failures_total": ("counter", "XLA cost/memory "
+                                                         "analyses that "
+                                                         "raised — derived "
+                                                         "FLOPs/bytes for "
+                                                         "those executables "
+                                                         "are honest "
+                                                         "unknowns, not "
+                                                         "quiet nulls"),
     # -- rpc: runtime/master_service.py (_RpcClient, shared by coord) ---
     "rpc.calls_total": ("counter", "RPC calls issued, labels: rpc, op",
                         ("rpc", "op")),

@@ -45,6 +45,10 @@ def _on_duration(event: str, duration_secs: float = 0.0, **kw) -> None:
         s.registry.histogram("jax.compile_seconds").observe(duration_secs)
         s.tracer.instant("jax.compile", event=event,
                          duration_secs=duration_secs)
+        # goodput ledger: the compile second is hiding inside whatever
+        # bucket the compiling thread has open — move it to `compile`
+        from . import goodput
+        goodput.note_compile(duration_secs)
     except Exception:
         # a telemetry bridge must never take down a compile
         pass

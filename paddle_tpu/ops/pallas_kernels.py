@@ -393,6 +393,17 @@ def _default_blocks(block_q: Optional[int],
 SHORT_SEQ_DENSE = 256
 
 
+def decode_route(L: int, route: Optional[str] = None) -> str:
+    """The route :func:`decode_attention` / :func:`paged_decode_attention`
+    will take for a read of L rows — exposed so cost accounting
+    (obs/roofline.py kernel models) can ask WITHOUT dispatching: modeled
+    kernel bytes apply only on the kernel route; the dense route's bytes
+    are already visible to XLA's own cost analysis."""
+    if route is not None:
+        return route
+    return "kernel" if _on_tpu() and L >= SHORT_SEQ_DENSE else "dense"
+
+
 def _dense_attention(q, k, v, causal, scale, kv_lens):
     """Masked dense attention for short sequences — same semantics as the
     flash kernels (causal + per-sample kv_lens), ordinary autodiff."""
@@ -638,9 +649,7 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``route="kernel", interpret=True``)."""
     B, L, H, D = k.shape
     scale_v = scale if scale is not None else D ** -0.5
-    if route is None:
-        route = ("kernel" if _on_tpu() and L >= SHORT_SEQ_DENSE
-                 else "dense")
+    route = decode_route(L, route)
     from .. import obs
     obs.count("kernels.routes_total", kernel="decode_attention", route=route)
     if route == "dense":
@@ -758,9 +767,7 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     P, bs, H, D = k_pool.shape
     L = NB * bs
     scale_v = scale if scale is not None else D ** -0.5
-    if route is None:
-        route = ("kernel" if _on_tpu() and L >= SHORT_SEQ_DENSE
-                 else "dense")
+    route = decode_route(L, route)
     from .. import obs
     obs.count("kernels.routes_total", kernel="paged_decode_attention",
               route=route)
@@ -1352,3 +1359,63 @@ def gru_sequence_fused(xw: jax.Array, lengths: jax.Array, u: jax.Array,
         interpret=bool(interpret),
     )(xw_tm, lens, u, h0)
     return jnp.swapaxes(out, 0, 1)[:B], ht[:B]
+
+
+# ---------------------------------------------------------------------------
+# Roofline cost models — Pallas custom calls report ZERO FLOPs/bytes to XLA's
+# cost analysis, so each kernel registers the analytic HBM bytes of one
+# dispatch with the obs cost ledger (obs/roofline.py register_kernel_cost).
+# Every consumer — the live fluid.device_bytes_total accounting, the
+# kernels.bytes_total counters at dispatch sites, and the bench rows'
+# hbm_bw_util columns (benchmarks/serving_decode.py) — resolves through
+# roofline.kernel_cost, so the modeled number has exactly one owner and the
+# bench rows and live gauges can never disagree on methodology.
+# ---------------------------------------------------------------------------
+
+def _decode_attention_bytes(*, batch, read, n_heads, d_head, layers=1,
+                            kv_dtype=None, itemsize=2, steps=1):
+    """HBM bytes of ``steps`` decode_attention dispatches: k+v live cache
+    rows stream once per step (int8 rows read 1 byte/element plus one f32
+    scale per (row, head) — the quantized-KV numerics contract,
+    docs/design/kernels.md)."""
+    row = n_heads * (d_head + 4 if kv_dtype == "int8"
+                     else d_head * itemsize)
+    return 2.0 * batch * read * row * layers * steps
+
+
+def _paged_decode_attention_bytes(*, batch, pages, page_block, n_heads,
+                                  d_head, layers=1, kv_dtype=None,
+                                  itemsize=2, steps=1):
+    """HBM bytes of ``steps`` paged reads: each sample streams its
+    ``pages`` live pages (``page_block`` rows each) once per step."""
+    return _decode_attention_bytes(batch=batch, read=pages * page_block,
+                                   n_heads=n_heads, d_head=d_head,
+                                   layers=layers, kv_dtype=kv_dtype,
+                                   itemsize=itemsize, steps=steps)
+
+
+def _lstm_sequence_fused_bytes(*, batch, seq_len, hidden, itemsize=4,
+                               gates=4):
+    """HBM bytes of one fused-RNN forward launch: the [B, T, G*H] gate
+    input streams in once, [B, T, H] outputs stream out, the recurrent
+    [H, G*H] weights load once (VMEM-resident across steps — the whole
+    point of the kernel)."""
+    return float(itemsize) * (batch * seq_len * hidden * gates      # xw in
+                              + batch * seq_len * hidden            # out
+                              + hidden * hidden * gates)            # U
+
+
+def _register_cost_models():
+    from ..obs import roofline
+    roofline.register_kernel_cost("decode_attention",
+                                  _decode_attention_bytes)
+    roofline.register_kernel_cost("paged_decode_attention",
+                                  _paged_decode_attention_bytes)
+    roofline.register_kernel_cost("lstm_sequence_fused",
+                                  _lstm_sequence_fused_bytes)
+    roofline.register_kernel_cost(
+        "gru_sequence_fused",
+        functools.partial(_lstm_sequence_fused_bytes, gates=3))
+
+
+_register_cost_models()

@@ -108,6 +108,15 @@ def lstm(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
                   route=("fused" if _pk._on_tpu() and plan is not None
                          else "scan"))
         if _pk._on_tpu() and plan is not None:
+            # modeled launch bytes through the ONE registered model
+            # (pallas_kernels._lstm_sequence_fused_bytes): under an
+            # executor/instrumented-jit trace the collector re-emits them
+            # PER DISPATCH; eagerly this counts kernels.bytes_total now
+            obs.roofline.note_kernel_bytes(
+                "lstm_sequence_fused",
+                obs.roofline.kernel_cost(
+                    "lstm_sequence_fused", batch=B, seq_len=T,
+                    hidden=H, itemsize=jnp.dtype(x.dtype).itemsize))
             blk, chunk = plan
             lens = (lengths if lengths is not None
                     else jnp.full((B,), T, jnp.int32))
@@ -346,6 +355,11 @@ def gru(x: jax.Array, lengths: Optional[jax.Array], w: jax.Array, u: jax.Array,
                   route=("fused" if _pk._on_tpu() and plan is not None
                          else "scan"))
         if _pk._on_tpu() and plan is not None:
+            obs.roofline.note_kernel_bytes(
+                "gru_sequence_fused",
+                obs.roofline.kernel_cost(
+                    "gru_sequence_fused", batch=B, seq_len=T,
+                    hidden=H, itemsize=jnp.dtype(x.dtype).itemsize))
             blk, chunk = plan
             lens = (lengths if lengths is not None
                     else jnp.full((B,), T, jnp.int32))

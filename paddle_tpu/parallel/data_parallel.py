@@ -56,7 +56,11 @@ class DataParallel:
             return new_params, new_state, loss
 
         donate_args = (0, 1) if donate else ()
-        self._step = jax.jit(_step, donate_argnums=donate_args)
+        # cost-instrumented jit (as Trainer._step): an obs session sees the
+        # SPMD step's FLOPs/bytes in the roofline ledger per dispatch
+        from ..obs import roofline
+        self._step = roofline.instrument(
+            jax.jit(_step, donate_argnums=donate_args), "data_parallel.step")
 
     # -- placement ---------------------------------------------------------
     def init(self, params, opt_state=None):

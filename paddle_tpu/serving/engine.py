@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import obs
+from ..obs.goodput import maybe_bucket
 from .batcher import Request, clip_emission
 from .paged import PagePool
 
@@ -101,6 +102,10 @@ class ServingEngine:
         self._stop = False
         self._failed: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
+        # goodput ledger for the scheduler thread (None when the obs
+        # plane is off): opened by _run, so in-process tests driving
+        # step() directly stay ledger-free and deterministic
+        self._gp = None
 
     # -- client surface (any thread) ---------------------------------------
     def submit(self, prompt, max_new: int, *, eos_id: Optional[int] = None,
@@ -196,17 +201,29 @@ class ServingEngine:
             self._thread = None
 
     def _run(self) -> None:
-        while True:
-            with self._lock:
-                while not self._stop and not self._queue and not self._live:
-                    self._wake.wait(timeout=1.0)
-                if self._stop:
+        # goodput window over the scheduler's whole life: device work is
+        # the prefill/segment dispatches, the admission-wait below is
+        # idle, and goodput.ratio says what fraction of the daemon's wall
+        # time the chip was decoding — the serving twin of the trainer's
+        # ledger (docs/design/observability.md "Goodput ledger")
+        self._gp = obs.goodput.open_ledger("serving")
+        try:
+            while True:
+                with self._lock:
+                    while (not self._stop and not self._queue
+                           and not self._live):
+                        self._wake.wait(timeout=1.0)
+                    if self._stop:
+                        return
+                try:
+                    self.step()
+                except Exception as e:  # a dead scheduler must not look alive
+                    self._fail_all(e)
                     return
-            try:
-                self.step()
-            except Exception as e:   # a dead scheduler must not look alive
-                self._fail_all(e)
-                return
+        finally:
+            gp, self._gp = self._gp, None
+            if gp is not None:
+                gp.close()
 
     def _fail_all(self, exc: BaseException) -> None:
         """A dispatch blew up (device OOM, a bug in a jitted path). After a
@@ -258,7 +275,7 @@ class ServingEngine:
         service owes its callers), run the batched ragged prefill, and
         emit each admission's first token (TTFT stops here). Returns the
         number admitted."""
-        with self._lock:
+        with maybe_bucket(self._gp, "host_input"), self._lock:
             group, members, pending = [], [], 0
             busy = set(self._live)
             for slot in range(self.pool.n_slots):
@@ -277,10 +294,11 @@ class ServingEngine:
                 members.append(rec)
         if not group:
             return 0
-        with obs.span("serving.prefill", batch=len(group)):
+        with obs.span("serving.prefill", batch=len(group)), \
+                maybe_bucket(self._gp, "device"):
             first = self.pool.admit(group)      # device work, lock released
         now = self._clock()
-        with self._lock:
+        with maybe_bucket(self._gp, "host_sync"), self._lock:
             for rec in members:
                 # a cancel landing during the prefill only sets the flag
                 # (this thread owns finalization); the next _reap honors it
@@ -306,10 +324,11 @@ class ServingEngine:
             live = sorted(self._live)
         if not live:
             return
-        with obs.span("serving.segment", live=len(live)):
+        with obs.span("serving.segment", live=len(live)), \
+                maybe_bucket(self._gp, "device"):
             block = self.pool.run_segment(live)  # device work, lock released
         now = self._clock()
-        with self._lock:
+        with maybe_bucket(self._gp, "host_sync"), self._lock:
             for slot in live:
                 rec = self._live.get(slot)
                 if rec is None or rec.done:

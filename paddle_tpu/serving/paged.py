@@ -87,8 +87,8 @@ class PagePool:
                 pools[f"v{i}_scale"] = jnp.ones((self.pages, self.bs, H),
                                                 jnp.float32)
         self.pools = pools
-        self._row_bytes = H * (Dh + 4 if kv_dtype == "int8"
-                               else Dh * jnp.dtype(dt).itemsize)
+        self._H, self._Dh = H, Dh
+        self._itemsize = jnp.dtype(dt).itemsize
 
         # host accounting
         self.free: List[int] = list(range(self.pages - 1, 0, -1))
@@ -295,8 +295,14 @@ class PagePool:
             jnp.asarray(self.pos, jnp.int32).clip(0, self.model.max_len - 1),
             jnp.asarray(self.cur))
         obs.count("decode.dispatches_total", route="serve_segment")
-        read = (2 * self.n_slots * nb * self.bs * self._row_bytes
-                * len(self.model.blocks) * self.segment)
+        # modeled cache-read bytes through the ONE registered model
+        # (ops/pallas_kernels._paged_decode_attention_bytes) — the same
+        # resolution the bench rows and the roofline ledger use
+        read = obs.roofline.kernel_cost(
+            "paged_decode_attention", batch=self.n_slots, pages=nb,
+            page_block=self.bs, n_heads=self._H, d_head=self._Dh,
+            layers=len(self.model.blocks), kv_dtype=self.kv_dtype,
+            itemsize=self._itemsize, steps=self.segment) or 0.0
         obs.count("kernels.bytes_total", read,
                   kernel="paged_decode_attention")
         self.segments_total += 1

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import faults, obs
+from ..obs.goodput import maybe_bucket
 from ..data.prefetch import DoubleBuffer
 from ..parallel.data_parallel import DataParallel
 from ..utils.logging import get_logger
@@ -40,6 +41,20 @@ from .evaluator import EvaluatorGroup
 log = get_logger(__name__)
 
 _NONFINITE_POLICIES = ("raise", "skip", "halt", "off")
+
+
+def _timed_input(batches, gp):
+    """Yield from ``batches`` timing each pull into the goodput ledger's
+    ``host_input`` bucket — the reader/feeder wait as the driver loop
+    experiences it (prefetch overlap shows up as near-zero pulls)."""
+    it = iter(batches)
+    while True:
+        with gp.bucket("host_input"):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+        yield batch
 
 
 class _TrainStatsView(Mapping):
@@ -200,7 +215,13 @@ class Trainer:
                     return new_params, new_opt, loss, outs
                 return new_params, new_opt, loss
 
-            self._step = jax.jit(_step, donate_argnums=(0, 1))
+            # cost-instrumented jit: first call per batch signature AOT-
+            # compiles and records FLOPs/bytes in the roofline ledger, so
+            # a training run under an obs session accumulates
+            # fluid.device_flops_total and the derived roofline.mfu gauge
+            # as a byproduct of just running
+            self._step = obs.roofline.instrument(
+                jax.jit(_step, donate_argnums=(0, 1)), "trainer.step")
         self._loss_jit = jax.jit(loss_fn)
 
     # ------------------------------------------------------------------ train
@@ -365,6 +386,10 @@ class Trainer:
 
         prev_handlers = (self._install_preemption_handlers()
                          if handle_signals else {})
+        # goodput ledger (None when the obs plane is off): splits this
+        # call's wall time into compile / host_input / device / host_sync
+        # / idle — goodput.*_seconds_total + the goodput.ratio gauge
+        gp = obs.goodput.open_ledger("trainer")
         try:
             last_pass = start_pass + num_passes - 1
             for pass_id in range(start_pass, start_pass + num_passes):
@@ -376,6 +401,8 @@ class Trainer:
                 self.evaluators.start()
                 first_batch = skip_batches if pass_id == start_pass else 0
                 batches = self._batches(reader, feeder, skip=first_batch)
+                if gp is not None:
+                    batches = _timed_input(batches, gp)
                 for batch_id, batch in enumerate(batches, start=first_batch):
                     event_handler(EV.BeginIteration(pass_id, batch_id))
                     if (self.on_nonfinite in ("skip", "halt")
@@ -385,22 +412,31 @@ class Trainer:
                     with obs.span("trainer.step",
                                   metric="trainer.step_seconds"):
                         with self.stats.timer("TrainBatch"), \
-                                obs.span("trainer.device_step"):
+                                obs.span("trainer.device_step"), \
+                                maybe_bucket(gp, "device"):
                             if self._dp is not None:
                                 batch = self._dp.shard_batch(batch)
                                 res = self._dp.step(params, opt_state,
                                                     *batch)
                             else:
                                 res = self._step(params, opt_state, *batch)
+                            if gp is not None:
+                                # under async dispatch (TPU) the step's wall
+                                # time surfaces at the FIRST host block — the
+                                # bucket contract puts that block here, so
+                                # block now rather than at float(cost) below
+                                # (which would book device time as host_sync;
+                                # nothing runs between dispatch and that sync,
+                                # so this costs no overlap)
+                                jax.block_until_ready(res)
                         if self.outputs_fn is not None:
                             params, opt_state, cost, outs = res
                         else:
                             params, opt_state, cost = res
                             outs = None
-                        # float(cost) is the host block on the async step —
-                        # under async dispatch the device time lands here
                         with obs.span("trainer.host_sync",
-                                      metric="trainer.sync_seconds"):
+                                      metric="trainer.sync_seconds"), \
+                                maybe_bucket(gp, "host_sync"):
                             cost_f = faults.filter_value("step.grad",
                                                          float(cost))
                     self._c_steps.inc()
@@ -456,6 +492,8 @@ class Trainer:
                                         opt_state)
                 event_handler(EV.EndPass(pass_id, pass_result))
         finally:
+            if gp is not None:
+                gp.close()
             for sig, handler in prev_handlers.items():
                 try:
                     signal.signal(sig, handler)

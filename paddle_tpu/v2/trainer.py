@@ -70,18 +70,43 @@ class SGD:
         event_handler = event_handler or (lambda e: None)
         feeder = _V2Feeder(feeding) if feeding else None
         fetches = [self.cost.var] + [e.var for e in self.extra]
-        for pass_id in range(num_passes):
-            event_handler(EV.BeginPass(pass_id))
-            for batch_id, rows in enumerate(reader()):
-                event_handler(EV.BeginIteration(pass_id, batch_id))
-                feed = feeder(rows) if feeder else rows
-                outs = self.exe.run(feed=feed, fetch_list=fetches)
-                metrics = {e.var.name: float(np.asarray(o).mean())
-                           for e, o in zip(self.extra, outs[1:])}
-                event_handler(EV.EndIteration(pass_id, batch_id,
-                                              float(outs[0]), None, metrics))
-                self._maybe_param_stats(batch_id)
-            event_handler(EV.EndPass(pass_id))
+        # goodput ledger (None when the obs plane is off): reader pulls +
+        # feeding are host_input, exe.run (a synchronous fetch) is device,
+        # result reads host_sync; compile seconds steal themselves out via
+        # the jax.monitoring bridge — obs/goodput.py owns the bucket math
+        from .. import obs
+        from ..obs.goodput import maybe_bucket
+        gp = obs.goodput.open_ledger("v2_sgd")
+        try:
+            for pass_id in range(num_passes):
+                event_handler(EV.BeginPass(pass_id))
+                it = iter(reader())
+                batch_id = 0
+                while True:
+                    with maybe_bucket(gp, "host_input"):
+                        try:
+                            rows = next(it)
+                        except StopIteration:
+                            break
+                    # BeginIteration between the reader pull and the feed
+                    # conversion — exactly where the plain for-loop fired it
+                    event_handler(EV.BeginIteration(pass_id, batch_id))
+                    with maybe_bucket(gp, "host_input"):
+                        feed = feeder(rows) if feeder else rows
+                    with maybe_bucket(gp, "device"):
+                        outs = self.exe.run(feed=feed, fetch_list=fetches)
+                    with maybe_bucket(gp, "host_sync"):
+                        metrics = {e.var.name: float(np.asarray(o).mean())
+                                   for e, o in zip(self.extra, outs[1:])}
+                        cost = float(outs[0])
+                    event_handler(EV.EndIteration(pass_id, batch_id,
+                                                  cost, None, metrics))
+                    self._maybe_param_stats(batch_id)
+                    batch_id += 1
+                event_handler(EV.EndPass(pass_id))
+        finally:
+            if gp is not None:
+                gp.close()
 
     def _maybe_param_stats(self, batch_id: int):
         """--show_parameter_stats_period analog (TrainerInternal.cpp:80-87)

@@ -1,0 +1,66 @@
+"""Regenerate tests/fixtures/tiny.xplane.pb — a hand-built XSpace whose
+wire bytes exercise the whole off-TPU xplane pipeline (parse -> device
+planes -> site attribution -> chrome merge) without a TPU or xprof.
+
+The shape mimics a real TPU trace: one device plane with an "XLA Ops"
+line whose op names carry the fluid Executor's named-scope stamps
+(executor._scope_tag: b{B}_op{I}_{type}) the way XLA embeds scopes in
+fused op names, plus a nested module event (self-time computation), and
+a host plane that must NOT count as a device lane.
+
+Run from the repo root:  python tests/fixtures/make_xplane_fixture.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from paddle_tpu.obs.xplane import encode_xspace  # noqa: E402
+
+#: epoch anchor (2023-01-01 00:00:00 UTC) in ns — fixed so the fixture
+#: bytes are reproducible
+T0 = 1672531200 * 10**9
+
+PLANES = [
+    {"name": "/device:TPU:0",
+     "lines": [
+         {"name": "XLA Modules", "timestamp_ns": T0,
+          "events": [
+              # the module span CONTAINS every op below: its self time
+              # must come out as the uncovered 100us tail
+              {"name": "jit_train_step", "offset_ps": 0,
+               "duration_ps": 1_000_000_000},          # 1 ms
+          ]},
+         {"name": "XLA Ops", "timestamp_ns": T0,
+          "events": [
+              # scope-stamped ops (two sites, one op fused twice)
+              {"name": "fusion.7/b0_op3_mul.1", "offset_ps": 0,
+               "duration_ps": 400_000_000},            # 400 us
+              {"name": "fusion.7/b0_op3_mul.1", "offset_ps": 400_000_000,
+               "duration_ps": 200_000_000},            # 200 us
+              {"name": "custom-call.2/b1_op0_lstm_fused",
+               "offset_ps": 600_000_000,
+               "duration_ps": 250_000_000},            # 250 us
+              # an unstamped op: site must resolve to None
+              {"name": "copy.3", "offset_ps": 850_000_000,
+               "duration_ps": 50_000_000},             # 50 us
+          ]},
+     ]},
+    {"name": "/host:CPU",
+     "lines": [
+         {"name": "python", "timestamp_ns": T0,
+          "events": [
+              {"name": "PjitFunction(train_step)", "offset_ps": 0,
+               "duration_ps": 1_200_000_000},
+          ]},
+     ]},
+]
+
+if __name__ == "__main__":
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tiny.xplane.pb")
+    with open(out, "wb") as f:
+        f.write(encode_xspace(PLANES))
+    print(f"wrote {out} ({os.path.getsize(out)} bytes)")

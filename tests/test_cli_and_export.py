@@ -90,17 +90,22 @@ def test_lint_bench_rows_schema(tmp_path):
     good = tmp_path / "good.jsonl"
     good.write_text(
         json.dumps({"metric": "x_train_ms_per_batch", "value": 1.0,
-                    "unit": "ms", "vs_baseline": None, "mfu": 0.2}) + "\n"
+                    "unit": "ms", "vs_baseline": None, "mfu": 0.2,
+                    "methodology": "measured"}) + "\n"
         + json.dumps({"metric": "z_serve_daemon_tokens_per_sec",
                       "value": 9.0, "unit": "tok/s", "vs_baseline": None,
-                      "ttft_p50_ms": 12.0, "tpot_p50_ms": 3.0}) + "\n")
+                      "ttft_p50_ms": 12.0, "tpot_p50_ms": 3.0,
+                      "methodology": "measured"}) + "\n")
     bad = tmp_path / "bad.jsonl"
     bad.write_text(
         json.dumps({"metric": "y_decode_tokens_per_sec", "value": 5.0,
                     "unit": "tok/s", "vs_baseline": None}) + "\n"
         + json.dumps({"metric": "z_serve_daemon_tokens_per_sec",
                       "value": 9.0, "unit": "tok/s",
-                      "vs_baseline": None}) + "\n")
+                      "vs_baseline": None}) + "\n"
+        + json.dumps({"metric": "w_train_ms_per_batch", "value": 1.0,
+                      "unit": "ms", "vs_baseline": None, "mfu": 0.2,
+                      "methodology": "guessed"}) + "\n")
     out = _run("lint", "--bench-rows", str(good))
     assert "0 problem(s)" in out
     r = subprocess.run([sys.executable, "-m", "paddle_tpu", "lint",
@@ -111,6 +116,9 @@ def test_lint_bench_rows_schema(tmp_path):
     # the _serve_ family rule (PR 8): a serving row without its SLO pair
     # (ttft_p50_ms / tpot_p50_ms) is rejected
     assert "ttft_p50_ms" in r.stdout and "tpot_p50_ms" in r.stdout
+    # methodology is required on roofline/SLO rows and must be one of
+    # measured|modeled — on-chip vs projected stays distinguishable
+    assert "methodology" in r.stdout and "guessed" in r.stdout
 
 
 def test_cli_train_test_time_dump(config_file, tmp_path):

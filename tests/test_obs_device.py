@@ -1,0 +1,413 @@
+"""Device-time obs plane tests (ISSUE 9): goodput ledger bucket accounting
+under an injectable clock (no real sleeps), the roofline cost ledger +
+kernel-cost override of the zero-FLOP custom-call default, derived
+MFU/HBM-bw gauges, the xplane fixture parse -> chrome-merge round trip
+off-TPU, the L007 catalogue-drift lint, and the 2-pass CPU acceptance run
+(non-null goodput ratio + device FLOPs as a byproduct of just running).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis, obs
+from paddle_tpu.obs import goodput, roofline
+from paddle_tpu.obs import xplane as xp
+
+pytestmark = pytest.mark.obs
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "tiny.xplane.pb")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_derivers():
+    # derivation state is weak-keyed on the registry object; clear it
+    # anyway so a registry a test holds alive can't leak a baseline into
+    # the next test
+    roofline._reset_derivers()
+    yield
+    roofline._reset_derivers()
+
+
+def _manual_clock():
+    t = {"v": 0.0}
+    return (lambda: t["v"]), t
+
+
+# -- goodput ledger -------------------------------------------------------------
+
+def test_goodput_buckets_and_idle_under_fake_clock():
+    r = obs.MetricsRegistry()
+    clock, t = _manual_clock()
+    led = goodput.GoodputLedger(r, component="test", clock=clock).open()
+    t["v"] = 10.0
+    with led.bucket("host_input"):
+        t["v"] = 12.0                        # 2 s reading
+    with led.bucket("device"):
+        t["v"] = 17.0                        # 5 s dispatch+block
+    led.add("host_sync", 1.0)
+    t["v"] = 20.0
+    led.close()                              # wall 20, accounted 8 -> idle 12
+
+    def c(bucket):
+        return r.counter(f"goodput.{bucket}_seconds_total").get(
+            component="test")
+
+    assert c("host_input") == pytest.approx(2.0)
+    assert c("device") == pytest.approx(5.0)
+    assert c("host_sync") == pytest.approx(1.0)
+    assert c("compile") == 0.0
+    assert c("idle") == pytest.approx(12.0)
+    assert r.gauge("goodput.ratio").get(component="test") == \
+        pytest.approx(5.0 / 20.0)
+    with pytest.raises(ValueError, match="unknown goodput bucket"):
+        led.add("gpu", 1.0)
+
+
+def test_goodput_compile_steal_and_nested_buckets():
+    r = obs.MetricsRegistry()
+    clock, t = _manual_clock()
+    led = goodput.GoodputLedger(r, component="test", clock=clock).open()
+    with led.bucket("device"):
+        # a 3 s backend compile fires inside the 10 s device region: the
+        # wall second is counted ONCE — compile gets 3, device keeps 7
+        led.note_compile(3.0)
+        t["v"] = 10.0
+    with led.bucket("host_sync"):            # outer: 10 -> 18
+        with led.bucket("host_input"):       # inner: 10 -> 16
+            t["v"] = 16.0
+        t["v"] = 18.0
+    led.close()
+
+    def c(bucket):
+        return r.counter(f"goodput.{bucket}_seconds_total").get(
+            component="test")
+
+    assert c("compile") == pytest.approx(3.0)
+    assert c("device") == pytest.approx(7.0)
+    # the inner bucket's whole span is not the outer's own time
+    assert c("host_input") == pytest.approx(6.0)
+    assert c("host_sync") == pytest.approx(2.0)
+
+
+def test_goodput_note_compile_routes_to_open_ledger_only():
+    r = obs.MetricsRegistry()
+    clock, t = _manual_clock()
+    goodput.note_compile(5.0)                # none open: cheap no-op
+    led = goodput.GoodputLedger(r, component="test", clock=clock).open()
+    goodput.note_compile(2.0)                # module-level forwarder
+    led.close()
+    assert r.counter("goodput.compile_seconds_total").get(
+        component="test") == pytest.approx(2.0)
+    goodput.note_compile(9.0)                # closed again: dropped
+    assert r.counter("goodput.compile_seconds_total").get(
+        component="test") == pytest.approx(2.0)
+
+
+def test_open_ledger_is_none_without_session():
+    assert goodput.open_ledger("test") is None
+    with goodput.maybe_bucket(None, "device"):
+        pass                                 # the zero-cost path
+
+
+# -- roofline: peaks, kernel costs, derived gauges ------------------------------
+
+def test_kernel_cost_registry_overrides_zero_flop_default():
+    """The Pallas custom-call default is ZERO bytes to XLA; the registered
+    model is what every consumer resolves instead."""
+    assert "decode_attention" in roofline.registered_kernels()
+    assert "paged_decode_attention" in roofline.registered_kernels()
+    got = roofline.kernel_cost("decode_attention", batch=2, read=128,
+                               n_heads=4, d_head=8, layers=3, kv_dtype=None,
+                               itemsize=2)
+    assert got == 2.0 * 2 * 128 * (4 * 8 * 2) * 3      # k+v rows stream once
+    int8 = roofline.kernel_cost("decode_attention", batch=2, read=128,
+                                n_heads=4, d_head=8, layers=3,
+                                kv_dtype="int8", itemsize=2)
+    assert int8 == 2.0 * 2 * 128 * (4 * (8 + 4)) * 3   # 1 B/elt + f32 scale
+    assert roofline.kernel_cost("no_such_kernel", batch=1) is None
+
+
+def test_account_extra_bytes_reaches_device_counter():
+    r = obs.MetricsRegistry()
+    roofline.account(None, extra_bytes=1024.0, registry=r, now=0.0)
+    assert r.counter("fluid.device_bytes_total").get() == 1024.0
+    assert r.counter("fluid.device_flops_total").get() == 0.0
+
+
+def test_derived_gauges_from_counter_deltas(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PEAK_TFLOPS", "1")      # 1e12 FLOP/s
+    monkeypatch.setenv("PADDLE_TPU_PEAK_HBM_GBPS", "1")    # 1e9 B/s
+    r = obs.MetricsRegistry()
+    cost = roofline.Cost(flops=5e11, bytes=5e8)
+    roofline.account(cost, registry=r, now=0.0)            # baseline
+    roofline.account(cost, registry=r, now=1.0)            # 1 s window
+    assert r.gauge("roofline.mfu").get() == pytest.approx(0.5)
+    assert r.gauge("roofline.hbm_bw_util").get() == pytest.approx(0.5)
+
+
+def test_gauges_never_set_when_peak_unknown(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_PEAK_TFLOPS", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_PEAK_HBM_GBPS", raising=False)
+    if jax.devices()[0].device_kind != "cpu":
+        pytest.skip("on-TPU: peaks are known")
+    r = obs.MetricsRegistry()
+    roofline.account(roofline.Cost(flops=1e9, bytes=1e6), registry=r,
+                     now=0.0)
+    roofline.account(roofline.Cost(flops=1e9, bytes=1e6), registry=r,
+                     now=1.0)
+    names = {s["name"] for s in r.collect()}
+    # absence, not a fabricated zero: a dashboard reads null off-TPU
+    assert "roofline.mfu" not in names
+    assert "roofline.hbm_bw_util" not in names
+    assert "fluid.device_flops_total" in names
+
+
+def test_cost_instrumented_jit_ledger_and_accounting():
+    r = obs.MetricsRegistry()
+    wrapped = roofline.instrument(lambda x: x @ x, "test.step",
+                                  extra_bytes=lambda x: 1000.0)
+    x = jnp.ones((32, 32), jnp.float32)
+    with obs.ObsSession(registry=r).installed():
+        y = wrapped(x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x @ x))
+        wrapped(x)                            # same signature: one entry
+        wrapped(jnp.ones((16, 16), jnp.float32))
+    assert len(wrapped.ledger) == 2           # one executable per shape
+    cost = wrapped.cost_of(x)
+    assert cost is not None and cost.flops and cost.flops > 0
+    assert r.counter("fluid.device_flops_total").get() >= 2 * cost.flops
+    # the kernel-modeled extra bytes ride every accounted dispatch
+    assert r.counter("fluid.device_bytes_total").get() >= 3 * 1000.0
+
+
+def test_note_kernel_bytes_eager_vs_collected():
+    """Outside a trace collector a launch site counts its own bytes (one
+    call == one dispatch); inside one, the collector absorbs them and the
+    owner re-emits per dispatch."""
+    r = obs.MetricsRegistry()
+    with obs.ObsSession(registry=r).installed():
+        roofline.note_kernel_bytes("fake_kernel", 64.0)
+        assert r.counter("kernels.bytes_total").get(
+            kernel="fake_kernel") == 64.0
+        with roofline.collect_kernel_bytes() as col:
+            assert roofline.record_kernel_bytes("fake_kernel", 10.0)
+            roofline.note_kernel_bytes("fake_kernel", 5.0)
+        assert col.per_kernel == {"fake_kernel": 15.0}
+        # the site did NOT count while collected
+        assert r.counter("kernels.bytes_total").get(
+            kernel="fake_kernel") == 64.0
+    assert not roofline.record_kernel_bytes("fake_kernel", 1.0)
+
+
+def test_trace_collected_kernel_bytes_count_per_dispatch():
+    """A launch site runs once per TRACE; the instrumented jit re-emits
+    its collected bytes once per DISPATCH — per-trace counting would
+    undercount a run by the step count (the fused-RNN semantics bug)."""
+    r = obs.MetricsRegistry()
+
+    def step(x):
+        roofline.note_kernel_bytes("fake_kernel", 256.0)  # trace-time site
+        return x * 2.0
+
+    wrapped = roofline.instrument(step, "test.fake")
+    x = jnp.ones((4,), jnp.float32)
+    with obs.ObsSession(registry=r).installed():
+        for _ in range(3):
+            wrapped(x)
+    assert r.counter("kernels.bytes_total").get(
+        kernel="fake_kernel") == 3 * 256.0
+    assert r.counter("fluid.device_bytes_total").get() >= 3 * 256.0
+
+
+def test_executor_reemits_collected_kernel_bytes_per_run(monkeypatch):
+    """The fluid Executor collects note_kernel_bytes sites during its AOT
+    trace and re-emits them on every run() of the cached executable."""
+    from paddle_tpu.fluid.registry import OpRegistry
+    real = OpRegistry.get("relu")
+
+    def fake(ins, attrs):
+        roofline.note_kernel_bytes("fake_kernel", 128.0)
+        return real(ins, attrs)
+
+    monkeypatch.setitem(OpRegistry._ops, "relu", fake)
+    fluid.reset_default_programs()
+    x = fluid.layers.data("x", shape=(4,))
+    y = fluid.layers.relu(x)
+    exe = fluid.Executor(scope=fluid.Scope())
+    r = obs.MetricsRegistry()
+    xs = np.ones((2, 4), np.float32)
+    with obs.ObsSession(registry=r).installed():
+        for _ in range(3):
+            out, = exe.run(feed={"x": xs}, fetch_list=[y])
+    np.testing.assert_allclose(out, xs)
+    assert r.counter("kernels.bytes_total").get(
+        kernel="fake_kernel") == 3 * 128.0
+    assert r.counter("fluid.device_bytes_total").get() >= 3 * 128.0
+
+
+def test_cost_failure_warns_once_and_counts():
+    r = obs.MetricsRegistry()
+    roofline._warned_cost_failure = False
+    try:
+        with obs.ObsSession(registry=r).installed():
+            with pytest.warns(RuntimeWarning, match="cost analysis failed"):
+                from benchmarks.mfu import step_flops
+                assert step_flops(lambda: (_ for _ in ()).throw(
+                    ValueError("boom"))) is None
+            # second failure: counted, NOT warned again
+            assert step_flops("not even callable") is None
+    finally:
+        roofline._warned_cost_failure = False
+    assert r.counter("roofline.cost_analysis_failures_total").get() == 2
+
+
+# -- xplane: parse -> attribute -> merge ----------------------------------------
+
+def test_xplane_fixture_round_trip_parse():
+    space = xp.read_xspace(FIXTURE)
+    names = [p["name"] for p in space["planes"]]
+    assert names == ["/device:TPU:0", "/host:CPU"]
+    dev = xp.device_planes(space)
+    assert [p["name"] for p in dev] == ["/device:TPU:0"]
+    evs = xp.plane_events(dev[0])
+    assert {e["name"] for e in evs} >= {"fusion.7/b0_op3_mul.1",
+                                        "custom-call.2/b1_op0_lstm_fused",
+                                        "copy.3"}
+    # integer-ns timestamps: adjacent events must not mis-nest
+    mul = [e for e in evs if "b0_op3" in e["name"]]
+    assert mul[0]["dur_ns"] == 400_000 and mul[1]["dur_ns"] == 200_000
+
+
+def test_xplane_site_attribution_and_op_totals():
+    rows = xp.op_totals(xp.read_xspace(FIXTURE))
+    by_op = {r["op"]: r for r in rows}
+    mul = by_op["fusion.7/b0_op3_mul.1"]
+    assert mul["site"] == "block 0, op #3 (mul)"
+    assert mul["count"] == 2 and mul["self_ns"] == 600_000
+    cc = by_op["custom-call.2/b1_op0_lstm_fused"]
+    assert cc["site"] == "block 1, op #0 (lstm_fused)"
+    assert cc["self_ns"] == 250_000           # back-to-back, no nesting
+    assert by_op["copy.3"]["site"] is None    # unstamped op
+    # the "XLA Modules" envelope line and the host plane must not count
+    assert "jit_train_step" not in by_op
+    assert "PjitFunction(train_step)" not in by_op
+    # rows sort by self time descending — the profile CLI's top-k order
+    assert rows[0]["op"] == "fusion.7/b0_op3_mul.1"
+    report = xp.top_ops_report(xp.read_xspace(FIXTURE), topk=5, steps=2)
+    assert "block 0, op #3 (mul)" in report
+    assert "self ms/step" in report
+
+
+def test_xplane_chrome_merge_round_trip():
+    clock = [0.0]
+
+    def c():
+        clock[0] += 0.01
+        return clock[0]
+
+    r = obs.MetricsRegistry()
+    with obs.ObsSession(registry=r, clock=c).installed() as s:
+        with obs.span("trainer.step"):
+            obs.count("trainer.steps_total")
+    host = s.dump()
+    dev = xp.xplane_dump(xp.read_xspace(FIXTURE),
+                         anchor_unix=host["meta"].get("clock_origin_unix"))
+    assert dev["meta"]["processes"] == {str(xp.DEVICE_PID_BASE):
+                                       "/device:TPU:0"}
+    tr = obs.chrome_trace(obs.merge_dumps([host, dev]))
+    evs = tr["traceEvents"] if isinstance(tr, dict) else tr
+    names = {e.get("name") for e in evs}
+    assert "trainer.step" in names            # host span lane survives
+    assert any(n and "b0_op3_mul" in n for n in names)   # device op lane
+    site_args = {e["args"].get("site") for e in evs
+                 if e.get("args") and e["args"].get("site")}
+    assert "block 0, op #3 (mul)" in site_args
+    lanes = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert "/device:TPU:0" in lanes
+
+
+def test_xplane_encoder_decoder_inverse():
+    planes = [{"name": "/device:TPU:1",
+               "lines": [{"name": "XLA Ops", "timestamp_ns": 123,
+                          "events": [{"name": "dot.1", "offset_ps": 5000,
+                                      "duration_ps": 2000}]}]}]
+    space = xp.read_xspace(xp.encode_xspace(planes))
+    assert space["planes"][0]["name"] == "/device:TPU:1"
+    ev = space["planes"][0]["lines"][0]["events"][0]
+    assert ev["name"] == "dot.1"
+    assert ev["offset_ps"] == 5000 and ev["duration_ps"] == 2000
+
+
+# -- L007 catalogue drift -------------------------------------------------------
+
+def test_L007_tree_is_clean():
+    """The shipped tree: every emit site catalogued, no orphans — run in
+    the suite so drift fails CI, not a dashboard."""
+    assert analysis.lint_catalogue_drift() == []
+
+
+def test_L007_flags_both_directions(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        "def f(obs, reg):\n"
+        "    obs.count('bogus.thing_total')\n"
+        "    'a string'.count('x')\n"                 # not metric-shaped
+        "    reg.counter(f'family.{x}_seconds_total')\n")
+    catalogue = {"known.orphan_total": ("counter", "never emitted"),
+                 "family.a_seconds_total": ("counter", "f-string emitted")}
+    diags = analysis.lint_catalogue_drift(root=str(tmp_path),
+                                          catalogue=catalogue)
+    assert {d.code for d in diags} == {"L007"}
+    by_var = {d.var for d in diags}
+    assert "bogus.thing_total" in by_var       # undeclared emit site
+    assert "known.orphan_total" in by_var      # orphaned entry
+    # the f-string family anchors its entry; str.count noise is ignored
+    assert "family.a_seconds_total" not in by_var
+    assert "x" not in by_var
+
+
+# -- acceptance: 2-pass CPU training run ----------------------------------------
+
+def test_e2e_two_pass_train_derives_goodput_and_flops(tmp_path):
+    """ISSUE 9 acceptance: after a 2-pass CPU training run with obs
+    installed, `obs summary` shows a non-null goodput ratio and
+    fluid.device_flops_total > 0 — chip utilization as a byproduct of
+    just running."""
+    import paddle_tpu.v2 as paddle
+    fluid.reset_default_programs()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(x, 1)
+    cost = paddle.layer.square_error_cost(pred, y)
+    rs = np.random.RandomState(0)
+    rows = [[(rs.rand(4).astype(np.float32), rs.rand(1).astype(np.float32))
+             for _ in range(8)] for _ in range(3)]
+
+    r = obs.MetricsRegistry()
+    with obs.ObsSession(registry=r).installed() as s:
+        trainer = paddle.SGD(cost, paddle.optimizer.SGD(0.05))
+        trainer.train(lambda: iter(rows), num_passes=2, feeding=[x, y])
+        dump = s.dump()
+    assert r.counter("fluid.device_flops_total").get() > 0
+    ratio = r.gauge("goodput.ratio").get(component="v2_sgd")
+    assert ratio is not None and 0.0 < ratio <= 1.0
+    assert r.counter("goodput.device_seconds_total").get(
+        component="v2_sgd") > 0
+    # the wall second is counted once: every bucket is a timed sub-region
+    # of the window, so at close sum(buckets) == wall and the final ratio
+    # gauge is exactly device / sum
+    device = r.counter("goodput.device_seconds_total").get(
+        component="v2_sgd")
+    total = sum(
+        r.counter(f"goodput.{b}_seconds_total").get(component="v2_sgd")
+        for b in goodput.BUCKETS)
+    assert ratio == pytest.approx(device / total, rel=1e-3)
+    rep = obs.summary(dump)
+    assert "goodput.ratio" in rep
+    assert "fluid.device_flops_total" in rep

@@ -50,7 +50,7 @@ def test_lstm_grad(np_rng):
     check_grad(f, [x, w, u], wrt=2)
 
 
-def test_gru_masking_and_grad(np_rng):
+def test_gru_masking(np_rng):
     D, H = 2, 3
     w = np_rng.randn(D, 3 * H).astype(np.float32) * 0.3
     u = np_rng.randn(H, 3 * H).astype(np.float32) * 0.3
@@ -58,6 +58,18 @@ def test_gru_masking_and_grad(np_rng):
     lengths = np.array([5, 2], np.int32)
     out, h = rnn.gru(jnp.asarray(x), jnp.asarray(lengths), w, u)
     np.testing.assert_array_equal(np.asarray(out[1, 2:]), 0.0)
+
+
+# slow: central-difference GRU grad (31s) — the lstm_grad precedent;
+# analytic masked-grad parity (scan vs fused, lengths in-loop) stays
+# tier-1 in test_pallas.py::test_gru_fused_backward_kernel_matches_scan_grads
+@pytest.mark.slow
+def test_gru_grad(np_rng):
+    D, H = 2, 3
+    w = np_rng.randn(D, 3 * H).astype(np.float32) * 0.3
+    u = np_rng.randn(H, 3 * H).astype(np.float32) * 0.3
+    x = np_rng.randn(2, 5, D).astype(np.float32)
+    lengths = np.array([5, 2], np.int32)
 
     def f(xx, ww):
         o, _ = rnn.gru(jnp.asarray(xx), jnp.asarray(lengths), ww, u)

@@ -38,6 +38,11 @@ def _solo(model, params, prompt, steps, _bucket=12):
     return np.asarray(out)[0, len(prompt):len(prompt) + steps]
 
 
+# slow: 55s of solo re-decodes; pinned==solo holds transitively tier-1
+# via test_serving_paged.py (paged==solo AND paged==pinned on the same
+# mixed workload), and fifo/longest_first parity below keeps the pinned
+# batcher exercised
+@pytest.mark.slow
 def test_continuous_batching_matches_solo_decode(model_and_params):
     model, params = model_and_params
     rs = np.random.RandomState(3)
