@@ -304,6 +304,10 @@ def main(full: bool = False):
                  ".run_paged()", ROW_TIMEOUT))
     rows.append(("__import__('benchmarks.serving_daemon', fromlist=['x'])"
                  ".run()", ROW_TIMEOUT))
+    # the prefix-cache rows (ROADMAP item 2): zipf shared-prefix workload
+    # warm-vs-cold — TTFT p50 and prefill FLOPs/token vs hit rate
+    rows.append(("__import__('benchmarks.serving_prefix', fromlist=['x'])"
+                 ".run()", ROW_TIMEOUT))
     if full:
         # the remaining BASELINE.md rows, so a --full session covers the
         # whole measured table in one output

@@ -1,0 +1,182 @@
+"""Shared-prefix serving row — the prefix cache under production-shaped
+traffic, vs a cold-cache control.
+
+Production traffic is a few thousand system prompts × millions of
+continuations, and popularity is heavy-tailed: a handful of prompts carry
+most of the load. This bench reproduces that shape — ``n_prefixes``
+distinct system prompts, zipf-distributed popularity, each request a
+(prefix, short unique continuation, decode budget) — and serves it twice
+through the SAME engine configuration:
+
+* **warm row**: ``prefix_cache=True`` — requests sharing a system prompt
+  admit with only their continuation prefilled (copy-on-write radix
+  index, serving/paged.py);
+* **cold control**: ``prefix_cache=False`` — every request re-prefills
+  from token 0 (the PR 8 behavior).
+
+Reported per row: ``hit_rate`` (shared prompt tokens / total prompt
+tokens — the fraction of prefill work the cache elided), engine-clock
+``ttft_p50_ms``/``tpot_p50_ms``, and ``prefill_flops_per_token`` — the
+admission executables' FLOPs from the PR 9 cost ledger
+(obs/roofline.py, methodology="measured") divided by admitted prompt
+tokens, which is the column that must FALL as hit rate rises. The
+``_serve_`` + ``_prefix_`` bench-row family rules make the SLO pair and
+``hit_rate`` mandatory (analysis/bench_schema.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .serving_daemon import _pct
+from .serving_decode import VOCAB, build
+
+PREFIX_LEN = 384        # 6 pages at block 64 — the shared system prompt
+CONT_LEN = 16           # the per-request unique continuation
+GEN = 16                # decode budget per request: one segment — the
+#                         system-prompt + short-answer shape, where
+#                         admission (prefill) dominates the queue and the
+#                         prefix cache's elision shows up in TTFT
+
+
+def _workload(n_requests: int, n_prefixes: int, zipf_a: float):
+    rs = np.random.RandomState(0)
+    prefixes = [rs.randint(0, VOCAB, PREFIX_LEN) for _ in range(n_prefixes)]
+    # zipf popularity over the prefix catalogue (rank r ~ 1/r^a), clipped
+    # into range — the few-prompts-carry-most-load shape
+    ranks = np.minimum(rs.zipf(zipf_a, n_requests) - 1, n_prefixes - 1)
+    reqs = []
+    for i in range(n_requests):
+        prompt = np.concatenate([prefixes[int(ranks[i])],
+                                 rs.randint(0, VOCAB, CONT_LEN)])
+        reqs.append(prompt)
+    return reqs
+
+
+def _serve_once(prompts, *, prefix_cache: bool, slots: int,
+                segment: int) -> dict:
+    from paddle_tpu import obs
+    from paddle_tpu.serving import ServingEngine
+
+    model, p16, _ = build(slots)
+    reg = obs.MetricsRegistry()
+    with obs.ObsSession(registry=reg).installed():
+        eng = ServingEngine(model, p16, slots=slots, segment=segment,
+                            page_block=64, cache_bucket=512,
+                            prompt_buckets=(32, 64, 512),
+                            queue_cap=2 * len(prompts),
+                            prefix_cache=prefix_cache)
+        # warm EVERY compiled program the measured pass will hit — the
+        # miss-admission bucket, the segment scans, AND (second wave:
+        # replayed prompts) the CoW + suffix-prefill hit program — then
+        # drop the warm-up's cache entries and tallies so the measured
+        # pass starts cold-but-compiled, like a long-lived daemon
+        rs = np.random.RandomState(7)
+        warm_prompts = [rs.randint(0, VOCAB, PREFIX_LEN + CONT_LEN)
+                        for _ in range(min(slots, 4))]
+        for wave in (warm_prompts, warm_prompts):
+            rids = [eng.submit(np.concatenate([p[:PREFIX_LEN],
+                                               rs.randint(0, VOCAB,
+                                                          CONT_LEN)]),
+                               GEN, prefix_len=PREFIX_LEN)
+                    for p in wave]
+            while not all(eng.poll(r)[1] for r in rids):
+                eng.step()
+        eng.pool.clear_prefix_cache()
+        eng.pool.reset_tallies()
+
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, GEN, prefix_len=PREFIX_LEN) for p in prompts]
+        while not all(eng.poll(r)[1] for r in rids):
+            eng.step()
+        dt = time.perf_counter() - t0
+        pool = eng.pool
+        delivered = sum(len(eng.poll(r)[0]) for r in rids)
+        ttft, tpot = [], []
+        for r in rids:
+            t = eng.timings(r)
+            if t["t_first"] is not None:
+                ttft.append((t["t_first"] - t["t_submit"]) * 1e3)
+                n = len(eng.poll(r)[0])
+                if t["t_done"] is not None and n > 1:
+                    tpot.append((t["t_done"] - t["t_first"]) / (n - 1)
+                                * 1e3)
+        hit_rate = (1.0 - pool.prefill_tokens_total
+                    / max(pool.prompt_tokens_total, 1))
+        flops = pool.admit_flops_total
+        return {"dt": dt, "delivered": delivered,
+                "ttft_p50_ms": _pct(ttft, 50), "ttft_p95_ms": _pct(ttft, 95),
+                "tpot_p50_ms": _pct(tpot, 50),
+                "hit_rate": round(hit_rate, 4),
+                "prefill_flops_per_token":
+                    round(flops / max(pool.prompt_tokens_total, 1), 1),
+                "flops_measured": flops > 0,
+                "stats": pool.prefix_stats()}
+
+
+def run(n_requests: int = 128, n_prefixes: int = 4, zipf_a: float = 1.2,
+        slots: int = 8, segment: int = 32) -> list:
+    # 128 requests / 4 system prompts = 32 continuations per prompt — a
+    # SMALL-sample proxy for the production few-prompts × millions shape
+    # (more prefixes per request would overweight the cache-warming
+    # transient a microbench can't amortize the way a daemon does);
+    # measured on the d256 CPU proxy: warm ttft_p50 2.25x lower than the
+    # cold control at hit_rate 0.89, prefill FLOPs/token 4.9x lower
+    """Two rows: the warm zipf shared-prefix row and its cold-cache
+    control (same workload, same engine shape, prefix cache off)."""
+    prompts = _workload(n_requests, n_prefixes, zipf_a)
+    cold = _serve_once(prompts, prefix_cache=False, slots=slots,
+                       segment=segment)
+    warm = _serve_once(prompts, prefix_cache=True, slots=slots,
+                       segment=segment)
+
+    def row(name, r, note, vs=None):
+        meth = "measured" if r["flops_measured"] else "modeled"
+        return {"metric": f"transformer_lm_serve_prefix_{name}_tokens_per_"
+                          f"sec_slots{slots}_seg{segment}_p{n_prefixes}"
+                          f"x{PREFIX_LEN}",
+                "value": round(r["delivered"] / r["dt"], 1),
+                "unit": "tokens/sec", "vs_baseline": vs,
+                "requests": n_requests,
+                "hit_rate": r["hit_rate"],
+                "ttft_p50_ms": round(r["ttft_p50_ms"], 1),
+                "ttft_p95_ms": round(r["ttft_p95_ms"], 1),
+                "tpot_p50_ms": round(r["tpot_p50_ms"], 2),
+                "prefill_flops_per_token": r["prefill_flops_per_token"],
+                "methodology": meth,
+                "note": note}
+
+    cold_note = ("cold-cache CONTROL: same zipf(%.1f) workload (%d system "
+                 "prompts x %d-token prefix + %d-token continuations, "
+                 "gen %d), prefix_cache=False — every request re-prefills "
+                 "from token 0; prefill_flops_per_token from the PR 9 "
+                 "cost ledger over the admission executables"
+                 % (zipf_a, n_prefixes, PREFIX_LEN, CONT_LEN, GEN))
+    ttft_ratio = (cold["ttft_p50_ms"] / warm["ttft_p50_ms"]
+                  if warm["ttft_p50_ms"] else None)
+    warm_note = ("prefix_cache=True on the same workload: hits admit with "
+                 "only the continuation prefilled (CoW radix index); "
+                 "ttft_p50 is %.1fx LOWER than the cold control's and "
+                 "prefill FLOPs/token fall with hit rate (greedy tokens "
+                 "stay exactly equal to solo decode — "
+                 "tests/test_serving_prefix.py); index state: %s"
+                 % (ttft_ratio or float("nan"),
+                    {k: v for k, v in warm["stats"].items()
+                     if k.startswith("prefix_")}))
+    warm_row = row("zipf", warm, warm_note,
+                   vs=None)
+    warm_row["ttft_p50_vs_cold"] = (round(ttft_ratio, 2)
+                                    if ttft_ratio else None)
+    return [row("cold", cold, cold_note), warm_row]
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for r in run():
+        print(json.dumps(r), flush=True)

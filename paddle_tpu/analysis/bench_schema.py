@@ -20,7 +20,11 @@ Family rules key on the metric NAME, which is itself part of the contract
   roofline column is bandwidth, not FLOPs (target >= 0.30);
 * ``*_serve_*`` rows: ``ttft_p50_ms`` + ``tpot_p50_ms`` — a serving row
   without its SLO pair is throughput theater (time-to-first-token and
-  time-per-output-token are what callers experience; PR 8's daemon rows).
+  time-per-output-token are what callers experience; PR 8's daemon rows);
+* ``*_prefix_*`` rows additionally: ``hit_rate`` — a prefix-cache row
+  whose speedup is not conditioned on its measured hit rate is
+  unreproducible (a serve+prefix metric name matches BOTH families, so
+  the SLO pair stays mandatory too; benchmarks/serving_prefix.py).
 """
 
 from __future__ import annotations
@@ -40,6 +44,7 @@ FAMILY_REQUIRED = {
     "_train_": ("mfu", "methodology"),
     "_decode_": ("hbm_bw_util", "methodology"),
     "_serve_": ("ttft_p50_ms", "tpot_p50_ms", "methodology"),
+    "_prefix_": ("hit_rate",),
 }
 
 #: the only legal methodology stamps

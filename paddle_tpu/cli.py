@@ -1023,7 +1023,11 @@ def cmd_serve(args):
             page_block=args.page_block, pages=args.pages,
             cache_bucket=args.cache_bucket, kv_dtype=args.kv_dtype,
             queue_cap=args.queue_cap,
-            default_timeout_s=args.request_timeout)
+            default_timeout_s=args.request_timeout,
+            prefix_cache=not args.no_prefix_cache,
+            class_weights={"interactive": args.interactive_weight,
+                           "batch": args.batch_weight},
+            max_tenants=args.max_tenants)
     except ValueError as e:
         # bad flag combinations (page_block not dividing max_len, a
         # cache_bucket off the page grid, ...) get the same structured
@@ -1051,7 +1055,10 @@ def cmd_serve(args):
     print(f"SERVING {host} {port}", flush=True)
     print(f"  slots={args.slots} segment={args.segment} "
           f"page_block={args.page_block} "
-          f"pages={engine.pool.pages} queue_cap={args.queue_cap}"
+          f"pages={engine.pool.pages} queue_cap={args.queue_cap} "
+          f"prefix_cache={'off' if args.no_prefix_cache else 'on'} "
+          f"weights=interactive:{args.interactive_weight:g}/"
+          f"batch:{args.batch_weight:g}"
           + (f" kv_dtype={args.kv_dtype}" if args.kv_dtype else ""),
           flush=True)
     import threading
@@ -1307,6 +1314,20 @@ def main(argv=None) -> int:
     sv.add_argument("--cache_bucket", type=int, default=256)
     sv.add_argument("--kv_dtype", choices=["int8"], default=None)
     sv.add_argument("--queue_cap", type=int, default=64)
+    sv.add_argument("--no_prefix_cache", action="store_true",
+                    help="disable the copy-on-write prefix radix index "
+                    "(default ON for the daemon: requests sharing a "
+                    "prompt prefix share KV pages and prefill only the "
+                    "suffix; docs/design/serving.md)")
+    sv.add_argument("--interactive_weight", type=float, default=4.0,
+                    help="weighted-fair service share of slo=interactive "
+                    "requests vs slo=batch (deficit scheduling at slot "
+                    "assignment)")
+    sv.add_argument("--batch_weight", type=float, default=1.0)
+    sv.add_argument("--max_tenants", type=int, default=32,
+                    help="distinct tenant labels this daemon will mint "
+                    "metric series for (bounded-cardinality contract; "
+                    "further tenants are refused at submit)")
     sv.add_argument("--request_timeout", type=float, default=None,
                     help="default per-request deadline (seconds); "
                     "timed-out requests free their slot and pages")

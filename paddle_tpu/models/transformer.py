@@ -376,6 +376,114 @@ class TransformerLM(nn.Module):
                   if self.tie_head else self.head(params["head"], x))
         return logits[:, 0], new_cell
 
+    def prefill_paged(self, params, pools, tokens, offsets, lengths,
+                      tables):
+        """Prefill FROM AN OFFSET against pre-populated block tables — the
+        prefix-cache admission path (serving/paged.py): each sample's
+        first ``offsets[b]`` positions already sit in shared pool pages,
+        so only the non-shared suffix ``tokens[b, :lengths[b]]`` runs the
+        forward.
+
+        tokens [B, S] int32 (right-padded suffixes); offsets/lengths [B]
+        int32; tables [B, NB] int32 covering positions
+        ``0 .. offsets[b] + lengths[b] - 1`` (entries past a sample's
+        live pages point at the null page; callers guarantee suffix
+        positions land in SLOT-OWNED pages — shared pages are never
+        written). ``pools`` is the page-pool dict (``k{i}``/``v{i}``
+        [P, bs, H, Dh], plus ``*_scale`` for int8). Returns
+        (new pools, last logits [B, V] — logits at each sample's final
+        suffix position, the admission's first-token source).
+
+        Numerics: each layer scatters the suffix k/v rows into the pool
+        (quantized for int8 pools), then attends q over the gathered
+        per-sample view with the suffix's OWN rows overlaid at full
+        precision — exactly the precision mix the dense admission prefill
+        has (own-prompt attention full precision, only the cache READ
+        quantized). The masked-softmax math mirrors
+        ``ops.pallas_kernels._dense_attention``'s op order so a zero-
+        offset suffix prefill reproduces the full-prefill formulation;
+        attending the shared prefix re-reads the very rows the original
+        prefill wrote. Garbage (padded i >= length, table nulls, stale
+        CoW rows past the match) sits strictly above the causal mask
+        ``j <= offset + i`` or is overlaid, and masked rows contribute
+        exactly zero (``exp(-1e30 - m) == 0``)."""
+        B, S = tokens.shape
+        bs = pools["k0"].shape[1]
+        NB = tables.shape[1]
+        L = NB * bs
+        quant = "k0_scale" in pools
+        offsets = jnp.asarray(offsets, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        iota = jnp.arange(S, dtype=jnp.int32)
+        positions = offsets[:, None] + iota[None, :]            # [B, S]
+        live = iota[None, :] < lengths[:, None]                 # [B, S]
+        # scatter targets: padded rows (and any position past the table)
+        # land in the reserved null page 0 — the drained-write convention
+        gpos = jnp.clip(positions, 0, self.max_len - 1)
+        blk_idx = jnp.clip(gpos // bs, 0, NB - 1)
+        pages = jnp.where(live,
+                          jnp.take_along_axis(tables, blk_idx, axis=1), 0)
+        rows = gpos % bs
+        # read-side overlay index: global position j maps to suffix row
+        # j - offset (clipped; selected only where own_mask holds)
+        jpos = jnp.arange(L, dtype=jnp.int32)
+        rel = jpos[None, :] - offsets[:, None]                  # [B, L]
+        own = (rel >= 0) & (rel < lengths[:, None])
+        rel_c = jnp.clip(rel, 0, S - 1)
+        # [B, 1, S, L]: query at global position offset+i sees keys j <=
+        # offset+i — broadcastable over the heads axis of the score tensor
+        causal = (jpos[None, None, None, :]
+                  <= positions[:, None, :, None])
+
+        def read(pool_q, scale_pool, own_rows):
+            g = pk.gather_pages(pool_q, tables).astype(jnp.float32)
+            if scale_pool is not None:
+                g = g * pk.gather_pages(scale_pool, tables)[..., None]
+            o = jnp.take_along_axis(
+                own_rows.astype(jnp.float32),
+                jnp.broadcast_to(rel_c[:, :, None, None],
+                                 (B, L) + own_rows.shape[2:]), axis=1)
+            return jnp.where(own[:, :, None, None], o, g)
+
+        x = self.embed(params["embed"], tokens)                 # [B, S, D]
+        x = x + params["pos_embed"][gpos].astype(x.dtype)
+        new_pools = dict(pools)
+        for i in range(len(self.blocks)):
+            blk = self.blocks[i]
+            q, k, v = blk.heads(params[f"blocks_{i}"], x)       # [B, S, H, Dh]
+            if quant:
+                k8, ks = pk.quantize_kv(k)
+                v8, vs = pk.quantize_kv(v)
+                new_pools[f"k{i}_scale"] = \
+                    new_pools[f"k{i}_scale"].at[pages, rows].set(ks)
+                new_pools[f"v{i}_scale"] = \
+                    new_pools[f"v{i}_scale"].at[pages, rows].set(vs)
+                kw, vw = k8, v8
+            else:
+                kw, vw = k, v
+            new_pools[f"k{i}"] = new_pools[f"k{i}"].at[pages, rows].set(
+                kw.astype(new_pools[f"k{i}"].dtype))
+            new_pools[f"v{i}"] = new_pools[f"v{i}"].at[pages, rows].set(
+                vw.astype(new_pools[f"v{i}"].dtype))
+            kr = read(new_pools[f"k{i}"],
+                      new_pools.get(f"k{i}_scale"), k)          # [B, L, H, Dh]
+            vr = read(new_pools[f"v{i}"],
+                      new_pools.get(f"v{i}_scale"), v)
+            # op order mirrors _dense_attention: einsum, * scale, mask,
+            # jax.nn.softmax, einsum, astype — zero-offset calls reproduce
+            # the full-prefill formulation bit for bit on the CPU route
+            s = jnp.einsum("bthd,bjhd->bhtj", q.astype(jnp.float32),
+                           kr) * blk.d_head ** -0.5
+            s = jnp.where(causal, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhtj,bjhd->bthd", p, vr).astype(q.dtype)
+            x = blk.finish(params[f"blocks_{i}"], x, o)
+        x = self.ln_f(params["ln_f"], x)
+        logits = (x @ params["embed"]["w"].T.astype(x.dtype)
+                  if self.tie_head else self.head(params["head"], x))
+        last = jnp.clip(lengths - 1, 0, S - 1)
+        return new_pools, logits[jnp.arange(B), last]
+
     def verify_step(self, params, cell, tokens, *,
                     cache_len: Optional[int] = None):
         """Multi-token incremental step — the speculative-decoding verify:

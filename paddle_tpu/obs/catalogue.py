@@ -237,12 +237,17 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "rpc.giveups_total": ("counter", "retry budgets exhausted"),
     "rpc.backoff_seconds_total": ("counter", "total backoff delay slept"),
     # -- serving: serving/engine.py, serving/paged.py -------------------
+    # tenant labels are BOUNDED by contract: values are charset-validated
+    # at submit (serving/batcher.py TENANT_RE) and the engine caps the
+    # number of distinct tenants it mints series for (max_tenants,
+    # default 32 — the L005 live-sample cardinality ceiling)
     "serving.requests_total": ("counter", "requests finished, labels: "
                                           "outcome (length | eos | "
                                           "cancelled | timeout | error — "
                                           "error = the engine failed and "
-                                          "abandoned it)",
-                               ("outcome",)),
+                                          "abandoned it), tenant "
+                                          "(bounded; see above)",
+                               ("outcome", "tenant")),
     "serving.rejected_total": ("counter", "submissions refused structured "
                                           "at admission, labels: reason "
                                           "(overloaded = queue cap; "
@@ -258,13 +263,47 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "serving.page_occupancy": ("gauge", "live tokens / allocated page "
                                         "capacity — 1.0 means HBM holds "
                                         "only live tokens (the paged-"
-                                        "cache residency win)"),
+                                        "cache residency win). The "
+                                        "prefix cache moves it BOTH "
+                                        "ways: N readers over one "
+                                        "shared page push it past 1.0, "
+                                        "while retained COLD cache "
+                                        "pages sit in the denominator "
+                                        "and drag a lightly-loaded "
+                                        "warm daemon toward 0 — low "
+                                        "occupancy + high prefix_pages "
+                                        "is healthy retention, not a "
+                                        "leak"),
+    "serving.prefix_hits_total": ("counter", "admissions that matched the "
+                                             "prefix radix index and "
+                                             "prefilled only their "
+                                             "non-shared suffix, labels: "
+                                             "tenant (bounded; see above)",
+                                  ("tenant",)),
+    "serving.prefix_misses_total": ("counter", "admissions that found no "
+                                               "shared prefix and ran the "
+                                               "full prefill, labels: "
+                                               "tenant (bounded)",
+                                    ("tenant",)),
+    "serving.prefix_pages_shared": ("gauge", "prefix-index pages pinned "
+                                             "by >= 1 live request (a "
+                                             "page read by N requests "
+                                             "counts once — the "
+                                             "refcounted-sharing win)"),
+    "serving.prefix_evictions_total": ("counter", "cold prefix-cache "
+                                                  "entries evicted back "
+                                                  "to the free list "
+                                                  "(lowest decayed "
+                                                  "measured-reuse score "
+                                                  "first)"),
     "serving.ttft_seconds": ("histogram", "submit -> first token (queueing "
                                           "+ prefill) — the SLO pair's "
-                                          "first half"),
+                                          "first half, labels: tenant "
+                                          "(bounded)", ("tenant",)),
     "serving.tpot_seconds": ("histogram", "per-output-token time after "
                                           "the first (completion - first "
-                                          "token) / (n - 1)"),
+                                          "token) / (n - 1), labels: "
+                                          "tenant (bounded)", ("tenant",)),
     # -- trainer: trainer/trainer.py ------------------------------------
     "trainer.steps_total": ("counter", "train batches executed"),
     "trainer.examples_total": ("counter", "samples consumed (leading dim "

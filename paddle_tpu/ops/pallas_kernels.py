@@ -1394,6 +1394,23 @@ def _paged_decode_attention_bytes(*, batch, pages, page_block, n_heads,
                                    itemsize=itemsize, steps=steps)
 
 
+def _paged_prefill_attention_bytes(*, batch, pages, page_block, n_heads,
+                                   d_head, layers=1, kv_dtype=None,
+                                   itemsize=2):
+    """HBM bytes of one prefix-HIT admission dispatch
+    (TransformerLM.prefill_paged): each sample's read view gathers its
+    ``pages`` table-named pages once per layer — the shared-prefix read
+    that replaces re-prefilling those positions. The suffix k/v WRITES
+    ride the executable's own XLA byte analysis; only the gathered cache
+    read needs a hand model (same shape as the paged decode read at
+    steps=1)."""
+    return _paged_decode_attention_bytes(batch=batch, pages=pages,
+                                         page_block=page_block,
+                                         n_heads=n_heads, d_head=d_head,
+                                         layers=layers, kv_dtype=kv_dtype,
+                                         itemsize=itemsize, steps=1)
+
+
 def _lstm_sequence_fused_bytes(*, batch, seq_len, hidden, itemsize=4,
                                gates=4):
     """HBM bytes of one fused-RNN forward launch: the [B, T, G*H] gate
@@ -1411,6 +1428,8 @@ def _register_cost_models():
                                   _decode_attention_bytes)
     roofline.register_kernel_cost("paged_decode_attention",
                                   _paged_decode_attention_bytes)
+    roofline.register_kernel_cost("paged_prefill_attention",
+                                  _paged_prefill_attention_bytes)
     roofline.register_kernel_cost("lstm_sequence_fused",
                                   _lstm_sequence_fused_bytes)
     roofline.register_kernel_cost(

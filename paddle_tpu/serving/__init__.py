@@ -8,6 +8,9 @@ Layers (each file is one altitude):
 * :mod:`.paged` — the paged KV-cache: a shared page pool + per-request
   block tables, so HBM holds live tokens instead of padding
   (:class:`PagePool`, :class:`PagedBatcher`).
+* :mod:`.prefix` — the copy-on-write radix index over the paged pool
+  (:class:`PrefixIndex`): requests sharing a prompt prefix share full KV
+  pages refcounted, and admission prefills only the non-shared suffix.
 * :mod:`.engine` — :class:`ServingEngine`: the long-lived scheduler with
   submit/poll/cancel, admission control + backpressure, cancel/timeout
   page reclamation, and TTFT/TPOT SLO telemetry.
@@ -20,12 +23,14 @@ ContinuousBatcher``) — PR 8 turned the module into a package without
 moving any public name.
 """
 
-from .batcher import (ContinuousBatcher, Request, SpeculativeDecoder,
-                      validate_request)
+from .batcher import (SLO_CLASSES, ContinuousBatcher, Request,
+                      SpeculativeDecoder, validate_request)
 from .daemon import ServingClient, ServingDaemon
 from .engine import Overloaded, ServingEngine
 from .paged import PagedBatcher, PagePool
+from .prefix import PrefixIndex
 
 __all__ = ["ContinuousBatcher", "Request", "SpeculativeDecoder",
-           "validate_request", "PagePool", "PagedBatcher", "ServingEngine",
-           "Overloaded", "ServingDaemon", "ServingClient"]
+           "validate_request", "PagePool", "PagedBatcher", "PrefixIndex",
+           "SLO_CLASSES", "ServingEngine", "Overloaded", "ServingDaemon",
+           "ServingClient"]

@@ -25,6 +25,7 @@ to running it alone through ``generate_cached`` (tests/test_serving.py).
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -36,14 +37,37 @@ from .. import obs
 from ..core.lod import bucket_length
 
 
+#: SLO classes a request may declare — the weighted-fair scheduler's queue
+#: key (serving/engine.py). "interactive" is the latency class (chat,
+#: completions a human is watching); "batch" the throughput class
+#: (offline eval, bulk scoring) that yields slots under contention.
+SLO_CLASSES = ("interactive", "batch")
+
+#: the bounded-cardinality contract for the ``tenant`` metric label: a
+#: short identifier from a closed alphabet (no path separators, no
+#: payloads), so per-tenant `serving.*` series stay a bounded enum the
+#: L005 lint's value heuristics accept. The engine additionally caps the
+#: number of DISTINCT tenants it will mint series for (max_tenants).
+TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,31}$")
+
+
 @dataclass
 class Request:
     """One generation request: prompt ids, generation budget, optional EOS
-    (generation stops BEFORE emitting eos_id; it is not returned)."""
+    (generation stops BEFORE emitting eos_id; it is not returned).
+
+    ``tenant``/``slo`` feed multi-tenant scheduling + per-tenant metric
+    labels; ``prefix_len`` (optional) declares how many leading prompt
+    tokens are a SHARED prefix (a system prompt) — the prefix cache only
+    INSERTS blocks inside the declared span, so one-off continuations
+    never pollute the radix index (matching is always attempted)."""
     rid: int
     prompt: np.ndarray
     max_new: int
     eos_id: Optional[int] = None
+    tenant: str = "default"
+    slo: str = "interactive"
+    prefix_len: Optional[int] = None
 
 
 def validate_request(r: Request, model) -> None:
@@ -68,6 +92,24 @@ def validate_request(r: Request, model) -> None:
     if r.prompt.size + 1 > model.max_len:
         raise ValueError(f"{who}: prompt longer than max_len "
                          f"{model.max_len}")
+    if not TENANT_RE.match(str(r.tenant)):
+        # the tenant value becomes a metric LABEL: an unbounded / path-like
+        # value here would mint unbounded series (the L005 cardinality
+        # failure mode) — refuse structured at submit, not at scrape
+        raise ValueError(
+            f"{who}: tenant {str(r.tenant)[:40]!r} violates the bounded-"
+            "cardinality label contract (need [A-Za-z0-9][A-Za-z0-9._-]"
+            "{0,31})")
+    if r.slo not in SLO_CLASSES:
+        raise ValueError(f"{who}: unknown slo class {r.slo!r} "
+                         f"(one of {SLO_CLASSES})")
+    if r.prefix_len is not None:
+        if int(r.prefix_len) < 0 or int(r.prefix_len) > r.prompt.size:
+            raise ValueError(
+                f"{who}: declared prefix_len {r.prefix_len} outside the "
+                f"prompt (len {r.prompt.size}) — a shared prefix cannot "
+                "be longer than the prompt that carries it")
+        r.prefix_len = int(r.prefix_len)
 
 
 def clip_emission(row, left: int, eos_id: Optional[int]):

@@ -171,9 +171,13 @@ class ServingDaemon:
             max_new = int(req.get("max_new", 0))
             eos = req.get("eos_id")
             timeout = req.get("timeout_s")
+            prefix = req.get("prefix_len")
             rid = self.engine.submit(
                 prompt, max_new, eos_id=None if eos is None else int(eos),
-                timeout_s=None if timeout is None else float(timeout))
+                timeout_s=None if timeout is None else float(timeout),
+                tenant=str(req.get("tenant", "default")),
+                slo=str(req.get("slo", "interactive")),
+                prefix_len=None if prefix is None else int(prefix))
         except Overloaded as e:
             return {"ok": False, "error": f"overloaded: {e}",
                     "code": "overloaded", "retry_after_s": e.retry_after_s}
@@ -225,10 +229,15 @@ class ServingClient(_RpcClient):
     _rpc_name = "serving rpc"
 
     def submit(self, prompt, max_new: int, *, eos_id: Optional[int] = None,
-               timeout_s: Optional[float] = None) -> int:
+               timeout_s: Optional[float] = None, tenant: str = "default",
+               slo: str = "interactive",
+               prefix_len: Optional[int] = None) -> int:
         # submit_key makes the op idempotent across the transport's
         # at-least-once retry: a lost reply re-sends the SAME key and the
-        # daemon answers with the original rid instead of admitting twice
+        # daemon answers with the original rid instead of admitting twice.
+        # tenant/slo ride the wire into the weighted-fair scheduler and
+        # the per-tenant SLO labels; prefix_len declares the shared-
+        # prefix span worth caching (docs/design/serving.md)
         req = {"op": "srv_submit",
                "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
                "max_new": int(max_new),
@@ -237,6 +246,12 @@ class ServingClient(_RpcClient):
             req["eos_id"] = int(eos_id)
         if timeout_s is not None:
             req["timeout_s"] = float(timeout_s)
+        if tenant != "default":
+            req["tenant"] = str(tenant)
+        if slo != "interactive":
+            req["slo"] = str(slo)
+        if prefix_len is not None:
+            req["prefix_len"] = int(prefix_len)
         r = self._call(req)
         if not r.get("ok"):
             if r.get("code") == "overloaded":
@@ -271,6 +286,9 @@ class ServingClient(_RpcClient):
     def submit_with_backoff(self, prompt, max_new: int, *,
                             eos_id: Optional[int] = None,
                             timeout_s: Optional[float] = None,
+                            tenant: str = "default",
+                            slo: str = "interactive",
+                            prefix_len: Optional[int] = None,
                             policy: Optional[RetryPolicy] = None) -> int:
         """Submit, retrying structured ``overloaded`` refusals — the client
         half of the backpressure contract. Each retry sleeps the LONGER of
@@ -285,7 +303,8 @@ class ServingClient(_RpcClient):
         while True:
             try:
                 return self.submit(prompt, max_new, eos_id=eos_id,
-                                   timeout_s=timeout_s)
+                                   timeout_s=timeout_s, tenant=tenant,
+                                   slo=slo, prefix_len=prefix_len)
             except Overloaded as e:
                 attempt += 1
                 if policy.max_attempts is not None \
@@ -297,7 +316,8 @@ class ServingClient(_RpcClient):
                                  e.retry_after_s))
 
     def stream(self, prompt, max_new: int, *, eos_id: Optional[int] = None,
-               timeout_s: Optional[float] = None,
+               timeout_s: Optional[float] = None, tenant: str = "default",
+               slo: str = "interactive", prefix_len: Optional[int] = None,
                poll_interval_s: float = 0.02,
                policy: Optional[RetryPolicy] = None):
         """Generator: submit (with backpressure backoff) then yield tokens
@@ -305,7 +325,9 @@ class ServingClient(_RpcClient):
         segment-sized bursts — the streaming granularity the decode loop
         actually has."""
         rid = self.submit_with_backoff(prompt, max_new, eos_id=eos_id,
-                                       timeout_s=timeout_s, policy=policy)
+                                       timeout_s=timeout_s, tenant=tenant,
+                                       slo=slo, prefix_len=prefix_len,
+                                       policy=policy)
         cursor = 0
         finished = False
         try:
@@ -340,8 +362,11 @@ class ServingClient(_RpcClient):
     def generate(self, prompt, max_new: int, *,
                  eos_id: Optional[int] = None,
                  timeout_s: Optional[float] = None,
+                 tenant: str = "default", slo: str = "interactive",
+                 prefix_len: Optional[int] = None,
                  poll_interval_s: float = 0.02) -> np.ndarray:
         """Blocking convenience: the full generated id array."""
         return np.asarray(list(self.stream(
             prompt, max_new, eos_id=eos_id, timeout_s=timeout_s,
+            tenant=tenant, slo=slo, prefix_len=prefix_len,
             poll_interval_s=poll_interval_s)), np.int32)

@@ -12,8 +12,10 @@ never waits on a dispatch.
 The scheduler loop is deliberately split into two phases with no shared
 state beyond the pool —
 
-* :meth:`admit_prefill`: queue -> slots (page-budget check, ragged prefill,
-  first-token emission, TTFT);
+* :meth:`admit_prefill`: queues -> slots (weighted-fair deficit
+  scheduling across tenant SLO classes, page-budget check with
+  cold-prefix eviction, ragged/suffix prefill, first-token emission,
+  TTFT);
 * :meth:`decode_segment`: one batched decode dispatch + collection
   (budget/EOS/cancel/timeout finalization, page free);
 
@@ -38,7 +40,7 @@ import numpy as np
 
 from .. import obs
 from ..obs.goodput import maybe_bucket
-from .batcher import Request, clip_emission
+from .batcher import SLO_CLASSES, Request, clip_emission
 from .paged import PagePool
 
 
@@ -55,13 +57,17 @@ class _Rec:
     """One request's lifecycle record (engine-internal)."""
 
     __slots__ = ("rid", "prompt", "eos_id", "left", "deadline", "t_submit",
-                 "t_first", "tokens", "done", "reason", "slot", "skip",
-                 "cancelled", "collected")
+                 "t_first", "t_done", "tokens", "done", "reason", "slot",
+                 "skip", "cancelled", "collected", "tenant", "slo",
+                 "prefix_len")
 
-    def __init__(self, rid, prompt, left, eos_id, deadline, t_submit):
+    def __init__(self, rid, prompt, left, eos_id, deadline, t_submit,
+                 tenant="default", slo="interactive", prefix_len=None):
         self.rid, self.prompt, self.left = rid, prompt, left
         self.eos_id, self.deadline, self.t_submit = eos_id, deadline, t_submit
+        self.tenant, self.slo, self.prefix_len = tenant, slo, prefix_len
         self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
         self.tokens: List[int] = []
         self.done = False
         self.reason = ""
@@ -82,19 +88,44 @@ class ServingEngine:
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
                  kv_dtype: Optional[str] = None, queue_cap: int = 64,
                  default_timeout_s: Optional[float] = None,
+                 prefix_cache: bool = False,
+                 class_weights: Optional[Dict[str, float]] = None,
+                 max_tenants: int = 32,
                  clock=time.monotonic):
         self.pool = PagePool(model, params, slots=slots, segment=segment,
                              page_block=page_block, pages=pages,
                              cache_bucket=cache_bucket,
                              prompt_buckets=prompt_buckets,
-                             kv_dtype=kv_dtype)
+                             kv_dtype=kv_dtype, prefix_cache=prefix_cache)
         self.model = model
         self.queue_cap = queue_cap
         self.default_timeout_s = default_timeout_s
+        # weighted-fair deficit scheduling across SLO classes: each class
+        # accrues weight-proportional service credit per scheduling round
+        # and admission debits the admitted request's token budget, so
+        # slots (the decode resource) divide ~weight-proportionally under
+        # contention while staying work-conserving when one class idles
+        self.class_weights = dict(class_weights
+                                  or {"interactive": 4.0, "batch": 1.0})
+        for c in SLO_CLASSES:
+            self.class_weights.setdefault(c, 1.0)
+        for c, w in self.class_weights.items():
+            # a zero/negative weight would silently pin that class's
+            # deficit balance negative — the INVERSE of the documented
+            # QoS intent; refuse structured like every other bad config
+            if not (w > 0):
+                raise ValueError(
+                    f"class_weights[{c!r}] must be > 0, got {w!r}")
+        # the bounded-cardinality contract behind the per-tenant metric
+        # labels: the engine refuses to mint series for more than
+        # max_tenants distinct tenants (structured at submit)
+        self.max_tenants = max_tenants
+        self._tenants = set()
         self._clock = clock
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._queue: List[_Rec] = []
+        self._queues: Dict[str, List[_Rec]] = {c: [] for c in SLO_CLASSES}
+        self._deficit: Dict[str, float] = {c: 0.0 for c in SLO_CLASSES}
         self._live: Dict[int, _Rec] = {}      # slot -> record
         self._recs: Dict[int, _Rec] = {}      # rid -> record (incl. done)
         self._done_order: List[int] = []      # finished rids, oldest first
@@ -108,13 +139,27 @@ class ServingEngine:
         self._gp = None
 
     # -- client surface (any thread) ---------------------------------------
+    def _queue_len_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
     def submit(self, prompt, max_new: int, *, eos_id: Optional[int] = None,
-               timeout_s: Optional[float] = None) -> int:
+               timeout_s: Optional[float] = None, tenant: str = "default",
+               slo: str = "interactive",
+               prefix_len: Optional[int] = None) -> int:
         """Queue one request; returns its rid. Raises ValueError for a
         malformed/unservable request (structured at submit time — the
-        validation-hardening contract) and :class:`Overloaded` when the
-        queue cap is reached (backpressure)."""
-        r = Request(-1, np.asarray(prompt), int(max_new), eos_id)
+        validation-hardening contract, now covering tenant labels, SLO
+        classes and declared prefixes) and :class:`Overloaded` when the
+        queue cap is reached (backpressure).
+
+        ``tenant`` labels this request's SLO metrics (bounded
+        cardinality: charset-validated AND capped at ``max_tenants``
+        distinct values per engine); ``slo`` picks the weighted-fair
+        scheduling class; ``prefix_len`` declares how many leading prompt
+        tokens are a shared prefix worth caching (matching is always
+        attempted — the declaration only gates index insertion)."""
+        r = Request(-1, np.asarray(prompt), int(max_new), eos_id,
+                    tenant=str(tenant), slo=str(slo), prefix_len=prefix_len)
         self.pool.validate(r)                  # mutates r.prompt to int32
         left = self.pool.effective_budget(r.prompt.size, r.max_new)
         timeout = timeout_s if timeout_s is not None else \
@@ -125,16 +170,27 @@ class ServingEngine:
             if self._failed is not None:
                 raise RuntimeError(
                     f"serving engine failed and stopped: {self._failed}")
-            if len(self._queue) >= self.queue_cap:
+            if (r.tenant not in self._tenants
+                    and len(self._tenants) >= self.max_tenants):
+                # the other half of the bounded-cardinality contract: a
+                # rotating tenant value must not mint unbounded series
+                raise ValueError(
+                    f"request: tenant {r.tenant!r} would exceed this "
+                    f"engine's {self.max_tenants}-tenant label budget "
+                    "(bounded-cardinality contract; raise max_tenants or "
+                    "reuse a tenant id)")
+            if self._queue_len_locked() >= self.queue_cap:
                 obs.count("serving.rejected_total", reason="overloaded")
                 raise Overloaded(
                     f"queue full ({self.queue_cap} waiting); retry later")
+            self._tenants.add(r.tenant)
             rid = self._next_rid
             self._next_rid += 1
-            rec = _Rec(rid, r.prompt, left, eos_id, deadline, now)
+            rec = _Rec(rid, r.prompt, left, eos_id, deadline, now,
+                       tenant=r.tenant, slo=r.slo, prefix_len=r.prefix_len)
             self._recs[rid] = rec
-            self._queue.append(rec)
-            obs.gauge_set("serving.queue_depth", len(self._queue))
+            self._queues[r.slo].append(rec)
+            obs.gauge_set("serving.queue_depth", self._queue_len_locked())
             self._wake.notify_all()
             return rid
 
@@ -166,24 +222,39 @@ class ServingEngine:
             if rec is None or rec.done:
                 return False
             rec.cancelled = True
-            if rec.slot is None and rec in self._queue:
-                self._queue.remove(rec)
+            queue = self._queues.get(rec.slo, ())
+            if rec.slot is None and rec in queue:
+                queue.remove(rec)
                 self._finalize_locked(rec, "cancelled")
             self._wake.notify_all()
             return True
 
+    def timings(self, rid: int) -> Dict[str, Optional[float]]:
+        """Engine-clock timestamps for one request (benches/tests read
+        TTFT/TPOT without scraping histograms): t_submit, t_first (None
+        until the first token), t_done (None until finalized)."""
+        with self._lock:
+            rec = self._recs[rid]
+            return {"t_submit": rec.t_submit, "t_first": rec.t_first,
+                    "t_done": rec.t_done}
+
     def stats(self) -> Dict[str, float]:
         with self._lock:
             live = len(self._live)
-            queued = len(self._queue)
+            queued = self._queue_len_locked()
+            per_class = {f"queue_{c}": len(q)
+                         for c, q in self._queues.items()}
         pool = self.pool
-        return {"queue_depth": queued, "slots_live": live,
-                "slots_total": pool.n_slots,
-                "pages_used": pool.pages_used,
-                "pages_reserved": pool.reserved,
-                "pages_total": pool.capacity_pages,
-                "page_block": pool.bs,
-                "peak_pages_used": pool.peak_pages_used}
+        out = {"queue_depth": queued, "slots_live": live,
+               "slots_total": pool.n_slots,
+               "pages_used": pool.pages_used,
+               "pages_reserved": pool.reserved,
+               "pages_total": pool.capacity_pages,
+               "page_block": pool.bs,
+               "peak_pages_used": pool.peak_pages_used}
+        out.update(per_class)
+        out.update(pool.prefix_stats())
+        return out
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -210,8 +281,8 @@ class ServingEngine:
         try:
             while True:
                 with self._lock:
-                    while (not self._stop and not self._queue
-                           and not self._live):
+                    while (not self._stop and not self._live
+                           and self._queue_len_locked() == 0):
                         self._wake.wait(timeout=1.0)
                     if self._stop:
                         return
@@ -235,9 +306,10 @@ class ServingEngine:
         traceback.print_exc()
         with self._lock:
             self._failed = f"{type(exc).__name__}: {exc}"
-            for rec in list(self._queue):
-                self._finalize_locked(rec, "error")
-            self._queue.clear()
+            for queue in self._queues.values():
+                for rec in list(queue):
+                    self._finalize_locked(rec, "error")
+                queue.clear()
             for slot, rec in list(self._live.items()):
                 self._release_locked(rec, "error")
             self._set_gauges_locked()
@@ -256,12 +328,13 @@ class ServingEngine:
         immediately — mid-flight cancel is a first-class path."""
         now = self._clock()
         with self._lock:
-            for rec in list(self._queue):
-                if rec.cancelled or (rec.deadline is not None
-                                     and now >= rec.deadline):
-                    self._queue.remove(rec)
-                    self._finalize_locked(
-                        rec, "cancelled" if rec.cancelled else "timeout")
+            for queue in self._queues.values():
+                for rec in list(queue):
+                    if rec.cancelled or (rec.deadline is not None
+                                         and now >= rec.deadline):
+                        queue.remove(rec)
+                        self._finalize_locked(
+                            rec, "cancelled" if rec.cancelled else "timeout")
             for slot, rec in list(self._live.items()):
                 if rec.cancelled or (rec.deadline is not None
                                      and now >= rec.deadline):
@@ -270,27 +343,61 @@ class ServingEngine:
             self._set_gauges_locked()
 
     def admit_prefill(self) -> int:
-        """Phase 1: move queued requests into free slots while the page
-        budget holds (FIFO — arrival order is the latency contract a
-        service owes its callers), run the batched ragged prefill, and
-        emit each admission's first token (TTFT stops here). Returns the
-        number admitted."""
+        """Phase 1: assign free slots to queued requests by WEIGHTED-FAIR
+        DEFICIT scheduling across SLO classes (slots are the decode
+        resource — whoever holds one decodes every segment, so slot
+        assignment IS the segment scheduler): each class with waiting
+        work accrues ``weight * segment`` tokens of service credit per
+        round, admission debits the admitted request's token budget, and
+        the class with the largest balance goes first. Within a class,
+        arrival order holds (FIFO — the latency contract); across
+        classes, interactive traffic pre-empts queued batch work at the
+        weight ratio without ever idling a slot (work-conserving: credit
+        resets while a class has nothing queued, and debt never blocks
+        the only nonempty class). A class head that does not fit the page
+        budget (even after cold-prefix eviction) blocks only ITS class —
+        a huge batch prompt cannot head-of-line-block interactive.
+
+        Then run the batched prefill — full ragged prefill for misses,
+        CoW + suffix-only prefill for prefix-cache hits — and emit each
+        admission's first token (TTFT stops here). Returns the number
+        admitted."""
         with maybe_bucket(self._gp, "host_input"), self._lock:
             group, members, pending = [], [], 0
             busy = set(self._live)
-            for slot in range(self.pool.n_slots):
-                if slot in busy or not self._queue:
+            free_slots = [s for s in range(self.pool.n_slots)
+                          if s not in busy]
+            quantum = float(self.pool.segment)
+            for c in SLO_CLASSES:
+                if self._queues[c]:
+                    w = self.class_weights[c]
+                    self._deficit[c] = min(self._deficit[c] + quantum * w,
+                                           8 * quantum * w)
+                else:
+                    self._deficit[c] = 0.0      # no banking while idle
+            blocked = set()
+            while free_slots:
+                avail = [c for c in SLO_CLASSES
+                         if self._queues[c] and c not in blocked]
+                if not avail:
+                    break
+                c = max(avail, key=lambda k: self._deficit[k])
+                rec = self._queues[c][0]
+                plan = self.pool.plan_admission(
+                    rec.prompt, rec.left, tenant=rec.tenant,
+                    prefix_len=rec.prefix_len)
+                if not self.pool.evict_for(plan.need_pages, pending,
+                                           protect=[p for _, p in group]
+                                           + [plan]):
+                    blocked.add(c)  # pages free at segment boundaries
                     continue
-                rec = self._queue[0]
-                need = self.pool.required_pages(rec.prompt.size, rec.left)
-                if not self.pool.fits(need, pending):
-                    break               # pages free at segment boundaries
-                pending += need
-                self._queue.pop(0)
+                self._queues[c].pop(0)
+                self._deficit[c] -= float(rec.left)
+                pending += plan.need_pages
+                slot = free_slots.pop(0)
                 rec.slot = slot
                 self._live[slot] = rec
-                busy.add(slot)
-                group.append((slot, rec.prompt, rec.left))
+                group.append((slot, plan))
                 members.append(rec)
         if not group:
             return 0
@@ -303,7 +410,8 @@ class ServingEngine:
                 # a cancel landing during the prefill only sets the flag
                 # (this thread owns finalization); the next _reap honors it
                 rec.t_first = now
-                obs.observe("serving.ttft_seconds", now - rec.t_submit)
+                obs.observe("serving.ttft_seconds", now - rec.t_submit,
+                            tenant=rec.tenant)
                 tok = first[rec.slot]
                 if rec.eos_id is not None and tok == rec.eos_id:
                     self._release_locked(rec, "eos")
@@ -353,13 +461,15 @@ class ServingEngine:
 
     def _finalize_locked(self, rec: _Rec, reason: str) -> None:
         rec.done, rec.reason = True, reason
-        obs.count("serving.requests_total", outcome=reason)
+        rec.t_done = self._clock()
+        obs.count("serving.requests_total", outcome=reason,
+                  tenant=rec.tenant)
         if rec.t_first is not None and len(rec.tokens) > 1:
             # time-per-output-token over the tokens AFTER the first (TTFT
             # owns the first) — the SLO pair dashboards alert on
             obs.observe("serving.tpot_seconds",
-                        (self._clock() - rec.t_first)
-                        / (len(rec.tokens) - 1))
+                        (rec.t_done - rec.t_first)
+                        / (len(rec.tokens) - 1), tenant=rec.tenant)
         self._done_order.append(rec.rid)
         # bound the finished-record memory of a long-lived daemon without
         # dropping results nobody has read: purge COLLECTED records first,
@@ -379,7 +489,7 @@ class ServingEngine:
 
     def _set_gauges_locked(self) -> None:
         pool = self.pool
-        obs.gauge_set("serving.queue_depth", len(self._queue))
+        obs.gauge_set("serving.queue_depth", self._queue_len_locked())
         obs.gauge_set("serving.slots_live", len(self._live))
         obs.gauge_set("serving.pages_used", pool.pages_used)
         obs.gauge_set("serving.pages_reserved", pool.reserved)
@@ -387,3 +497,6 @@ class ServingEngine:
         obs.gauge_set("serving.page_occupancy",
                       pool.live_tokens(list(self._live)) / used
                       if used else 0.0)
+        if pool.index is not None:
+            obs.gauge_set("serving.prefix_pages_shared",
+                          pool.index.live_pages())

@@ -9,19 +9,33 @@ tokens instead of padding, mixed-length sessions share one pool, pages
 allocate as positions grow, and a finished/cancelled request returns its
 pages to the free list immediately.
 
+With ``prefix_cache=True`` the pool additionally shares pages ACROSS
+requests through a radix index over prompt prefixes (serving/prefix.py):
+a request whose prompt starts with a cached prefix admits with only the
+non-shared suffix prefilled (``TransformerLM.prefill_paged`` — prefill
+from an offset over pre-populated block tables), full prefix pages are
+read in place under refcounts, and the last partial page copies on write
+before any append touches it. Cold entries evict by measured reuse.
+
 Invariants the exactness contract rides on:
 
-* live slots never share a page (allocation pops unique pages);
+* live slots never share an OWNED page (allocation pops unique pages);
+  index-owned pages are shared read-only and never written after the
+  admission wave that populated them;
 * page 0 is the reserved NULL page: padded block-table entries and
   drained-slot writes land there, and no live read is ever unmasked into
   it (assembled position ``j*bs + r`` of a padded entry is > ``pos``);
-* admission RESERVES each request's worst-case page count up front
-  (prompt + capped budget + one segment of overshoot), so a live slot can
-  never fail a mid-flight allocation — backpressure happens at admission,
+* admission RESERVES each request's worst-case OWNED page count up front
+  (prompt + capped budget + one segment of overshoot, minus the shared
+  prefix pages), and the fit check counts index-held pages too — so a
+  live slot can never fail a mid-flight allocation and a cold cache can
+  always be evicted out of the way: backpressure happens at admission,
   not in the decode loop;
 * the paged read (ops/pallas_kernels.paged_decode_attention) shares the
   dense-row masked-softmax formulation, so greedy tokens are bit-equal to
-  the pinned pool and to solo decode (tests/test_serving_paged.py).
+  the pinned pool and to solo decode (tests/test_serving_paged.py); the
+  suffix-prefill hit path mirrors the same formulation and precision mix
+  (tests/test_serving_prefix.py pins parity per interleaving).
 """
 
 from __future__ import annotations
@@ -35,19 +49,44 @@ import numpy as np
 from .. import obs
 from ..core.lod import bucket_length
 from .batcher import Request, clip_emission, validate_request
+from .prefix import Match, PrefixIndex
+
+
+class _AdmitPlan:
+    """One request's host-side admission plan: the prefix-index match (or
+    None), the OWNED pages it must reserve, and the labels its metrics
+    carry. Computed by :meth:`PagePool.plan_admission` with no pool
+    mutation, so schedulers can check :meth:`PagePool.fits` (and evict)
+    before committing anything."""
+
+    __slots__ = ("prompt", "left", "plen", "tenant", "prefix_cap",
+                 "match", "need_pages", "offset")
+
+    def __init__(self, prompt, left, tenant, prefix_cap, match, need_pages):
+        self.prompt = prompt
+        self.left = left
+        self.plen = int(prompt.size)
+        self.tenant = tenant
+        self.prefix_cap = prefix_cap
+        self.match: Optional[Match] = match
+        self.need_pages = need_pages
+        self.offset = match.shared_len if match is not None else 0
 
 
 class PagePool:
     """Device page pools + host page accounting + the jitted admit/segment
     programs. Compile surface is bounded exactly like the pinned batcher:
-    one admission program per prompt-pad bucket, one segment program per
-    cache-read bucket (in pages)."""
+    one admission program per prompt-pad bucket, one suffix-admission
+    program per (suffix-pad, read-pages) bucket pair, one segment program
+    per cache-read bucket (in pages)."""
 
     def __init__(self, model, params, *, slots: int, segment: int = 32,
                  page_block: int = 64, pages: Optional[int] = None,
                  cache_bucket: int = 256,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 prefix_cache: bool = False,
+                 prefix_half_life: int = 64):
         if model.max_len % page_block:
             raise ValueError(f"page_block {page_block} must divide "
                              f"max_len {model.max_len}")
@@ -89,6 +128,14 @@ class PagePool:
         self.pools = pools
         self._H, self._Dh = H, Dh
         self._itemsize = jnp.dtype(dt).itemsize
+        # one (k + v) page in HBM bytes — the prefix index's reuse-ledger
+        # credit unit (int8 rows carry a 4-byte scale per (row, head))
+        row_b = H * (Dh + 4 if kv_dtype == "int8" else Dh * self._itemsize)
+        self.page_bytes = 2.0 * self.bs * row_b * len(model.blocks)
+        self.index: Optional[PrefixIndex] = (
+            PrefixIndex(self.bs, self.page_bytes,
+                        half_life=prefix_half_life)
+            if prefix_cache else None)
 
         # host accounting
         self.free: List[int] = list(range(self.pages - 1, 0, -1))
@@ -96,6 +143,8 @@ class PagePool:
         self.pos = np.zeros((slots,), np.int64)
         self.cur = np.zeros((slots,), np.int32)
         self.slot_pages: List[List[int]] = [[] for _ in range(slots)]
+        self.slot_shared: List[list] = [[] for _ in range(slots)]
+        self.slot_partial: List[Optional[object]] = [None] * slots
         self.slot_reserve = np.zeros((slots,), np.int64)
         self.reserved = 0
         self.peak_pages_used = 0
@@ -105,7 +154,14 @@ class PagePool:
         self.read_bytes_total = 0
         self.occupancy_num = 0      # live tokens, summed per segment
         self.occupancy_den = 0      # allocated page capacity, ditto
-        self._admit_fns = {}        # (tpad, nbp) -> jitted admission
+        self.prompt_tokens_total = 0     # tokens ADMITTED (prompt lengths)
+        self.prefill_tokens_total = 0    # tokens actually PREFILLED
+        self.cow_copies_total = 0        # last-partial-page CoW copies
+        self.admit_flops_total = 0.0     # PR 9 cost-ledger FLOPs of the
+        #                                  admission dispatches (0 when the
+        #                                  obs plane is off)
+        self._admit_fns = {}        # (tpad, nbp) -> jitted full admission
+        self._hit_fns = {}          # (tpad, nbr) -> jitted suffix admission
         self._seg_fns = {}          # nb -> jitted segment scan
 
     # -- accounting --------------------------------------------------------
@@ -113,16 +169,27 @@ class PagePool:
     def pages_used(self) -> int:
         return self.capacity_pages - len(self.free)
 
+    @property
+    def index_pages(self) -> int:
+        return self.index.total_pages if self.index is not None else 0
+
     def reset_tallies(self) -> None:
         """Zero the always-on measurement tallies (peak pages, segment and
-        byte counts, occupancy sums) — benches call this between a warm-up
-        pass and the measured pass so warm-up traffic never leaks into the
-        reported row."""
+        byte counts, occupancy sums, prefix/prefill token counts) —
+        benches call this between a warm-up pass and the measured pass so
+        warm-up traffic never leaks into the reported row."""
         self.peak_pages_used = 0
         self.segments_total = 0
         self.read_bytes_total = 0
         self.occupancy_num = 0
         self.occupancy_den = 0
+        self.prompt_tokens_total = 0
+        self.prefill_tokens_total = 0
+        self.cow_copies_total = 0
+        self.admit_flops_total = 0.0
+        if self.index is not None:
+            self.index.hits = self.index.misses = 0
+            self.index.evictions = 0
 
     def required_pages(self, plen: int, left: int) -> int:
         """Worst-case pages a (prompt, capped budget) request can touch:
@@ -133,13 +200,56 @@ class PagePool:
         return -(-hi // self.bs)
 
     def fits(self, need_pages: int, pending: int = 0) -> bool:
-        """Can a request needing ``need_pages`` be admitted? ``pending`` is
-        the page count the CURRENT admission wave has already claimed:
-        ``reserved`` only updates inside :meth:`admit`, so a wave checking
-        each request against the pre-wave value alone would over-commit
-        the pool and exhaust the free list mid-decode — exactly the
-        failure reservations exist to prevent."""
-        return self.reserved + pending + need_pages <= self.capacity_pages
+        """Can a request needing ``need_pages`` OWNED pages be admitted?
+        ``pending`` is the page count the CURRENT admission wave has
+        already claimed: ``reserved`` only updates inside :meth:`admit`,
+        so a wave checking each request against the pre-wave value alone
+        would over-commit the pool and exhaust the free list mid-decode —
+        exactly the failure reservations exist to prevent. Pages held by
+        the prefix index count against capacity too (they are not in the
+        free list); :meth:`evict_for` reclaims cold ones."""
+        return (self.reserved + pending + need_pages + self.index_pages
+                <= self.capacity_pages)
+
+    def evict_for(self, need_pages: int, pending: int = 0,
+                  protect: Sequence[_AdmitPlan] = ()) -> bool:
+        """Evict cold prefix-cache entries (lowest decayed measured-reuse
+        score first) until ``need_pages`` fits; True on success. Pinned
+        entries never evict, so this cannot steal pages from live
+        readers — and ``protect`` (the current admission wave's plans,
+        including the one being priced) shields entries a plan has
+        MATCHED but not yet pinned: plans pin only inside :meth:`admit`,
+        so without the shield a same-wave eviction could free a page a
+        block table is about to reference."""
+        if self.index is None:
+            return self.fits(need_pages, pending)
+        keep = set()
+        for plan in protect:
+            if plan.match is not None:
+                keep.update(id(n) for n in plan.match.nodes)
+                if plan.match.partial is not None:
+                    keep.add(id(plan.match.partial))
+        while True:
+            deficit = (self.reserved + pending + need_pages
+                       + self.index_pages) - self.capacity_pages
+            if deficit <= 0:
+                return True
+            freed = self.index.evict_pages(deficit, keep)
+            if not freed:
+                return False
+            self.free.extend(freed)
+            obs.count("serving.prefix_evictions_total", len(freed))
+
+    def clear_prefix_cache(self) -> int:
+        """Drop every unpinned prefix-cache entry back to the free list
+        (drain / tests); returns the number of pages reclaimed. A drain
+        is deliberate, not capacity pressure, so it does not count into
+        ``serving.prefix_evictions_total``."""
+        if self.index is None:
+            return 0
+        freed = self.index.clear()
+        self.free.extend(freed)
+        return len(freed)
 
     def effective_budget(self, prompt_len: int, max_new: int) -> int:
         """The max_len-capped token budget a (prompt, max_new) can hold."""
@@ -147,8 +257,9 @@ class PagePool:
 
     def validate(self, r: Request) -> int:
         """Submit-time validation; returns the request's worst-case page
-        need. Raises ValueError for malformed requests AND for requests no
-        empty pool could ever hold (the page-budget check)."""
+        need (prefix hits can only shrink it). Raises ValueError for
+        malformed requests AND for requests no empty pool could ever hold
+        (the page-budget check)."""
         validate_request(r, self.model)
         need = self.required_pages(
             r.prompt.size, self.effective_budget(r.prompt.size, r.max_new))
@@ -162,6 +273,23 @@ class PagePool:
                 f"{self.capacity_pages}; shrink max_new or grow pages")
         return need
 
+    def plan_admission(self, prompt: np.ndarray, left: int, *,
+                       tenant: str = "default",
+                       prefix_len: Optional[int] = None) -> _AdmitPlan:
+        """Match ``prompt`` against the prefix index (read-only — nothing
+        is pinned until :meth:`admit` commits the plan) and price the
+        admission in OWNED pages. The match is capped at ``plen - 1`` so
+        at least one prompt token always re-prefills: the last token's
+        logits are the admission's first-token source and logits are not
+        cached."""
+        plen = int(prompt.size)
+        match = None
+        if self.index is not None:
+            match = self.index.match(prompt, plen - 1)
+        shared_full = len(match.nodes) if match is not None else 0
+        need = self.required_pages(plen, left) - shared_full
+        return _AdmitPlan(prompt, left, tenant, prefix_len, match, need)
+
     def _alloc(self) -> int:
         if not self.free:       # reservation accounting makes this a bug
             raise RuntimeError("page pool exhausted past its reservations")
@@ -170,19 +298,39 @@ class PagePool:
         return page
 
     def _ensure(self, slot: int, upto_pos: int) -> None:
-        """Grow ``slot``'s table to cover positions < upto_pos."""
+        """Grow ``slot``'s table to cover positions < upto_pos. Shared
+        prefix pages occupy the leading table entries; only the tail past
+        them allocates."""
         need = -(-min(upto_pos, self.model.max_len) // self.bs)
-        pages = self.slot_pages[slot]
-        while len(pages) < need:
-            self.tables[slot, len(pages)] = self._alloc()
-            pages.append(int(self.tables[slot, len(pages)]))
+        have = len(self.slot_shared[slot]) + len(self.slot_pages[slot])
+        while have < need:
+            page = self._alloc()
+            self.tables[slot, have] = page
+            self.slot_pages[slot].append(page)
+            have += 1
 
     def free_slot(self, slot: int) -> None:
-        """Return every page immediately and park the slot: table -> null
+        """Return every OWNED page immediately, un-pin the shared prefix
+        path (refcounts decrement; pages return to the free list only at
+        refcount 0 via eviction), hand the last partial prompt page to the
+        index (it keys a stored tail), and park the slot: table -> null
         page, pos -> 0, so its idle decode writes/reads only ever touch
-        page 0 (no park_idle dance — pos is host-owned here)."""
-        self.free.extend(self.slot_pages[slot])
+        page 0."""
+        entry = self.slot_partial[slot]
+        pages = self.slot_pages[slot]
+        if entry is not None:
+            if (self.index is not None
+                    and entry.node.partials.get(entry.key) is entry):
+                # the index adopts the page: it stays allocated as a cold
+                # cached tail instead of returning to the free list
+                self.index.adopt(entry)
+                pages.remove(entry.page)
+            self.slot_partial[slot] = None
+        self.free.extend(pages)
         self.slot_pages[slot] = []
+        if self.index is not None and self.slot_shared[slot]:
+            self.index.release(self.slot_shared[slot])
+        self.slot_shared[slot] = []
         self.reserved -= int(self.slot_reserve[slot])
         self.slot_reserve[slot] = 0
         self.tables[slot, :] = 0
@@ -216,8 +364,40 @@ class PagePool:
                                 prompts.shape[0], nbp, bs, -1)
                             out[nm] = pools[nm].at[pages].set(rows)
                 return out, first
-            fn = jax.jit(admit, donate_argnums=(1,))
+            # cost-instrumented (PR 9 ledger): under an obs session the
+            # dispatch feeds fluid.device_flops_total and admit() reads
+            # the per-executable FLOPs into admit_flops_total — the
+            # prefill-FLOPs-per-token evidence of the prefix bench row
+            fn = obs.roofline.instrument(
+                jax.jit(admit, donate_argnums=(1,)), "serving.admit")
             self._admit_fns[(tpad, nbp)] = fn
+        return fn
+
+    def _hit_fn(self, tpad: int, nbr: int):
+        """The prefix-HIT admission program: copy-on-write the matched
+        partial pages, then prefill only the non-shared suffixes from
+        their offsets against the pre-populated block tables
+        (models/transformer.py prefill_paged). One compile per
+        (suffix-pad, read-pages) bucket pair."""
+        fn = self._hit_fns.get((tpad, nbr))
+        if fn is None:
+            model = self.model
+
+            def admit_sfx(params, pools, suffix, offsets, lens, tables,
+                          copy_src, copy_dst):
+                # CoW first: dst pages are freshly-owned copies of the
+                # stored partial pages (no-copy slots pass (0, 0) — the
+                # null page absorbs the self-copy like any drained write)
+                out = {nm: v.at[copy_dst].set(v[copy_src])
+                       for nm, v in pools.items()}
+                out, last = model.prefill_paged(params, out, suffix,
+                                                offsets, lens, tables)
+                first = jnp.argmax(last, axis=-1).astype(suffix.dtype)
+                return out, first
+            fn = obs.roofline.instrument(
+                jax.jit(admit_sfx, donate_argnums=(1,)),
+                "serving.admit_prefix")
+            self._hit_fns[(tpad, nbr)] = fn
         return fn
 
     def _seg_fn(self, nb: int):
@@ -238,46 +418,188 @@ class PagePool:
                                                  length=segment)
                 pools_out = {k: v for k, v in cell.items() if k != "pos"}
                 return pools_out, cur, jnp.moveaxis(toks, 0, 1)
-            fn = jax.jit(seg, donate_argnums=(1,))
+            fn = obs.roofline.instrument(
+                jax.jit(seg, donate_argnums=(1,)), "serving.segment")
             self._seg_fns[nb] = fn
         return fn
 
     # -- the two scheduler-visible operations ------------------------------
-    def admit(self, group: List[Tuple[int, np.ndarray, int]]) -> Dict[int, int]:
-        """Prefill + page placement for ``group`` = [(slot, prompt, left)]
-        (left = the CAPPED token budget). Reserves worst-case pages,
-        allocates the prompt's pages, runs ONE full-pool-width jitted
-        prefill-and-scatter, and returns {slot: first generated token}.
-        Caller has checked :meth:`fits` per request."""
+    def admit(self, group: List[Tuple[int, _AdmitPlan]]) -> Dict[int, int]:
+        """Commit ``group`` = [(slot, plan)] (plans from
+        :meth:`plan_admission`; caller has checked :meth:`fits` /
+        :meth:`evict_for` per plan): reserve worst-case OWNED pages, pin
+        matched prefix paths, allocate the prompts' tail pages, run the
+        full-prefill dispatch for misses and the CoW + suffix-prefill
+        dispatch for hits, insert the new full prompt blocks (and the
+        last partial page) into the index, and return {slot: first
+        generated token}."""
         if not group:
             return {}
-        for slot, prompt, left in group:
-            need = self.required_pages(prompt.size, left)
-            self.slot_reserve[slot] = need
-            self.reserved += need
-            self._ensure(slot, prompt.size)
-        tpad = bucket_length(max(p.size for _, p, _ in group),
+        if self.index is not None:
+            self.index.tick += 1
+        miss: List[Tuple[int, _AdmitPlan]] = []
+        hits: List[Tuple[int, _AdmitPlan]] = []
+        cow: Dict[int, Tuple[int, int]] = {}      # slot -> (src, dst)
+        for slot, plan in group:
+            self.slot_reserve[slot] = plan.need_pages
+            self.reserved += plan.need_pages
+            self.slot_partial[slot] = None
+            if plan.match is not None:
+                self.index.acquire(plan.match)
+                self.slot_shared[slot] = list(plan.match.nodes)
+                for j, node in enumerate(plan.match.nodes):
+                    self.tables[slot, j] = node.page
+                if plan.offset:
+                    obs.count("serving.prefix_hits_total",
+                              tenant=plan.tenant)
+                else:
+                    obs.count("serving.prefix_misses_total",
+                              tenant=plan.tenant)
+            else:
+                self.slot_shared[slot] = []
+            self._ensure(slot, plan.plen)
+            if plan.match is not None and plan.match.partial_len > 0:
+                # CoW: the block after the shared full pages is this
+                # slot's first OWNED page; the stored tail copies into it
+                # before the suffix prefill appends a single row
+                dst = self.slot_pages[slot][0]
+                cow[slot] = (plan.match.partial.page, dst)
+                self.cow_copies_total += 1
+            self.prompt_tokens_total += plan.plen
+            self.prefill_tokens_total += plan.plen - plan.offset
+            (hits if plan.offset else miss).append((slot, plan))
+
+        first = np.zeros((self.n_slots,), np.int32)
+        if miss:
+            self._dispatch_miss(miss, first)
+        if hits:
+            self._dispatch_hits(hits, cow, first)
+        if self.index is not None:
+            for slot, plan in group:
+                self._insert_after(slot, plan)
+        out = {}
+        for slot, plan in group:
+            self.pos[slot] = plan.plen
+            self.cur[slot] = int(first[slot])
+            out[slot] = int(first[slot])
+        return out
+
+    def _dispatch_miss(self, miss, first) -> None:
+        """The cold path: ONE full-pool-width jitted prefill-and-scatter,
+        numerically identical to the pre-prefix-cache admission."""
+        tpad = bucket_length(max(p.plen for _, p in miss),
                              self.prompt_buckets)
         tpad = min(tpad, self.model.max_len - 1)
         nbp = -(-tpad // self.bs)
         prompts = np.zeros((self.n_slots, tpad), np.int32)
         lens = np.zeros((self.n_slots,), np.int32)
         pages = np.zeros((self.n_slots, nbp), np.int32)
-        for slot, prompt, _ in group:
-            prompts[slot, :prompt.size] = prompt
-            lens[slot] = prompt.size
+        for slot, plan in miss:
+            prompts[slot, :plan.plen] = plan.prompt
+            lens[slot] = plan.plen
             n = min(nbp, len(self.slot_pages[slot]))
             pages[slot, :n] = self.slot_pages[slot][:n]
-        self.pools, first = self._admit_fn(tpad, nbp)(
-            self.params, self.pools, jnp.asarray(prompts), jnp.asarray(lens),
-            jnp.asarray(pages))
-        first = np.asarray(first)
-        out = {}
-        for slot, prompt, _ in group:
-            self.pos[slot] = prompt.size
-            self.cur[slot] = int(first[slot])
-            out[slot] = int(first[slot])
-        return out
+        fn = self._admit_fn(tpad, nbp)
+        args = (self.params, self.pools, jnp.asarray(prompts),
+                jnp.asarray(lens), jnp.asarray(pages))
+        self.pools, f = fn(*args)
+        self._note_admit_cost(fn, args)
+        f = np.asarray(f)
+        for slot, _ in miss:
+            first[slot] = f[slot]
+
+    def _dispatch_hits(self, hits, cow, first) -> None:
+        """The warm path: CoW copies + suffix prefill from each slot's
+        offset, reading the shared prefix pages through the block table."""
+        max_sfx = max(p.plen - p.offset for _, p in hits)
+        tpad = min(bucket_length(max_sfx, self.prompt_buckets),
+                   self.model.max_len - 1)
+        nbr = -(-min(bucket_length(max(p.plen for _, p in hits),
+                                   self.prompt_buckets),
+                     self.model.max_len) // self.bs)
+        suffix = np.zeros((self.n_slots, tpad), np.int32)
+        offsets = np.zeros((self.n_slots,), np.int32)
+        lens = np.zeros((self.n_slots,), np.int32)
+        src = np.zeros((self.n_slots,), np.int32)
+        dst = np.zeros((self.n_slots,), np.int32)
+        for slot, plan in hits:
+            sfx = plan.prompt[plan.offset:]
+            suffix[slot, :sfx.size] = sfx
+            offsets[slot] = plan.offset
+            lens[slot] = sfx.size
+            if slot in cow:
+                src[slot], dst[slot] = cow[slot]
+        fn = self._hit_fn(tpad, nbr)
+        args = (self.params, self.pools, jnp.asarray(suffix),
+                jnp.asarray(offsets), jnp.asarray(lens),
+                jnp.asarray(self.tables[:, :nbr]), jnp.asarray(src),
+                jnp.asarray(dst))
+        self.pools, f = fn(*args)
+        self._note_admit_cost(fn, args)
+        # modeled HBM bytes of the gathered prefix read (the hit path's
+        # bytes term), through the ONE registered model
+        read = obs.roofline.kernel_cost(
+            "paged_prefill_attention", batch=self.n_slots, pages=nbr,
+            page_block=self.bs, n_heads=self._H, d_head=self._Dh,
+            layers=len(self.model.blocks), kv_dtype=self.kv_dtype,
+            itemsize=self._itemsize) or 0.0
+        obs.count("kernels.bytes_total", read,
+                  kernel="paged_prefill_attention")
+        f = np.asarray(f)
+        for slot, _ in hits:
+            first[slot] = f[slot]
+
+    def _note_admit_cost(self, fn, args) -> None:
+        """Accumulate the admission executable's FLOPs from the PR 9 cost
+        ledger (None while the obs plane is off or analysis failed) —
+        benchmarks/serving_prefix.py divides this by prompt tokens for
+        its prefill-FLOPs-per-token column."""
+        cost = fn.cost_of(*args)
+        if cost is not None and cost.flops:
+            self.admit_flops_total += cost.flops
+
+    def _insert_after(self, slot: int, plan: _AdmitPlan) -> None:
+        """Grow the radix index from this admission: every full prompt
+        block past the matched depth becomes a shared node (the slot's
+        page transfers to the index, or dedups onto an existing node's
+        page), and a partial prompt tail registers for copy-on-write
+        sharing. ``prefix_len`` (when declared) caps what is cached so
+        unique continuations never pollute the index."""
+        idx = self.index
+        prompt, plen = plan.prompt, plan.plen
+        cap = plen if plan.prefix_cap is None else min(plan.prefix_cap,
+                                                       plen)
+        q0 = len(plan.match.nodes) if plan.match is not None else 0
+        parent = (plan.match.nodes[-1] if plan.match is not None
+                  and plan.match.nodes else idx.root)
+        kfull = cap // self.bs
+        for j in range(q0, kfull):
+            page = self.slot_pages[slot].pop(0)
+            key = tuple(int(t) for t in prompt[j * self.bs:
+                                               (j + 1) * self.bs])
+            node, created = idx.insert_full(parent, key, page)
+            if created:
+                # first use counts as one reuse credit, so a brand-new
+                # prefix survives an eviction scan long enough to be hit
+                idx._credit(node, idx.page_bytes)
+            else:
+                # duplicate admission (e.g. two misses sharing a prefix
+                # in one wave): keep the existing shared page, free ours
+                self.free.append(page)
+                self.tables[slot, j] = node.page
+            idx.ref(node)
+            self.slot_shared[slot].append(node)
+            # the page is no longer (to be) owned by the slot
+            self.slot_reserve[slot] -= 1
+            self.reserved -= 1
+            parent = node
+        tail = tuple(int(t) for t in prompt[kfull * self.bs:cap])
+        if tail and kfull >= q0 and self.slot_pages[slot]:
+            entry = idx.insert_partial(parent, tail,
+                                       self.slot_pages[slot][0], slot)
+            if entry is not None:
+                idx._credit(entry, idx.page_bytes * len(tail) / self.bs)
+                self.slot_partial[slot] = entry
 
     def run_segment(self, live: Sequence[int]) -> np.ndarray:
         """One decode segment across the whole pool; returns the emitted
@@ -316,9 +638,23 @@ class PagePool:
     def live_tokens(self, live: Sequence[int]) -> int:
         """Cache rows written across ``live`` slots (occupancy numerator).
         Rows 0..pos-1 exist (each step writes AT pos then advances), so the
-        count is pos, capped at max_len where overshoot writes clamp."""
+        count is pos, capped at max_len where overshoot writes clamp.
+        Shared prefix rows count once per READER (each slot's positions
+        include them), so occupancy can legitimately exceed 1.0 under
+        prefix sharing — the sharing win made visible."""
         return int(sum(min(int(self.pos[i]), self.model.max_len)
                        for i in live))
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """Host tallies for stats()/benches: hit/miss counts, shared and
+        cached page counts, prefill-vs-prompt token totals."""
+        out = {"prefix_cache": 1.0 if self.index is not None else 0.0,
+               "prompt_tokens": self.prompt_tokens_total,
+               "prefill_tokens": self.prefill_tokens_total,
+               "cow_copies": self.cow_copies_total}
+        if self.index is not None:
+            out.update(self.index.stats())
+        return out
 
 
 class PagedBatcher:
@@ -326,14 +662,16 @@ class PagedBatcher:
     :class:`~paddle_tpu.serving.batcher.ContinuousBatcher` (greedy outputs
     token-for-token equal to solo decode; schedule is a throughput knob
     only), with cache residency proportional to LIVE tokens instead of
-    slots * max_len."""
+    slots * max_len. ``prefix_cache=True`` turns on cross-request prefix
+    sharing (copy-on-write radix index; see :class:`PagePool`)."""
 
     def __init__(self, model, params, *, slots: int = 8, segment: int = 32,
                  page_block: int = 64, pages: Optional[int] = None,
                  cache_bucket: int = 256,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
                  schedule: str = "longest_first",
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 prefix_cache: bool = False):
         if schedule not in ("longest_first", "fifo"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.model, self.params = model, params
@@ -342,7 +680,7 @@ class PagedBatcher:
                              page_block=page_block, pages=pages,
                              cache_bucket=cache_bucket,
                              prompt_buckets=prompt_buckets,
-                             kv_dtype=kv_dtype)
+                             kv_dtype=kv_dtype, prefix_cache=prefix_cache)
 
     def _effective_budget(self, r: Request) -> int:
         return self.pool.effective_budget(r.prompt.size, r.max_new)
@@ -367,16 +705,20 @@ class PagedBatcher:
             for i in range(pool.n_slots):
                 if slots[i] is not None or not queue:
                     continue
-                need = pool.required_pages(
-                    queue[0].prompt.size, self._effective_budget(queue[0]))
-                if not pool.fits(need, pending):
+                r = queue[0]
+                plan = pool.plan_admission(
+                    r.prompt, self._effective_budget(r), tenant=r.tenant,
+                    prefix_len=r.prefix_len)
+                if not pool.evict_for(plan.need_pages, pending,
+                                      protect=[p for _, p in group]
+                                      + [plan]):
                     break          # head-of-line: wait for pages to free
-                pending += need
-                r = queue.pop(0)
+                pending += plan.need_pages
+                queue.pop(0)
                 slots[i] = r
                 left[i] = self._effective_budget(r)
                 outs[i] = []
-                group.append((i, r.prompt, int(left[i])))
+                group.append((i, plan))
             pool.admit(group)
 
         admit()

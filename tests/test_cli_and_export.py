@@ -273,9 +273,15 @@ def test_traffic_prediction_example_config(tmp_path):
     assert "pass 0 done" in out
 
 
+@pytest.mark.slow
 def test_gan_vae_example_smoke():
     """examples/gan_vae_mnist.py (v1_api_demo/{gan,vae} analog): both
-    demos train mechanically on short budgets."""
+    demos train mechanically on short budgets.
+
+    slow: ~13s example smoke; the generative-model substance is tier-1
+    in tests/test_generative.py and the example-runner plumbing in the
+    sibling example smokes (PR 7 precedent: sequence_tagging/serving_llm
+    demotions; PR 12 --durations=25 triage)."""
     import importlib
     mod = importlib.import_module("examples.gan_vae_mnist")
     mod.train_gan(steps=40)

@@ -125,10 +125,18 @@ def test_idle_slot_parking_near_max_len(model_and_params):
     np.testing.assert_array_equal(got[0], want)
 
 
+@pytest.mark.slow
 def test_continuous_batching_int8_kv_matches_solo_int8(model_and_params):
     """The quantized-KV exactness contract for serving: an int8-cache
     batcher's tokens equal SOLO decode at the same kv_dtype (the
-    quantization error is the configuration's, batching adds none)."""
+    quantization error is the configuration's, batching adds none).
+
+    slow: ~14s, and the int8 serving machinery it proves (quantize on
+    append, in-read dequant, padded-scale convention) is tier-1-covered
+    by test_serving_paged.py::test_paged_int8_matches_solo_int8 +
+    tests/test_serving_prefix.py int8 hits on the SAME prefill/
+    decode_step cells; the pinned pool's int8 storage layout has no
+    other code of its own (PR 12 --durations=25 triage)."""
     model, params = model_and_params
     rs = np.random.RandomState(13)
     reqs = [Request(rid, rs.randint(0, VOCAB, int(rs.randint(3, 30))),

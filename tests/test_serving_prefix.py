@@ -1,0 +1,405 @@
+"""Prefix-shared paged KV-cache (serving/prefix.py + paged.py + engine.py):
+requests sharing a prompt prefix share refcounted pages copy-on-write
+through a radix index, admission prefills only the non-shared suffix, and
+the engine schedules slots weighted-fair across tenant SLO classes.
+
+Contracts under test:
+
+* EXACTNESS — greedy tokens equal SOLO decode for every hit/miss/
+  partial-hit interleaving (full hit, partial-block hit, same-wave
+  sharing then divergence, eviction-then-readmit, int8 KV);
+* RECLAMATION — refcounts never underflow, cancel mid-flight with shared
+  pages drains cleanly, and clear_prefix_cache() returns the pool to
+  pages_used == 0;
+* SCHEDULING — weighted-fair deficit slot assignment serves interactive
+  ahead of earlier-queued batch work without idling slots;
+* VALIDATION — tenant labels (bounded cardinality) and declared
+  prefix_len die structured at submit.
+
+Dims are shared with tests/test_serving_paged.py (same model family and
+pool shapes), so the session compile-cache fixture reuses its traced
+executables.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (PagedBatcher, PrefixIndex, Request,
+                                ServingEngine)
+
+VOCAB, D, H, L, MAX_LEN = 97, 32, 4, 2, 128
+BS = 8                      # page_block — one trie level per 8 tokens
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TransformerLM(VOCAB, d_model=D, n_heads=H, n_layers=L,
+                          max_len=MAX_LEN)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _solo(model, params, prompt, steps, kv_dtype=None, _bucket=12):
+    """Solo-decode reference (steps padded onto shared scan compiles —
+    greedy is prefix-stable; the test_serving_paged.py trick)."""
+    if kv_dtype is not None:
+        out = model.generate_fused(params, jnp.asarray(prompt[None]),
+                                   steps=steps, kv_dtype=kv_dtype)
+        return np.asarray(out)[0, len(prompt):]
+    padded = min(-(-steps // _bucket) * _bucket,
+                 model.max_len - len(prompt))
+    out = model.generate_cached(params, jnp.asarray(prompt[None]),
+                                steps=padded)
+    return np.asarray(out)[0, len(prompt):len(prompt) + steps]
+
+
+def _batcher(model, params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("segment", 8)
+    kw.setdefault("page_block", BS)
+    kw.setdefault("cache_bucket", 32)
+    kw.setdefault("prefix_cache", True)
+    return PagedBatcher(model, params, **kw)
+
+
+def _assert_refs_drained(index):
+    """Every trie refcount is back to zero (no leaks, no underflow — the
+    release assert inside PrefixIndex guards the underflow side)."""
+    stack = [index.root]
+    while stack:
+        n = stack.pop()
+        stack.extend(n.children.values())
+        if n is not index.root:
+            assert n.refs == 0, f"leaked ref on node {n.key[:3]}..."
+
+
+# -- the radix index itself (pure host) ---------------------------------
+
+def test_prefix_index_radix_unit():
+    idx = PrefixIndex(4, page_bytes=100.0, half_life=2)
+    toks = list(range(13))                    # 3 full blocks + tail [12]
+    m = idx.match(toks, len(toks) - 1)
+    assert m.shared_len == 0 and not m.nodes
+    # insert the path root -> [0..3] -> [4..7] (pages 5, 6) + tail (8..11)
+    n0, created0 = idx.insert_full(idx.root, tuple(toks[0:4]), 5)
+    n1, created1 = idx.insert_full(n0, tuple(toks[4:8]), 6)
+    assert created0 and created1 and idx.total_pages == 2
+    dup, created = idx.insert_full(idx.root, tuple(toks[0:4]), 99)
+    assert dup is n0 and not created          # dedup keeps the first page
+    p = idx.insert_partial(n1, tuple(toks[8:12]), 7, owner=3)
+    assert p is not None and idx.total_pages == 2   # owner-live: not owned
+    # match caps at limit: limit 9 allows 2 full blocks + 1 tail token
+    m = idx.match(toks, 9)
+    assert [n.page for n in m.nodes] == [5, 6]
+    assert m.partial is p and m.partial_len == 1 and m.shared_len == 9
+    # pin + ledger: refs block eviction; partials never pin (hits copy)
+    idx.acquire(m)
+    assert n0.refs == 1 and n1.refs == 1 and idx.hits == 1
+    assert idx.evict_one() is None            # all full nodes pinned,
+    #                                           partial owner still live
+    idx.adopt(p)                              # owner slot freed
+    assert idx.total_pages == 3
+    assert idx.evict_one() == 7               # the only evictable entry
+    idx.release(m.nodes)
+    _assert_refs_drained(idx)
+    # decayed measured reuse: n1 was credited at tick 0; advance ticks and
+    # credit n0 again — n1 (stale leaf) must evict before n0's subtree
+    idx.tick += 10
+    idx._credit(n0, 100.0)
+    assert idx.evict_one() == 6               # n1: cold leaf, decayed
+    assert idx.evict_one() == 5               # now n0 is a leaf
+    assert idx.total_pages == 0 and idx.evictions == 3
+
+
+# -- exactness across interleavings -------------------------------------
+
+def test_full_hit_matches_solo(model_and_params):
+    """Warm the index with a miss, then replay the same prompt (full hit,
+    one-token suffix): tokens equal solo decode, the second admission
+    prefills ~nothing, and page dedup shares the prompt blocks."""
+    model, params = model_and_params
+    rs = np.random.RandomState(3)
+    b = _batcher(model, params)
+    prompt = rs.randint(0, VOCAB, 27)
+    want = _solo(model, params, prompt, 11)
+    np.testing.assert_array_equal(
+        b.serve([Request(0, prompt.copy(), 11)])[0], want)
+    st = b.pool.prefix_stats()
+    assert st["prefix_misses"] == 1 and st["prefix_hits"] == 0
+    cold_prefill = b.pool.prefill_tokens_total
+    np.testing.assert_array_equal(
+        b.serve([Request(1, prompt.copy(), 11)])[1], want)
+    st = b.pool.prefix_stats()
+    assert st["prefix_hits"] == 1
+    # the hit prefilled only the uncached tail (<= one page + the final
+    # token), not the whole prompt again
+    assert b.pool.prefill_tokens_total - cold_prefill <= BS
+    _assert_refs_drained(b.pool.index)
+
+
+def test_partial_block_hit_cow(model_and_params):
+    """A prompt diverging MID-block from a cached one: the full blocks
+    share in place, the stored partial page is copied before the suffix
+    appends (CoW), and tokens stay exact for both."""
+    model, params = model_and_params
+    rs = np.random.RandomState(5)
+    b = _batcher(model, params, slots=2)
+    shared = rs.randint(0, VOCAB, 21)         # 2 full blocks + 5-token tail
+    a = Request(0, shared.copy(), 9)          # stores the tail as a partial
+    b.serve([a])
+    # diverges after 19 shared tokens: 2 full-block hits + 3-token
+    # partial match into A's stored tail -> CoW copy
+    c = Request(1, np.concatenate([shared[:19], rs.randint(0, VOCAB, 6)]),
+                13)
+    got = b.serve([c])
+    np.testing.assert_array_equal(got[1], _solo(model, params, c.prompt, 13))
+    st = b.pool.prefix_stats()
+    assert st["prefix_hits"] == 1 and st["cow_copies"] >= 1
+    _assert_refs_drained(b.pool.index)
+
+
+def test_concurrent_admits_share_then_diverge(model_and_params):
+    """Two requests sharing a prefix admitted in the SAME wave: both are
+    misses (insertion is post-dispatch), but the index dedups their
+    common blocks to one page set, and a third request then hits it.
+    Tokens equal solo for every one of them."""
+    model, params = model_and_params
+    rs = np.random.RandomState(7)
+    b = _batcher(model, params)
+    shared = rs.randint(0, VOCAB, 16)         # exactly 2 full blocks
+    reqs = [Request(0, np.concatenate([shared, rs.randint(0, VOCAB, 5)]), 10),
+            Request(1, np.concatenate([shared, rs.randint(0, VOCAB, 3)]), 12)]
+    got = b.serve(reqs)
+    for r in reqs:
+        np.testing.assert_array_equal(
+            got[r.rid], _solo(model, params, r.prompt, r.max_new))
+    st = b.pool.prefix_stats()
+    assert st["prefix_misses"] == 2
+    # dedup: the 2 shared blocks exist ONCE (plus each request's partial
+    # tail adopted on free) — not 4 full nodes
+    assert st["prefix_nodes"] == 2 and st["prefix_partials"] == 2
+    late = Request(2, np.concatenate([shared, rs.randint(0, VOCAB, 7)]), 8)
+    got2 = b.serve([late])
+    np.testing.assert_array_equal(
+        got2[2], _solo(model, params, late.prompt, 8))
+    assert b.pool.prefix_stats()["prefix_hits"] == 1
+
+
+def test_eviction_then_readmit(model_and_params):
+    """A pool too small to keep the whole cache: cold entries evict
+    (measured-reuse order) to make room, a later replay of the evicted
+    prompt partially misses and re-inserts, and every emission stays
+    exact throughout. The worst-case-reservation invariant holds with
+    index pages counted."""
+    model, params = model_and_params
+    rs = np.random.RandomState(11)
+    b = _batcher(model, params, slots=2, pages=9)    # 8 usable pages
+    pa = rs.randint(0, VOCAB, 16)                    # 2 full blocks
+    want_a = _solo(model, params, pa, 8)
+    np.testing.assert_array_equal(
+        b.serve([Request(0, pa.copy(), 8)])[0], want_a)
+    assert b.pool.index_pages == 2
+    # B needs 7 owned pages: 7 + 2 cached > 8 -> eviction must free one
+    pb = rs.randint(0, VOCAB, 24)
+    np.testing.assert_array_equal(
+        b.serve([Request(1, pb.copy(), 24)])[1],
+        _solo(model, params, pb, 24))
+    st = b.pool.prefix_stats()
+    assert st["prefix_evictions"] >= 1
+    assert b.pool.reserved == 0
+    assert b.pool.pages_used == b.pool.index_pages <= b.pool.capacity_pages
+    # replay A: the evicted tail of its path misses and re-inserts; the
+    # surviving depth still hits. Either way: exact.
+    np.testing.assert_array_equal(
+        b.serve([Request(2, pa.copy(), 8)])[2], want_a)
+    _assert_refs_drained(b.pool.index)
+    b.pool.clear_prefix_cache()
+    assert b.pool.pages_used == 0
+
+
+def test_same_wave_eviction_cannot_steal_matched_pages(model_and_params):
+    """Regression: plans pin their matched nodes only inside admit(), so
+    an eviction triggered LATER in the same admission wave (another
+    request pricing its own pages) must shield every already-planned
+    match — otherwise a block table ends up pointing at a freed page
+    that the very same wave re-allocates, and tokens silently diverge.
+    Here B (a hit on A's cold blocks) and C (a big miss that needs an
+    eviction) are planned in one wave: C must wait, not evict from under
+    B, and everyone stays exact."""
+    model, params = model_and_params
+    rs = np.random.RandomState(17)
+    b = _batcher(model, params, slots=3, pages=9, schedule="fifo")
+    pa = rs.randint(0, VOCAB, 16)                 # 2 full blocks, cold
+    np.testing.assert_array_equal(
+        b.serve([Request(0, pa.copy(), 8)])[0], _solo(model, params, pa, 8))
+    assert b.pool.index_pages == 2
+    hit = Request(1, np.concatenate([pa, rs.randint(0, VOCAB, 4)]), 8)
+    big = Request(2, rs.randint(0, VOCAB, 24), 16)   # needs an eviction
+    got = b.serve([hit, big])
+    np.testing.assert_array_equal(
+        got[1], _solo(model, params, hit.prompt, 8))
+    np.testing.assert_array_equal(
+        got[2], _solo(model, params, big.prompt, 16))
+    _assert_refs_drained(b.pool.index)
+
+
+def test_int8_hits_match_solo_int8(model_and_params):
+    """Quantized-KV prefix sharing: full and partial hits equal SOLO
+    decode at kv_dtype=int8 (the hit path reads the dequantized prefix —
+    the same read every decode step performs)."""
+    model, params = model_and_params
+    rs = np.random.RandomState(13)
+    b = _batcher(model, params, slots=2, kv_dtype="int8")
+    shared = rs.randint(0, VOCAB, 24)
+    r0 = Request(0, np.concatenate([shared, rs.randint(0, VOCAB, 5)]), 12)
+    got = b.serve([r0])
+    np.testing.assert_array_equal(
+        got[0], _solo(model, params, r0.prompt, 12, kv_dtype="int8"))
+    r1 = Request(1, r0.prompt.copy(), 10)                       # full hit
+    r2 = Request(2, np.concatenate([shared[:20],
+                                    rs.randint(0, VOCAB, 6)]), 9)  # partial
+    got2 = b.serve([r1, r2])
+    np.testing.assert_array_equal(
+        got2[1], _solo(model, params, r1.prompt, 10, kv_dtype="int8"))
+    np.testing.assert_array_equal(
+        got2[2], _solo(model, params, r2.prompt, 9, kv_dtype="int8"))
+    assert b.pool.prefix_stats()["prefix_hits"] == 2
+
+
+# -- engine: reclamation, scheduling, validation -------------------------
+
+def test_engine_cancel_mid_flight_with_shared_pages(model_and_params):
+    """Cancel a request READING shared prefix pages mid-decode: refcounts
+    release (never underflow), its owned pages free, the survivors' reads
+    are untouched, and the drained pool holds exactly the cached pages —
+    which clear_prefix_cache() then returns to the free list."""
+    model, params = model_and_params
+    rs = np.random.RandomState(21)
+    eng = ServingEngine(model, params, slots=2, segment=8, page_block=BS,
+                        cache_bucket=32, queue_cap=8, prefix_cache=True)
+    shared = rs.randint(0, VOCAB, 16)
+    pa = np.concatenate([shared, rs.randint(0, VOCAB, 4)])
+    first = eng.submit(pa, 8)
+    while not eng.poll(first)[1]:
+        eng.step()                      # warm the index (2 full blocks)
+    assert eng.pool.index_pages >= 2
+    # two hits share the cached blocks; one is cancelled mid-flight
+    victim = eng.submit(np.concatenate([shared, rs.randint(0, VOCAB, 3)]),
+                        100)
+    survivor_prompt = np.concatenate([shared, rs.randint(0, VOCAB, 5)])
+    survivor = eng.submit(survivor_prompt, 9)
+    eng.step()                          # admit both (hits), one segment
+    assert eng.pool.index.live_pages() == 2     # pinned by both readers
+    assert eng.cancel(victim) is True
+    eng.step()                          # reap: victim's pins release
+    assert eng.poll(victim)[1:] == (True, "cancelled")
+    while not eng.poll(survivor)[1]:
+        eng.step()
+    toks, done, reason = eng.poll(survivor)
+    assert done and reason == "length"
+    np.testing.assert_array_equal(
+        np.asarray(toks, np.int32), _solo(model, params, survivor_prompt, 9))
+    _assert_refs_drained(eng.pool.index)
+    assert eng.pool.reserved == 0
+    assert eng.pool.pages_used == eng.pool.index_pages
+    eng.pool.clear_prefix_cache()
+    assert eng.pool.pages_used == 0
+
+
+def test_weighted_fair_deficit_scheduling(model_and_params):
+    """Slot assignment is weighted-fair, not FCFS: with one slot and a
+    backlog, the interactive request admits ahead of two earlier-queued
+    batch requests (weight 4:1), and batch still runs afterwards
+    (work-conserving). Per-request tokens are schedule-independent."""
+    model, params = model_and_params
+    rs = np.random.RandomState(23)
+    t = [0.0]
+    eng = ServingEngine(model, params, slots=1, segment=8, page_block=BS,
+                        cache_bucket=32, queue_cap=8,
+                        clock=lambda: (t.__setitem__(0, t[0] + 1.0),
+                                       t[0])[1])
+    prompts = {n: rs.randint(0, VOCAB, 9) for n in ("b1", "b2", "i1")}
+    b1 = eng.submit(prompts["b1"], 8, slo="batch")
+    b2 = eng.submit(prompts["b2"], 8, slo="batch")
+    i1 = eng.submit(prompts["i1"], 8, slo="interactive")
+    for _ in range(40):
+        eng.step()
+        if all(eng.poll(r)[1] for r in (b1, b2, i1)):
+            break
+    order = sorted((b1, b2, i1), key=lambda r: eng.timings(r)["t_first"])
+    assert order[0] == i1, "interactive should pre-empt queued batch work"
+    assert order[1:] == [b1, b2], "batch stays FIFO within its class"
+    for rid, name in ((b1, "b1"), (b2, "b2"), (i1, "i1")):
+        np.testing.assert_array_equal(
+            np.asarray(eng.poll(rid)[0], np.int32),
+            _solo(model, params, prompts[name], 8))
+    st = eng.stats()
+    assert st["queue_interactive"] == 0 and st["queue_batch"] == 0
+
+
+def test_tenant_and_prefix_validation(model_and_params):
+    """The validation-hardening satellite: tenant labels violating the
+    bounded-cardinality contract, unknown SLO classes, and a declared
+    prefix longer than the prompt all die structured at submit — engine,
+    batcher, and daemon handler alike."""
+    model, params = model_and_params
+    eng = ServingEngine(model, params, slots=2, segment=8, page_block=BS,
+                        cache_bucket=32, queue_cap=4, max_tenants=2)
+    p = np.arange(5, dtype=np.int32) % VOCAB
+    with pytest.raises(ValueError, match="cardinality"):
+        eng.submit(p, 4, tenant="a/b/c")          # path-like label value
+    with pytest.raises(ValueError, match="cardinality"):
+        eng.submit(p, 4, tenant="x" * 80)         # oversized label value
+    with pytest.raises(ValueError, match="slo"):
+        eng.submit(p, 4, slo="turbo")
+    with pytest.raises(ValueError, match="prefix_len"):
+        eng.submit(p, 4, prefix_len=6)            # longer than the prompt
+    eng.submit(p, 4, tenant="t1")
+    eng.submit(p, 4, tenant="t2")
+    with pytest.raises(ValueError, match="tenant"):
+        eng.submit(p, 4, tenant="t3")             # past the series budget
+    b = _batcher(model, params, slots=2)
+    with pytest.raises(ValueError, match="prefix_len"):
+        b.serve([Request(0, p.copy(), 4, prefix_len=99)])
+    from paddle_tpu.serving import ServingDaemon
+    d = ServingDaemon(ServingEngine(model, params, slots=2, segment=8,
+                                    page_block=BS, cache_bucket=32))
+    r = d._do_submit({"prompt": [3, 5], "max_new": 4, "tenant": "a b"})
+    assert r["ok"] is False and r["code"] == "invalid_argument"
+    r = d._do_submit({"prompt": [3, 5], "max_new": 4, "prefix_len": 9})
+    assert r["ok"] is False and r["code"] == "invalid_argument"
+
+
+def test_prefix_metrics_and_tenant_labels(model_and_params):
+    """The serving.prefix_* catalogue entries and per-tenant labels land
+    in a live registry with the documented label keys."""
+    model, params = model_and_params
+    rs = np.random.RandomState(31)
+    reg = obs.MetricsRegistry()
+    with obs.ObsSession(registry=reg).installed():
+        eng = ServingEngine(model, params, slots=2, segment=8,
+                            page_block=BS, cache_bucket=32, queue_cap=8,
+                            prefix_cache=True)
+        prompt = rs.randint(0, VOCAB, 18)
+        # sequential waves so the second admission HITS the first's blocks
+        r0 = eng.submit(prompt.copy(), 6, tenant="acme")
+        while not eng.poll(r0)[1]:
+            eng.step()
+        r1 = eng.submit(prompt.copy(), 6, tenant="acme")
+        while not eng.poll(r1)[1]:
+            eng.step()
+    samples = reg.collect()
+    by_name = {}
+    for s in samples:
+        by_name.setdefault(s["name"], []).append(s)
+    assert "serving.prefix_misses_total" in by_name
+    assert "serving.prefix_hits_total" in by_name
+    assert "serving.prefix_pages_shared" in by_name
+    assert any(s["labels"].get("tenant") == "acme"
+               for s in by_name["serving.prefix_hits_total"])
+    done = by_name["serving.requests_total"]
+    assert all(s["labels"].get("tenant") == "acme" for s in done)
