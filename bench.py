@@ -308,6 +308,10 @@ def main(full: bool = False):
     # warm-vs-cold — TTFT p50 and prefill FLOPs/token vs hit rate
     rows.append(("__import__('benchmarks.serving_prefix', fromlist=['x'])"
                  ".run()", ROW_TIMEOUT))
+    # the autotune rows (ROADMAP item 3): tuned-vs-heuristic plan deltas
+    # for the fused-RNN families + the measured decode-route crossover
+    rows.append(("__import__('benchmarks.autotune_delta', fromlist=['x'])"
+                 ".run()", ROW_TIMEOUT))
     if full:
         # the remaining BASELINE.md rows, so a --full session covers the
         # whole measured table in one output

@@ -1,0 +1,95 @@
+"""Tuned-vs-heuristic bench rows — the autotune plane's evidence.
+
+Runs `paddle_tpu tune`'s measurement driver over the fused-RNN families
+(textcls LSTM + NMT-encoder GRU) and the decode-routing space on the
+CURRENT backend, then reports one row per shape family: the measured
+speedup of the tuned plan over the heuristic plan, with the winning plan
+in the note. On TPU the families are the real bench shapes (``bench``
+profile); off-TPU the sweep runs the same kernels through the Pallas
+interpreter at proxy dims (``cpu`` profile — noted per row; interpreter
+ratios do not transfer to the chip, the closed loop does).
+
+The sweep writes into a throwaway cache file (a bench row must not mutate
+``~/.paddle_tpu``) and points the in-process consult at it, so the rows'
+``plan_source: "tuned"`` stamp is literally true: the routing entries
+resolved these plans from a measured cache while the row ran.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List
+
+
+def run() -> List[dict]:
+    from benchmarks.mfu import attach_hbm_bw, attach_mfu
+
+    from paddle_tpu import tune
+    cache_path = os.path.join(tempfile.mkdtemp(prefix="pt_autotune_row_"),
+                              "autotune.json")
+    prev = os.environ.get(tune.CACHE_ENV)
+    os.environ[tune.CACHE_ENV] = cache_path
+    tune.reset()
+    try:
+        report = tune.run_tune(spaces=("fused_rnn", "decode_route"),
+                               cache_path=cache_path)
+        rows: List[dict] = []
+        for r in report["results"]:
+            if r["space"] == "fused_rnn":
+                if r.get("plan") is None:
+                    continue
+                tuned_s = (r["tuned_ms"] or 0.0) / 1e3
+                row = {
+                    "metric": (f"fused_rnn_train_autotune_"
+                               f"{r['kernel']}_{r['family']}"),
+                    "value": r.get("speedup"),
+                    "unit": "x_tuned_vs_heuristic",
+                    "vs_baseline": None,
+                    "plan_source": "tuned",
+                    "note": {
+                        "tuned_plan": r["plan"],
+                        "heuristic_plan": r.get("heuristic_plan"),
+                        "tuned_ms": r.get("tuned_ms"),
+                        "heuristic_ms": r.get("heuristic_ms"),
+                        "candidates": r.get("candidates"),
+                        "family_note": r.get("note"),
+                        "backend": report["backend"],
+                        "device_kind": report["device_kind"],
+                    },
+                }
+                # mfu is an honest null here: the row's value is a RATIO
+                # of two measured times of the same kernel, not a
+                # throughput (methodology stays "measured")
+                rows.append(attach_mfu(row, None, max(tuned_s, 1e-9)))
+            elif r["space"] == "decode_route":
+                row = {
+                    "metric": "autotune_decode_route_crossover",
+                    "value": r["plan"].get("kernel_min_len"),
+                    "unit": "min_kernel_len_tokens",
+                    "vs_baseline": None,
+                    "plan_source": "tuned",
+                    "methodology": "measured",
+                    "note": {
+                        "sweep": r.get("sweep"),
+                        "heuristic_plan": r.get("heuristic_plan"),
+                        "family_note": r.get("note"),
+                        "backend": report["backend"],
+                        "device_kind": report["device_kind"],
+                    },
+                }
+                rows.append(attach_hbm_bw(row, None, 1.0,
+                                          methodology="measured"))
+        return rows
+    finally:
+        if prev is None:
+            os.environ.pop(tune.CACHE_ENV, None)
+        else:
+            os.environ[tune.CACHE_ENV] = prev
+        tune.reset()
+
+
+if __name__ == "__main__":
+    import json
+    for row in run():
+        print(json.dumps(row))

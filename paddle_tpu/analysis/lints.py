@@ -67,6 +67,7 @@ LINT_CATALOGUE = {
     # bucket spec) — catalogued here so the id/severity live in one table
     "L006": ("shape-churn", Severity.WARNING),
     "L007": ("catalogue-drift", Severity.WARNING),
+    "L008": ("autotune-staleness", Severity.WARNING),
 }
 
 # control-flow / executor-lowered ops act through sub-blocks, not outputs
@@ -423,6 +424,75 @@ def lint_catalogue_drift(root=None, catalogue=None,
             "(orphan)", var=name,
             hint="delete the entry, or wire the metric where it was "
                  "meant to be observed"))
+    return diags
+
+
+def lint_autotune_cache(path=None,
+                        severity: Severity = None) -> List[Diagnostic]:
+    """L008: the autotune cache vs the CURRENT plan spaces — staleness.
+
+    An autotune entry is only as good as the candidate set that produced
+    it: when a plan space changes (``paddle_tpu.tune.spaces.SPACE_DEFS``),
+    previously tuned winners may no longer exist, or better candidates may
+    have appeared. Stale entries are IGNORED at consult time (the
+    heuristics silently own those decisions again), so the lint is what
+    makes the degradation visible: it flags a schema-version mismatch
+    (whole file ignored), entries whose ``space_hash`` differs from the
+    current space's hash, and entries naming unknown spaces. Fix: re-run
+    ``paddle_tpu tune``. ``path=None`` resolves
+    ``$PADDLE_TPU_AUTOTUNE_CACHE`` / ``~/.paddle_tpu/autotune.json``; a
+    missing file is clean (nothing tuned, nothing stale)."""
+    import json
+    import os
+
+    from ..tune import cache as _tcache
+    from ..tune import spaces as _tspaces
+    sev = severity if severity is not None else LINT_CATALOGUE["L008"][1]
+    diags: List[Diagnostic] = []
+    path = path or _tcache.default_cache_path()
+    if not os.path.exists(path):
+        return diags
+
+    def emit(msg: str, hint: str, **kw):
+        diags.append(Diagnostic("L008", sev, msg, hint=hint, **kw))
+
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        emit(f"autotune cache {path} is unreadable ({e}); every consult "
+             "falls back to heuristics",
+             "delete the file or re-run `paddle_tpu tune`")
+        return diags
+    version = data.get("schema_version") if isinstance(data, dict) else None
+    if version != _tcache.SCHEMA_VERSION:
+        emit(f"autotune cache {path} has schema_version {version!r} "
+             f"(supported: {_tcache.SCHEMA_VERSION}); the whole file is "
+             "ignored at consult time",
+             "re-run `paddle_tpu tune` to rewrite it")
+        return diags
+    entries = data.get("entries") or {}
+    for key, entry in sorted(entries.items()):
+        if not isinstance(entry, dict):
+            emit(f"autotune entry {key!r} is not an object",
+                 "re-run `paddle_tpu tune`", var=key)
+            continue
+        space = entry.get("space")
+        if space not in _tspaces.SPACE_DEFS:
+            emit(f"autotune entry {key!r} names unknown plan space "
+                 f"{space!r} (known: {list(_tspaces.SPACE_NAMES)}); "
+                 "ignored at consult time",
+                 "the space was removed/renamed — re-run `paddle_tpu "
+                 "tune` to drop it", var=key)
+            continue
+        current = _tspaces.space_hash(space)
+        if entry.get("space_hash") != current:
+            emit(f"autotune entry {key!r} was tuned under plan-space hash "
+                 f"{entry.get('space_hash')!r} but the current "
+                 f"{space!r} space hashes {current!r}; the entry is "
+                 "STALE and ignored at consult time (heuristic applies)",
+                 "re-run `paddle_tpu tune` to re-measure under the new "
+                 "candidate set", var=key)
     return diags
 
 

@@ -304,6 +304,12 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
                                           "the first (completion - first "
                                           "token) / (n - 1), labels: "
                                           "tenant (bounded)", ("tenant",)),
+    # -- tune: tune/driver.py (`paddle_tpu tune`) -----------------------
+    "tune.measurements_total": ("counter", "candidate-plan timings taken "
+                                           "by the autotune driver (one "
+                                           "per timed dispatch), labels: "
+                                           "space",
+                                ("space",)),
     # -- trainer: trainer/trainer.py ------------------------------------
     "trainer.steps_total": ("counter", "train batches executed"),
     "trainer.examples_total": ("counter", "samples consumed (leading dim "

@@ -398,9 +398,20 @@ def decode_route(L: int, route: Optional[str] = None) -> str:
     will take for a read of L rows — exposed so cost accounting
     (obs/roofline.py kernel models) can ask WITHOUT dispatching: modeled
     kernel bytes apply only on the kernel route; the dense route's bytes
-    are already visible to XLA's own cost analysis."""
+    are already visible to XLA's own cost analysis.
+
+    A MEASURED crossover from the autotune cache (``paddle_tpu tune``,
+    paddle_tpu.tune) replaces the ``SHORT_SEQ_DENSE`` heuristic when one
+    exists for this device_kind: the tuned ``kernel_min_len`` (null =
+    the dense route won at every measured length) decides, and off-TPU
+    hosts then honor it through the interpreter — both routes share one
+    masked-softmax formulation, so the swap never changes tokens."""
     if route is not None:
         return route
+    from .. import tune
+    thr = tune.decode_kernel_min_len()
+    if thr is not tune.MISS:
+        return "kernel" if thr is not None and L >= thr else "dense"
     return "kernel" if _on_tpu() and L >= SHORT_SEQ_DENSE else "dense"
 
 

@@ -40,6 +40,7 @@ Invariants the exactness contract rides on:
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -50,6 +51,21 @@ from .. import obs
 from ..core.lod import bucket_length
 from .batcher import Request, clip_emission, validate_request
 from .prefix import Match, PrefixIndex
+
+#: per-model shared jitted-program cache: every PagePool over the same
+#: model instance resolves its admit/hit/segment programs here, keyed by
+#: the full closure signature (kind, kv_dtype, page size, segment, bucket
+#: dims) — pool ARRAYS are call arguments, so pools of any page count
+#: share one traced executable per shape family. Weak-keyed: a gc'd model
+#: drops its programs with it.
+_SHARED_FNS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _shared_fn_cache(model) -> dict:
+    d = _SHARED_FNS.get(model)
+    if d is None:
+        d = _SHARED_FNS[model] = {}
+    return d
 
 
 class _AdmitPlan:
@@ -81,12 +97,22 @@ class PagePool:
     per cache-read bucket (in pages)."""
 
     def __init__(self, model, params, *, slots: int, segment: int = 32,
-                 page_block: int = 64, pages: Optional[int] = None,
+                 page_block: Optional[int] = None,
+                 pages: Optional[int] = None,
                  cache_bucket: int = 256,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
                  kv_dtype: Optional[str] = None,
                  prefix_cache: bool = False,
                  prefix_half_life: int = 64):
+        if page_block is None:
+            # autotune consult (paddle_tpu.tune, `paddle_tpu tune`): a
+            # measured winner validated against THIS pool's grid
+            # (divides max_len and cache_bucket), else the 64 heuristic.
+            # Page size changes read geometry only — the assembled row
+            # order is identical at any block, so tokens never change
+            # (test_serving_paged.py holds paged==solo at page_block=8).
+            from .. import tune
+            page_block = tune.page_block(model.max_len, cache_bucket) or 64
         if model.max_len % page_block:
             raise ValueError(f"page_block {page_block} must divide "
                              f"max_len {model.max_len}")
@@ -160,9 +186,13 @@ class PagePool:
         self.admit_flops_total = 0.0     # PR 9 cost-ledger FLOPs of the
         #                                  admission dispatches (0 when the
         #                                  obs plane is off)
-        self._admit_fns = {}        # (tpad, nbp) -> jitted full admission
-        self._hit_fns = {}          # (tpad, nbr) -> jitted suffix admission
-        self._seg_fns = {}          # nb -> jitted segment scan
+        # jitted admission/segment programs are shared PER MODEL INSTANCE
+        # across pools (keys carry everything else the closures capture:
+        # kv_dtype, page size, segment, bucket dims): a rebuilt
+        # pool/engine over the same model re-traces nothing, and the test
+        # suite's session-shared model turns the paged parity suite's
+        # per-test pools into one traced executable per shape family
+        self._fns = _shared_fn_cache(model)
 
     # -- accounting --------------------------------------------------------
     @property
@@ -338,7 +368,8 @@ class PagePool:
 
     # -- jitted programs ---------------------------------------------------
     def _admit_fn(self, tpad: int, nbp: int):
-        fn = self._admit_fns.get((tpad, nbp))
+        key = ("admit", self.kv_dtype, self.bs, tpad, nbp)
+        fn = self._fns.get(key)
         if fn is None:
             model, kv_dtype, bs = self.model, self.kv_dtype, self.bs
             tpp = nbp * bs
@@ -370,7 +401,7 @@ class PagePool:
             # prefill-FLOPs-per-token evidence of the prefix bench row
             fn = obs.roofline.instrument(
                 jax.jit(admit, donate_argnums=(1,)), "serving.admit")
-            self._admit_fns[(tpad, nbp)] = fn
+            self._fns[key] = fn
         return fn
 
     def _hit_fn(self, tpad: int, nbr: int):
@@ -379,7 +410,8 @@ class PagePool:
         their offsets against the pre-populated block tables
         (models/transformer.py prefill_paged). One compile per
         (suffix-pad, read-pages) bucket pair."""
-        fn = self._hit_fns.get((tpad, nbr))
+        key = ("hit", self.kv_dtype, self.bs, tpad, nbr)
+        fn = self._fns.get(key)
         if fn is None:
             model = self.model
 
@@ -397,11 +429,12 @@ class PagePool:
             fn = obs.roofline.instrument(
                 jax.jit(admit_sfx, donate_argnums=(1,)),
                 "serving.admit_prefix")
-            self._hit_fns[(tpad, nbr)] = fn
+            self._fns[key] = fn
         return fn
 
     def _seg_fn(self, nb: int):
-        fn = self._seg_fns.get(nb)
+        key = ("seg", self.kv_dtype, self.bs, self.segment, nb)
+        fn = self._fns.get(key)
         if fn is None:
             model, segment = self.model, self.segment
 
@@ -420,7 +453,7 @@ class PagePool:
                 return pools_out, cur, jnp.moveaxis(toks, 0, 1)
             fn = obs.roofline.instrument(
                 jax.jit(seg, donate_argnums=(1,)), "serving.segment")
-            self._seg_fns[nb] = fn
+            self._fns[key] = fn
         return fn
 
     # -- the two scheduler-visible operations ------------------------------
@@ -666,7 +699,8 @@ class PagedBatcher:
     sharing (copy-on-write radix index; see :class:`PagePool`)."""
 
     def __init__(self, model, params, *, slots: int = 8, segment: int = 32,
-                 page_block: int = 64, pages: Optional[int] = None,
+                 page_block: Optional[int] = None,
+                 pages: Optional[int] = None,
                  cache_bucket: int = 256,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
                  schedule: str = "longest_first",

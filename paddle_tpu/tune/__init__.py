@@ -1,0 +1,152 @@
+"""paddle_tpu.tune — the measured autotuning plane (ROADMAP item 3).
+
+Closes the TVM loop: the plan knobs every Pallas route used to hard-code
+(``_fused_plan``'s wide-tile preference, the ``SHORT_SEQ_DENSE`` decode
+crossover, the paged-cache ``page_block``) become enumerable plan spaces
+(:mod:`~paddle_tpu.tune.spaces`), a measurement driver
+(:mod:`~paddle_tpu.tune.driver`, ``paddle_tpu tune``) times every
+candidate on the current backend, and winners persist in a versioned
+cache (:mod:`~paddle_tpu.tune.cache`) the routing entries consult first.
+
+The consult functions here are the routing entries' ONLY doorway into the
+cache, and they are fail-safe by construction: any miss, hash staleness,
+schema mismatch, or illegal plan returns the "no tuned entry" answer and
+the caller's heuristic decides — tuned plans change speed, never
+numerics (tests/test_autotune.py holds route/plan choice to bit parity).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from .cache import (CACHE_ENV, DISABLE_ENV, SCHEMA_VERSION, AutotuneCache,
+                    default_cache_path, get_cache, load_cache, reset,
+                    set_cache)
+from .spaces import (PROFILES, SPACE_DEFS, SPACE_NAMES, fused_candidates,
+                     fused_family, space_hash)
+
+__all__ = [
+    "AutotuneCache", "CACHE_ENV", "DISABLE_ENV", "SCHEMA_VERSION",
+    "default_cache_path", "load_cache", "get_cache", "set_cache", "reset",
+    "SPACE_DEFS", "SPACE_NAMES", "PROFILES", "space_hash", "fused_family",
+    "fused_candidates", "run_tune", "results_markdown", "MISS",
+    "fused_plan", "decode_kernel_min_len", "page_block", "plan_source",
+]
+
+#: sentinel for "no tuned entry applies — the heuristic decides". Distinct
+#: from None, which several plans use as a real value (e.g. a tuned
+#: ``kernel_min_len: null`` = "the dense route won everywhere, measured").
+MISS = object()
+
+
+def _device_kind() -> str:
+    from ..obs.roofline import _device_kind as dk
+    return dk()
+
+
+def _fresh_entry(space: str, kernel: str,
+                 family: str) -> Optional[Dict[str, Any]]:
+    """The active cache's entry for (space, kernel, device_kind, family),
+    or None — misses include hash-stale entries (the plan space changed
+    under the cache; ``paddle_tpu lint`` reports those as L008)."""
+    cache = get_cache()
+    if cache is None:
+        return None
+    entry = cache.get(space, kernel, _device_kind(), family)
+    if entry is None or entry.get("space_hash") != space_hash(space):
+        return None
+    return entry
+
+
+def fused_plan(kernel: str, *, T: int, H: int, gates: int,
+               seq_h_units: int, batch: int,
+               budget_bytes: int = 15_500_000,
+               double_buffer_always: bool = False
+               ) -> Optional[Tuple[int, int]]:
+    """Tuned (block_b, chunk_t) for one fused-RNN launch, or None.
+
+    The plan is re-validated against :func:`ops.rnn.plan_is_legal` on
+    THIS machine before it is honored — a cache copied from a different
+    chip (or hand-edited) can cost a heuristic fallback, never an illegal
+    kernel launch."""
+    entry = _fresh_entry("fused_rnn", kernel,
+                         fused_family(gates=gates, T=T, H=H, batch=batch))
+    if entry is None:
+        return None
+    plan = entry.get("plan")
+    if (not isinstance(plan, (list, tuple)) or len(plan) != 2
+            or not all(isinstance(v, int) and v > 0 for v in plan)):
+        return None
+    blk, chunk = plan
+    from ..ops.rnn import plan_is_legal
+    if not plan_is_legal(T, H, gates, seq_h_units, batch, blk, chunk,
+                         budget_bytes=budget_bytes,
+                         double_buffer_always=double_buffer_always):
+        return None
+    return blk, chunk
+
+
+def decode_kernel_min_len():
+    """Tuned decode-route crossover: the read length from which the
+    Pallas kernel route wins on this device_kind. Returns :data:`MISS`
+    when no tuned entry applies (heuristic decides), None when the tuned
+    verdict is "dense everywhere", else a positive int."""
+    entry = _fresh_entry("decode_route", "decode_attention", "default")
+    if entry is None:
+        return MISS
+    plan = entry.get("plan")
+    if not isinstance(plan, dict) or "kernel_min_len" not in plan:
+        return MISS
+    v = plan["kernel_min_len"]
+    if v is None:
+        return None
+    if isinstance(v, int) and v >= 1:
+        return v
+    return MISS
+
+
+def page_block(max_len: int, cache_bucket: int) -> Optional[int]:
+    """Tuned paged-KV page size, validated against the caller's grid
+    (must divide ``max_len`` and ``cache_bucket``), or None."""
+    entry = _fresh_entry("page_block", "paged_decode_attention", "default")
+    if entry is None:
+        return None
+    plan = entry.get("plan")
+    if not isinstance(plan, dict):
+        return None
+    bs = plan.get("page_block")
+    if (isinstance(bs, int) and bs >= 1 and max_len % bs == 0
+            and cache_bucket % bs == 0):
+        return bs
+    return None
+
+
+def plan_source() -> str:
+    """"tuned" when an autotune cache with at least one current-hash entry
+    for THIS device_kind is active, else "heuristic" — the bench rows'
+    ``plan_source`` stamp (analysis/bench_schema.py): it records whether
+    the process's kernel-plan consults could resolve against measured
+    winners during the row."""
+    cache = get_cache()
+    if cache is None:
+        return "heuristic"
+    dk = _device_kind()
+    for entry in cache.entries.values():
+        if (entry.get("device_kind") == dk
+                and entry.get("space") in SPACE_DEFS
+                and entry.get("space_hash")
+                == space_hash(entry["space"])):
+            return "tuned"
+    return "heuristic"
+
+
+def run_tune(*args, **kwargs):
+    """Lazy veneer over :func:`tune.driver.run_tune` (keeps ``import
+    paddle_tpu`` free of the driver's jax-heavy measurement path)."""
+    from .driver import run_tune as _run
+    return _run(*args, **kwargs)
+
+
+def results_markdown(report):
+    from .driver import results_markdown as _md
+    return _md(report)

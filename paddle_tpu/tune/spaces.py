@@ -1,0 +1,155 @@
+"""Plan spaces — the enumerable candidate sets the tuner searches.
+
+The Tensor-Processing-Primitives shape (PAPERS.md): each hand kernel
+exposes a SMALL spec of micro-kernel parameters, and tuning is a measured
+search over their composition rather than a hand-written preference list.
+Three spaces ship:
+
+* ``fused_rnn`` — (block_b, chunk_t) launch plans for the whole-sequence
+  LSTM/GRU kernels, per ``(kernel, shape family)``. Candidates are exactly
+  the plans ``ops.rnn.plan_is_legal`` admits (one owner for the VMEM cost
+  model), so a cached winner can never be an illegal launch.
+* ``decode_route`` — the dense-vs-kernel crossover length for
+  ``decode_attention`` / ``paged_decode_attention``: the tuner measures
+  both routes over a length grid and persists the smallest length from
+  which the kernel route stays faster (``kernel_min_len``; null when the
+  dense route wins everywhere — the measured truth on CPU hosts).
+* ``page_block`` — the paged KV-cache page size: candidates are the
+  power-of-two blocks; ``PagePool(page_block=None)`` consults the winner
+  and validates divisibility against its own ``max_len``/``cache_bucket``.
+
+Every space carries a static ``SPACE_DEFS`` literal; :func:`space_hash`
+digests it. Entries persist the hash they were tuned under, so a code
+change to a candidate set invalidates old winners — ignored at consult
+time, reported by ``paddle_tpu lint`` as L008.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+#: static, hash-stable definition of each plan space. Bump ``version`` (or
+#: change any constant) to invalidate previously tuned entries.
+SPACE_DEFS: Dict[str, Dict[str, Any]] = {
+    "fused_rnn": {
+        "version": 1,
+        "blocks": [8, 16, 32, 64],
+        "chunks": [8, 16, 32, 64, 128, 256],
+        "budget_bytes": 15_500_000,
+    },
+    "decode_route": {
+        "version": 1,
+        "routes": ["dense", "kernel"],
+        "plan": "kernel_min_len",
+    },
+    "page_block": {
+        "version": 1,
+        "blocks": [16, 32, 64, 128],
+    },
+}
+
+SPACE_NAMES = tuple(sorted(SPACE_DEFS))
+
+
+def _hash_def(name: str) -> str:
+    blob = json.dumps({"space": name, "def": SPACE_DEFS[name]},
+                      sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+#: digests computed ONCE at import — consults run on trace-time paths, so
+#: space_hash must be a dict get, not a re-serialization
+_SPACE_HASHES: Dict[str, str] = {n: _hash_def(n) for n in SPACE_DEFS}
+
+
+def space_hash(name: str) -> str:
+    """Stable digest of one plan space's candidate-set definition."""
+    return _SPACE_HASHES[name]
+
+
+def fused_family(*, gates: int, T: int, H: int, batch: int) -> str:
+    """The fused-RNN shape-family key — exact (gates, T, H, B): a tuned
+    tile plan is only as good as the shape it was measured on, so lookups
+    never interpolate across shapes (a near-miss falls back to the
+    heuristic, which handles any shape)."""
+    return f"g{gates}_t{T}_h{H}_b{batch}"
+
+
+def fused_candidates(*, T: int, H: int, gates: int,
+                     seq_h_units: Optional[int] = None,
+                     batch: int,
+                     double_buffer_always: bool = False
+                     ) -> List[Tuple[int, int]]:
+    """Every legal (block_b, chunk_t) for one fused-RNN family, via the
+    ONE VMEM legality model (``ops.rnn.plan_is_legal``)."""
+    from ..ops import rnn
+    d = SPACE_DEFS["fused_rnn"]
+    if seq_h_units is None:
+        seq_h_units = gates + 1
+    blocks = [b for b in d["blocks"] if b <= max(batch, 8)]
+    if batch < 8:
+        blocks = [batch]
+    out: List[Tuple[int, int]] = []
+    chunks = sorted({min(c, T) for c in d["chunks"] if c <= T} | {T})
+    for blk in blocks:
+        for chunk in chunks:
+            if rnn.plan_is_legal(T, H, gates, seq_h_units, batch, blk,
+                                 chunk, budget_bytes=d["budget_bytes"],
+                                 double_buffer_always=double_buffer_always):
+                out.append((blk, chunk))
+    return out
+
+
+#: measurement profiles: which families/lengths the driver sweeps.
+#: ``smoke`` is the CI/--check profile (seconds, CPU interpret); ``cpu``
+#: is the default off-TPU profile — PROXY dims of the textcls/NMT
+#: families sized for the interpreter (noted on every row/entry);
+#: ``bench`` is the on-chip profile with the real bench-family shapes.
+PROFILES: Dict[str, Dict[str, Any]] = {
+    "smoke": {
+        "reps": 1,
+        "fused_families": [
+            {"kernel": "lstm_sequence_fused", "gates": 4, "seq_h_units": 6,
+             "T": 8, "H": 8, "batch": 8, "note": "smoke"},
+        ],
+        "decode": {"lengths": [32, 64], "batch": 2, "n_heads": 2,
+                   "d_head": 8, "note": "smoke"},
+        "page_block": {"read_pages": 4, "batch": 2, "n_heads": 2,
+                       "d_head": 8, "blocks": [16, 32], "note": "smoke"},
+    },
+    "cpu": {
+        "reps": 2,
+        "fused_families": [
+            # textcls-h256 proxy (interpret-sized: same gate structure,
+            # reduced T/H/B so the sweep finishes in CI time)
+            {"kernel": "lstm_sequence_fused", "gates": 4, "seq_h_units": 6,
+             "T": 16, "H": 32, "batch": 16, "note": "textcls proxy"},
+            # NMT-encoder GRU proxy
+            {"kernel": "gru_sequence_fused", "gates": 3, "seq_h_units": 4,
+             "T": 16, "H": 32, "batch": 16, "note": "nmt-encoder proxy"},
+        ],
+        "decode": {"lengths": [64, 128, 256], "batch": 4, "n_heads": 4,
+                   "d_head": 8, "note": "serving-dims proxy"},
+        "page_block": {"read_pages": 8, "batch": 4, "n_heads": 4,
+                       "d_head": 8, "blocks": [16, 32, 64],
+                       "note": "serving-dims proxy"},
+    },
+    "bench": {
+        "reps": 3,
+        "fused_families": [
+            {"kernel": "lstm_sequence_fused", "gates": 4, "seq_h_units": 6,
+             "T": 64, "H": 256, "batch": 64, "note": "textcls h256"},
+            {"kernel": "lstm_sequence_fused", "gates": 4, "seq_h_units": 6,
+             "T": 64, "H": 512, "batch": 64, "note": "textcls h512"},
+            {"kernel": "gru_sequence_fused", "gates": 3, "seq_h_units": 4,
+             "T": 32, "H": 512, "batch": 64, "note": "nmt encoder"},
+        ],
+        "decode": {"lengths": [128, 256, 512, 1024, 2048], "batch": 8,
+                   "n_heads": 12, "d_head": 64, "note": "gpt2s decode"},
+        "page_block": {"read_pages": 16, "batch": 8, "n_heads": 12,
+                       "d_head": 64, "blocks": [16, 32, 64, 128],
+                       "note": "gpt2s decode"},
+    },
+}

@@ -43,6 +43,40 @@ def _session_compile_cache(tmp_path_factory):
     yield
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _hermetic_autotune(tmp_path_factory):
+    """Point the autotune consult at a session-local (absent) cache file:
+    a developer's real ~/.paddle_tpu/autotune.json must never steer test
+    plans (tuned plans are parity-safe by construction, but the suite's
+    route/plan assertions pin exact heuristic decisions). Tests that
+    exercise the consult install their own caches via
+    paddle_tpu.tune.set_cache / $PADDLE_TPU_AUTOTUNE_CACHE; the env var
+    is exported so subprocess tests inherit the hermetic path."""
+    from paddle_tpu import tune
+    if not os.environ.get(tune.CACHE_ENV):
+        os.environ[tune.CACHE_ENV] = str(
+            tmp_path_factory.mktemp("autotune") / "autotune.json")
+        tune.reset()
+    yield
+
+
+@pytest.fixture(scope="session")
+def paged_model_and_params():
+    """ONE TransformerLM (the shared serving dims: VOCAB=97, D=32, H=4,
+    L=2, MAX_LEN=128) for the paged/prefix serving suites — ROADMAP
+    item 5's shared-executable fixture. PagePool shares its jitted
+    admission/segment programs PER MODEL INSTANCE
+    (serving/paged.py _SHARED_FNS), so a session-scoped model means each
+    shape family traces once for the whole suite instead of once per
+    test, and the model's own generate/prefill jit caches carry the solo
+    references across files too."""
+    from paddle_tpu.models import TransformerLM
+    model = TransformerLM(97, d_model=32, n_heads=4, n_layers=2,
+                          max_len=128)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deterministic fault-injection tests "

@@ -1,0 +1,318 @@
+"""The measured autotuning plane (paddle_tpu/tune + the routing consults).
+
+Contracts under test:
+
+* CACHE — versioned round trip, env-path resolution, schema refusal,
+  atomic save; a corrupt/stale/illegal entry degrades to the heuristic,
+  never to an error or an illegal launch;
+* CONSULT — `_fused_plan`/`decode_route`/`PagePool` actually read the
+  installed cache (kernels.routes_total flips, plans swap);
+* PARITY — the tentpole invariant: tuned plans change SPEED, never
+  outputs. Fused-RNN forward AND backward are bit-equal across plans
+  (and match the scan reference); greedy tokens through a tuned decode
+  route equal the dense-route stream token for token;
+* LINT — L008 flags schema/space-hash staleness;
+* CLI — `paddle_tpu tune --check` closes the measure→persist→consult
+  loop end to end on the CPU interpret backend.
+
+Decode dims are the shared serving dims (VOCAB=97, D=32, H=4, L=2,
+MAX_LEN=128) so the session compile cache absorbs trace costs.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import obs, tune
+from paddle_tpu.ops import pallas_kernels as pk
+from paddle_tpu.ops import rnn as R
+
+VOCAB, D, H, L, MAX_LEN = 97, 32, 4, 2, 128
+
+
+@pytest.fixture
+def tune_cache():
+    """An empty installed AutotuneCache the test can drop entries into;
+    uninstalls afterwards (the session env points consults at a
+    nonexistent file, so post-test lookups miss)."""
+    c = tune.AutotuneCache()
+    tune.set_cache(c)
+    yield c
+    tune.reset()
+
+
+def _put_fused(cache, kernel, plan, *, gates, T, H_, batch, stale=False):
+    return cache.put(
+        "fused_rnn", kernel, "cpu",
+        tune.fused_family(gates=gates, T=T, H=H_, batch=batch), list(plan),
+        "deadbeef" if stale else tune.space_hash("fused_rnn"),
+        methodology="measured")
+
+
+# -- cache mechanics -----------------------------------------------------
+
+def test_cache_roundtrip_env_and_schema(tmp_path, monkeypatch):
+    c = tune.AutotuneCache()
+    c.put("decode_route", "decode_attention", "cpu", "default",
+          {"kernel_min_len": 96}, tune.space_hash("decode_route"),
+          methodology="measured", tuned_ms=1.0)
+    path = c.save(str(tmp_path / "autotune.json"))
+    loaded = tune.load_cache(path)
+    e = loaded.get("decode_route", "decode_attention", "cpu", "default")
+    assert e is not None and e["plan"]["kernel_min_len"] == 96
+    assert e["methodology"] == "measured"
+    # the consult honors $PADDLE_TPU_AUTOTUNE_CACHE
+    monkeypatch.setenv(tune.CACHE_ENV, path)
+    tune.reset()
+    try:
+        assert tune.decode_kernel_min_len() == 96
+        assert tune.plan_source() == "tuned"
+    finally:
+        tune.reset()
+    # a future schema version is refused loudly at load...
+    bad = dict(c.to_dict(), schema_version=99)
+    (tmp_path / "bad.json").write_text(json.dumps(bad))
+    with pytest.raises(ValueError, match="schema_version"):
+        tune.load_cache(str(tmp_path / "bad.json"))
+    # ...and silently (warn-once) ignored on the consult path
+    monkeypatch.setenv(tune.CACHE_ENV, str(tmp_path / "bad.json"))
+    tune.reset()
+    try:
+        with pytest.warns(RuntimeWarning, match="autotune cache"):
+            assert tune.decode_kernel_min_len() is tune.MISS
+    finally:
+        tune.reset()
+
+
+def test_consult_rejects_stale_and_illegal_entries(tune_cache):
+    heur = R._fused_plan(32, 16, seq_h_units=6, batch=16)
+    # a stale-hash entry is invisible: heuristic decides
+    _put_fused(tune_cache, "lstm_sequence_fused", (8, 8), gates=4, T=32,
+               H_=16, batch=16, stale=True)
+    assert R._fused_plan(32, 16, seq_h_units=6, batch=16,
+                         kernel="lstm_sequence_fused") == heur
+    # an illegal plan (batch tile not a multiple of 8, nor the whole
+    # batch) is rejected by plan_is_legal -> heuristic again
+    _put_fused(tune_cache, "lstm_sequence_fused", (12, 8), gates=4, T=32,
+               H_=16, batch=16)
+    assert R._fused_plan(32, 16, seq_h_units=6, batch=16,
+                         kernel="lstm_sequence_fused") == heur
+    # malformed plans never raise
+    tune_cache.put("page_block", "paged_decode_attention", "cpu",
+                   "default", {"page_block": "huge"},
+                   tune.space_hash("page_block"))
+    assert tune.page_block(128, 32) is None
+    tune_cache.put("decode_route", "decode_attention", "cpu", "default",
+                   {"wrong_key": 1}, tune.space_hash("decode_route"))
+    assert tune.decode_kernel_min_len() is tune.MISS
+
+
+def test_fused_plan_consult_swaps_plan(tune_cache):
+    heur = R._fused_plan(32, 16, seq_h_units=6, batch=16)
+    cands = tune.fused_candidates(T=32, H=16, gates=4, seq_h_units=6,
+                                  batch=16)
+    other = next(c for c in cands if c != heur)
+    _put_fused(tune_cache, "lstm_sequence_fused", other, gates=4, T=32,
+               H_=16, batch=16)
+    assert R._fused_plan(32, 16, seq_h_units=6, batch=16,
+                         kernel="lstm_sequence_fused") == other
+    # a different family (batch 8) misses -> heuristic
+    assert R._fused_plan(32, 16, seq_h_units=6, batch=8,
+                         kernel="lstm_sequence_fused") \
+        == R._fused_plan(32, 16, seq_h_units=6, batch=8)
+
+
+# -- the tentpole parity property ---------------------------------------
+
+def test_tuned_fused_plans_change_speed_never_outputs(tune_cache):
+    """Forward AND backward: every legal (block_b, chunk_t) launch of the
+    fused LSTM kernel produces BIT-identical outputs and gradients — so a
+    tuned plan (injected synthetic cache entry) can only change launch
+    geometry, never numerics. The scan reference bounds them all."""
+    T, B, H_ = 12, 8, 8
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(B, T, 5) * 0.3, jnp.float32)
+    lens = jnp.asarray(rs.randint(1, T + 1, B), jnp.int32)
+    w = jnp.asarray(rs.randn(5, 4 * H_) * 0.3, jnp.float32)
+    u = jnp.asarray(rs.randn(H_, 4 * H_) * 0.3, jnp.float32)
+    b = jnp.asarray(rs.randn(4 * H_) * 0.3, jnp.float32)
+    h0 = jnp.zeros((B, H_), jnp.float32)
+
+    heur = R._fused_plan(T, H_, seq_h_units=6, batch=B)
+    assert heur is not None
+    tuned = next(c for c in tune.fused_candidates(
+        T=T, H=H_, gates=4, seq_h_units=6, batch=B) if c != heur)
+    _put_fused(tune_cache, "lstm_sequence_fused", tuned, gates=4, T=T,
+               H_=H_, batch=B)
+    # inject a synthetic BACKWARD plan too (keyed separately), so the
+    # gradient path consults the cache as well
+    bwd_heur = R._fused_plan(T, H_, 4, 11, B, double_buffer_always=True)
+    bwd_cands = [c for c in tune.fused_candidates(
+        T=T, H=H_, gates=4, seq_h_units=11, batch=B,
+        double_buffer_always=True) if c != bwd_heur]
+    if bwd_cands:
+        tune_cache.put(
+            "fused_rnn", "lstm_sequence_fused_bwd", "cpu",
+            tune.fused_family(gates=4, T=T, H=H_, batch=B),
+            list(bwd_cands[0]), tune.space_hash("fused_rnn"))
+        assert R._fused_bwd_plan(T, H_, 4, 11, B,
+                                 kernel="lstm_sequence_fused_bwd") \
+            == bwd_cands[0]
+    consulted = R._fused_plan(T, H_, seq_h_units=6, batch=B,
+                              kernel="lstm_sequence_fused")
+    assert consulted == tuned != heur
+
+    def run(plan):
+        def f(x, w, u, b, h0):
+            out, ht, ct = R._lstm_fused(x, lens, w, u, b, h0, h0, 0.5,
+                                        plan[0], plan[1])
+            return out, ht, ct
+
+        out = f(x, w, u, b, h0)
+        loss = lambda *a: sum(jnp.sum(o * (i + 1.0))
+                              for i, o in enumerate(f(*a)))
+        grads = jax.grad(loss, argnums=(0, 1, 2, 3, 4))(x, w, u, b, h0)
+        return out, grads
+
+    out_t, g_t = run(consulted)
+    out_h, g_h = run(heur)
+    for a, bb in zip(out_t, out_h):        # plan choice: bit parity
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
+    for a, bb in zip(g_t, g_h):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
+    # and both match the scan reference (shared math, fp tolerance)
+    ref_out, ref_state = R._lstm_scan(x, lens, w, u, b, h0, h0, False, 0.5)
+    np.testing.assert_allclose(np.asarray(out_t[0]), np.asarray(ref_out),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_t[1]),
+                               np.asarray(ref_state.h), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_tuned_decode_route_greedy_token_parity(tune_cache,
+                                                paged_model_and_params):
+    """End to end through the model: an injected decode-route entry with
+    kernel_min_len=1 forces EVERY cache read onto the Pallas kernel route
+    (interpret on CPU — the promoted tuning/CI backend), and the greedy
+    stream is token-for-token equal to the dense-route stream. Route
+    consult is proven via kernels.routes_total."""
+    from paddle_tpu.models import TransformerLM
+    model, params = paged_model_and_params
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(0, VOCAB, 7)
+    base = np.asarray(model.generate_cached(
+        params, jnp.asarray(prompt[None]), steps=12))
+    tune_cache.put("decode_route", "decode_attention", "cpu", "default",
+                   {"kernel_min_len": 1},
+                   tune.space_hash("decode_route"),
+                   methodology="measured")
+    assert pk.decode_route(32) == "kernel"
+    # a FRESH model instance retraces its decode steps under the tuned
+    # route (the first model's jit cache pinned the dense executables)
+    model2 = TransformerLM(VOCAB, d_model=D, n_heads=H, n_layers=L,
+                           max_len=MAX_LEN)
+    params2 = model2.init(jax.random.PRNGKey(0))
+    reg = obs.MetricsRegistry()
+    with obs.ObsSession(registry=reg).installed():
+        got = np.asarray(model2.generate_cached(
+            params2, jnp.asarray(prompt[None]), steps=12))
+    np.testing.assert_array_equal(got, base)
+    routes = [s for s in reg.collect()
+              if s["name"] == "kernels.routes_total"
+              and s["labels"].get("kernel") == "decode_attention"]
+    assert any(s["labels"].get("route") == "kernel" and s["value"] > 0
+               for s in routes), routes
+
+
+def test_tuned_page_block_consult(tune_cache, paged_model_and_params):
+    from paddle_tpu.serving import PagePool
+    model, params = paged_model_and_params
+    # no entry -> the 64 heuristic
+    assert PagePool(model, params, slots=2, cache_bucket=128).bs == 64
+    tune_cache.put("page_block", "paged_decode_attention", "cpu",
+                   "default", {"page_block": 32},
+                   tune.space_hash("page_block"),
+                   methodology="measured")
+    assert PagePool(model, params, slots=2, cache_bucket=128).bs == 32
+    # explicit page_block always wins over the cache
+    assert PagePool(model, params, slots=2, page_block=8,
+                    cache_bucket=32).bs == 8
+    # a winner that does not divide this pool's grid falls back
+    tune_cache.put("page_block", "paged_decode_attention", "cpu",
+                   "default", {"page_block": 48},
+                   tune.space_hash("page_block"))
+    assert PagePool(model, params, slots=2, cache_bucket=128).bs == 64
+
+
+# -- lint + CLI ----------------------------------------------------------
+
+def test_lint_autotune_staleness_l008(tmp_path):
+    from paddle_tpu.analysis import lint_autotune_cache
+    # missing file: clean (nothing tuned, nothing stale)
+    assert lint_autotune_cache(str(tmp_path / "none.json")) == []
+    c = tune.AutotuneCache()
+    c.put("fused_rnn", "lstm_sequence_fused", "cpu", "g4_t8_h8_b8",
+          [8, 8], tune.space_hash("fused_rnn"))
+    path = c.save(str(tmp_path / "fresh.json"))
+    assert lint_autotune_cache(path) == []
+    # stale space hash -> one L008 naming the entry
+    c.put("fused_rnn", "gru_sequence_fused", "cpu", "g3_t8_h8_b8",
+          [8, 8], "0ld5pacehash")
+    path = c.save(str(tmp_path / "stale.json"))
+    diags = lint_autotune_cache(path)
+    assert len(diags) == 1 and diags[0].code == "L008"
+    assert "STALE" in diags[0].message
+    # unknown space -> flagged; schema mismatch -> whole-file finding
+    c2 = tune.AutotuneCache()
+    c2.put("warp_drive", "k", "cpu", "f", [1], "x")
+    diags = lint_autotune_cache(c2.save(str(tmp_path / "unk.json")))
+    assert len(diags) == 1 and "unknown plan space" in diags[0].message
+    (tmp_path / "old.json").write_text(
+        json.dumps({"schema_version": 0, "entries": {}}))
+    diags = lint_autotune_cache(str(tmp_path / "old.json"))
+    assert len(diags) == 1 and "schema_version" in diags[0].message
+
+
+def test_tune_check_cli_smoke(tmp_path, capsys):
+    """`paddle_tpu tune --check`: the CI smoke — a seconds-long smoke
+    sweep on the interpret backend, persisted, reloaded, and consulted
+    through the real entry points. Also covers `lint --autotune-cache`
+    standalone over the file it wrote."""
+    from paddle_tpu.cli import main
+    path = str(tmp_path / "autotune.json")
+    rc = main(["tune", "--check", "--cache", path])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "--check OK" in out
+    cache = tune.load_cache(path)
+    assert len(cache.entries) >= 3          # >= 2 plan spaces end-to-end
+    spaces = {e["space"] for e in cache.entries.values()}
+    assert {"fused_rnn", "decode_route", "page_block"} <= spaces
+    for e in cache.entries.values():
+        assert e["methodology"] == "measured"
+        assert e["space_hash"] == tune.space_hash(e["space"])
+    rc = main(["lint", "--autotune-cache", path, "--fail-on", "warning"])
+    assert rc == 0
+    # markdown table (the kernels.md regeneration surface) renders
+    rc = main(["tune", "--profile", "smoke", "--dry-run", "--markdown",
+               "--spaces", "page_block"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "| space | kernel |" in out
+
+
+def test_plan_source_stamp(tune_cache):
+    assert tune.plan_source() == "heuristic"      # empty cache
+    tune_cache.put("decode_route", "decode_attention", "cpu", "default",
+                   {"kernel_min_len": None},
+                   tune.space_hash("decode_route"))
+    assert tune.plan_source() == "tuned"
+    # stale entries do not count as tuned
+    stale = tune.AutotuneCache()
+    stale.put("decode_route", "decode_attention", "cpu", "default",
+              {"kernel_min_len": None}, "0ld")
+    tune.set_cache(stale)
+    assert tune.plan_source() == "heuristic"

@@ -91,7 +91,8 @@ def test_lint_bench_rows_schema(tmp_path):
     good.write_text(
         json.dumps({"metric": "x_train_ms_per_batch", "value": 1.0,
                     "unit": "ms", "vs_baseline": None, "mfu": 0.2,
-                    "methodology": "measured"}) + "\n"
+                    "methodology": "measured",
+                    "plan_source": "heuristic"}) + "\n"
         + json.dumps({"metric": "z_serve_daemon_tokens_per_sec",
                       "value": 9.0, "unit": "tok/s", "vs_baseline": None,
                       "ttft_p50_ms": 12.0, "tpot_p50_ms": 3.0,
@@ -105,7 +106,8 @@ def test_lint_bench_rows_schema(tmp_path):
                       "vs_baseline": None}) + "\n"
         + json.dumps({"metric": "w_train_ms_per_batch", "value": 1.0,
                       "unit": "ms", "vs_baseline": None, "mfu": 0.2,
-                      "methodology": "guessed"}) + "\n")
+                      "methodology": "guessed",
+                      "plan_source": "vibes"}) + "\n")
     out = _run("lint", "--bench-rows", str(good))
     assert "0 problem(s)" in out
     r = subprocess.run([sys.executable, "-m", "paddle_tpu", "lint",
@@ -119,6 +121,9 @@ def test_lint_bench_rows_schema(tmp_path):
     # methodology is required on roofline/SLO rows and must be one of
     # measured|modeled — on-chip vs projected stays distinguishable
     assert "methodology" in r.stdout and "guessed" in r.stdout
+    # plan_source is required on _train_/_decode_ rows (tuned-vs-heuristic
+    # deltas stay machine-checkable) and must be tuned|heuristic
+    assert "plan_source" in r.stdout and "vibes" in r.stdout
 
 
 def test_cli_train_test_time_dump(config_file, tmp_path):

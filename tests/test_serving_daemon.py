@@ -218,30 +218,27 @@ def test_abandoned_stream_cancels_server_side(daemon):
 
 def test_stream_surfaces_cancellation(daemon):
     """A server-side cancel must raise out of stream()/generate(), never
-    read as a short-but-normal completion."""
-    import threading
-
+    read as a short-but-normal completion. The cancel fires synchronously
+    at submit time (before the scheduler can touch the queued record) —
+    a polling killer thread used to lose the race on a compile-warm
+    session, where all ~12 segments finish inside one 50 ms poll."""
     from paddle_tpu.serving import ServingClient
     d, _ = daemon
     c = ServingClient(*d.address)
+    orig = d.engine.submit
 
-    def cancel_whatever_runs():
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline:
-            with d.engine._lock:
-                rids = [r.rid for r in d.engine._recs.values()
-                        if not r.done]
-            if rids:
-                for rid in rids:
-                    d.engine.cancel(rid)
-                return
-            time.sleep(0.05)
+    def submit_then_cancel(*a, **kw):
+        rid = orig(*a, **kw)
+        assert d.engine.cancel(rid) is True   # queued -> cancel always wins
+        return rid
 
-    killer = threading.Thread(target=cancel_whatever_runs, daemon=True)
-    killer.start()
-    with pytest.raises(RuntimeError, match="cancelled"):
-        list(c.stream(np.random.RandomState(3).randint(0, VOCAB, 5), 100))
-    killer.join(timeout=30)
+    d.engine.submit = submit_then_cancel
+    try:
+        with pytest.raises(RuntimeError, match="cancelled"):
+            list(c.stream(np.random.RandomState(3).randint(0, VOCAB, 5),
+                          100))
+    finally:
+        d.engine.submit = orig
     c.close()
 
 

@@ -18,7 +18,6 @@ import numpy as np
 import pytest
 
 from paddle_tpu import obs
-from paddle_tpu.models import TransformerLM
 from paddle_tpu.ops import pallas_kernels as pk
 from paddle_tpu.serving import (ContinuousBatcher, Overloaded, PagedBatcher,
                                 Request, ServingEngine)
@@ -27,11 +26,11 @@ VOCAB, D, H, L, MAX_LEN = 97, 32, 4, 2, 128
 
 
 @pytest.fixture(scope="module")
-def model_and_params():
-    model = TransformerLM(VOCAB, d_model=D, n_heads=H, n_layers=L,
-                          max_len=MAX_LEN)
-    params = model.init(jax.random.PRNGKey(0))
-    return model, params
+def model_and_params(paged_model_and_params):
+    """The session-shared model (conftest.py): pools built over the same
+    instance share traced admission/segment executables per shape family
+    instead of re-tracing per test (ROADMAP item 5)."""
+    return paged_model_and_params
 
 
 def _solo(model, params, prompt, steps, _bucket=12):

@@ -27,7 +27,6 @@ import numpy as np
 import pytest
 
 from paddle_tpu import obs
-from paddle_tpu.models import TransformerLM
 from paddle_tpu.serving import (PagedBatcher, PrefixIndex, Request,
                                 ServingEngine)
 
@@ -36,11 +35,12 @@ BS = 8                      # page_block — one trie level per 8 tokens
 
 
 @pytest.fixture(scope="module")
-def model_and_params():
-    model = TransformerLM(VOCAB, d_model=D, n_heads=H, n_layers=L,
-                          max_len=MAX_LEN)
-    params = model.init(jax.random.PRNGKey(0))
-    return model, params
+def model_and_params(paged_model_and_params):
+    """The session-shared model (conftest.py): dims are shared with
+    test_serving_paged.py, and the per-model-instance program cache in
+    serving/paged.py now shares TRACED executables across both files,
+    not just XLA compiles (ROADMAP item 5)."""
+    return paged_model_and_params
 
 
 def _solo(model, params, prompt, steps, kv_dtype=None, _bucket=12):
