@@ -42,8 +42,82 @@ def _make_trainer(cfg):
     return v2.SGD(cost, opt)
 
 
+def _parse_hostport(addr, default_host="127.0.0.1", default_port=0):
+    """host:port with the `obs serve --master` validation discipline:
+    bracket-stripped IPv6 literals, and None on anything malformed so the
+    caller answers with a clear exit-2 instead of a ValueError traceback.
+    Returns (host, port) or None."""
+    if not addr:
+        return default_host, default_port
+    host, _, port = addr.rpartition(":")
+    try:
+        return (host.strip("[]") or default_host), int(port)
+    except ValueError:
+        return None
+
+
+def _cmd_train_elastic(args):
+    """``train --elastic master|worker`` — the elastic data-parallel mode
+    (docs/design/elastic.md). The config script defines
+    ``elastic_workload()`` returning ``{"loss_fn", "params", "optimizer",
+    "batches"}`` (params/batches as host arrays; workers only need
+    loss_fn)."""
+    import runpy
+
+    from .trainer.elastic import ElasticMaster, ElasticWorker
+    cfg = runpy.run_path(args.config)
+    wl_fn = cfg.get("elastic_workload")
+    if not callable(wl_fn):
+        print(f"error: --elastic needs the config to define "
+              f"elastic_workload(); {args.config} does not", file=sys.stderr)
+        return 2
+    wl = wl_fn()
+    parsed = _parse_hostport(args.master_addr)
+    if parsed is None:
+        print(f"error: --master_addr must be host:port, got "
+              f"{args.master_addr!r}", file=sys.stderr)
+        return 2
+    host, port = parsed
+    if args.elastic == "worker":
+        if not args.master_addr or not port:
+            print("error: --elastic worker needs --master_addr HOST:PORT",
+                  file=sys.stderr)
+            return 2
+        worker = ElasticWorker(wl["loss_fn"], (host, port),
+                               worker=args.worker_id)
+        summary = worker.run()
+        print(f"elastic worker {summary['worker']} served "
+              f"{summary['shards']} shard(s); job done: {summary['done']}")
+        return 0 if summary["done"] else 2
+    em = ElasticMaster(wl["loss_fn"], wl["optimizer"], host=host, port=port,
+                       shards_per_step=args.shards_per_step,
+                       min_workers=args.min_workers, ttl=args.heartbeat_ttl,
+                       snapshot_dir=args.save_dir or None)
+    em.start()
+    completed = False
+    try:
+        print(f"ELASTIC MASTER {em.address[0]} {em.address[1]}", flush=True)
+        params, _, loss = em.fit(wl["batches"], wl.get("params"),
+                                 num_passes=args.num_passes)
+        completed = True
+        print(f"elastic training done: {args.num_passes} pass(es), "
+              f"final loss {loss:.6f}, membership epoch "
+              f"{em.membership.epoch}")
+        if args.save_dir:
+            print(f"state checkpoints under {args.save_dir}")
+    finally:
+        # drain only after a COMPLETED run: workers leave once they
+        # observe the done signal, which a failed fit never sets — the
+        # error path must surface the traceback now, not after 10s of
+        # waiting for departures that cannot happen
+        em.stop(drain_s=10.0 if completed else 0.0)
+    return 0
+
+
 def cmd_train(args):
     from .trainer import event
+    if getattr(args, "elastic", None):
+        return _cmd_train_elastic(args)
     if getattr(args, "compile_cache", None):
         # persistent XLA compile cache BEFORE the config builds/compiles
         # anything: a preemption-resume of this same command re-loads its
@@ -1086,10 +1160,8 @@ def cmd_obs_serve(args):
     if master:
         # validate ONCE at startup: a malformed flag must be a clear exit-2
         # here, not a ValueError 500ing every later scrape inside provider
-        host, _, port = master.rpartition(":")
-        try:
-            master_addr = (host.strip("[]") or "127.0.0.1", int(port))
-        except ValueError:
+        master_addr = _parse_hostport(master)
+        if master_addr is None:
             print(f"obs serve: --master must be host:port, got {master!r}",
                   file=sys.stderr)
             return 2
@@ -1280,6 +1352,33 @@ def main(argv=None) -> int:
                         "cache: a preemption-resume (or any re-run) loads "
                         "its compiled executables from here instead of "
                         "recompiling ($PADDLE_TPU_COMPILE_CACHE_DIR analog)")
+    t.add_argument("--elastic", choices=["master", "worker"], default=None,
+                   help="elastic data-parallel mode (docs/design/elastic.md): "
+                        "'master' serves membership + shard dispatch and "
+                        "applies the updates; 'worker' joins a master under "
+                        "a heartbeat lease and computes shard gradients. "
+                        "The config must define elastic_workload() -> "
+                        "{loss_fn, params, optimizer, batches}")
+    t.add_argument("--master_addr", default=None,
+                   help="--elastic worker: HOST:PORT of the elastic master "
+                        "to join; --elastic master: bind address "
+                        "(default 127.0.0.1:0 — the chosen port is printed "
+                        "as 'ELASTIC MASTER host port')")
+    t.add_argument("--min_workers", type=int, default=1,
+                   help="--elastic master: members required before the "
+                        "first step dispatches")
+    t.add_argument("--shards_per_step", type=int, default=4,
+                   help="--elastic master: fixed shard tasks per global "
+                        "batch (the elasticity quantum; membership-"
+                        "independent so the reduce stays byte-stable)")
+    t.add_argument("--heartbeat_ttl", type=float, default=5.0,
+                   help="--elastic master: seconds without a heartbeat "
+                        "before a worker is evicted and its in-flight "
+                        "shards re-bucket")
+    t.add_argument("--worker_id", default=None,
+                   help="--elastic worker: stable membership name (a "
+                        "re-join under the same name fences the old "
+                        "incarnation)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test")

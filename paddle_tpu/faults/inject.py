@@ -17,6 +17,7 @@ site                      where it fires
 ``lease.renew``           per lease renewal (runtime/lease.py, runtime/coord.py)
 ``reader.next``           per chunk-task stream opened (data/chunks.py)
 ``step.grad``             per train-step loss produced (trainer/trainer.py)
+``mbr.heartbeat``         per membership heartbeat sent (runtime/membership.py)
 ========================  =====================================================
 
 ``step.grad`` caveat: the hook filters the HOST-observed loss value after
@@ -59,7 +60,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from .. import obs
 
 SITES = ("ckpt.write", "rpc.send", "rpc.recv", "lease.renew",
-         "reader.next", "step.grad")
+         "reader.next", "step.grad", "mbr.heartbeat")
 
 #: process-global active plan; None = harness disabled (the fast path)
 _PLAN: Optional["FaultPlan"] = None

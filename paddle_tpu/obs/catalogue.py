@@ -185,6 +185,42 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
                                                "labels: type", ("type",)),
     "master.obs_workers": ("gauge", "distinct workers whose metric "
                                     "snapshots the master currently holds"),
+    # -- cluster: runtime/membership.py, trainer/elastic.py -------------
+    "cluster.members": ("gauge", "workers currently registered under a "
+                                 "live heartbeat lease (the elastic "
+                                 "fleet size)"),
+    "cluster.epoch": ("gauge", "membership view epoch — bumps on every "
+                               "join / graceful leave / eviction; elastic "
+                               "submissions stamped with an older epoch "
+                               "are fence-refused"),
+    "cluster.joins_total": ("counter", "mbr_join registrations accepted "
+                                       "(incl. re-joins after eviction or "
+                                       "a master restart)"),
+    "cluster.leaves_total": ("counter", "members removed from the view, "
+                                        "labels: reason (graceful = "
+                                        "mbr_leave; evicted = missed "
+                                        "heartbeat window; replaced = a "
+                                        "newer same-name incarnation "
+                                        "joined over a live one)",
+                             ("reason",)),
+    "cluster.heartbeats_total": ("counter", "membership heartbeats "
+                                            "accepted (lease extended)"),
+    "cluster.stale_rpcs_total": ("counter", "membership/elastic RPCs "
+                                            "fence-refused with a "
+                                            "structured code, labels: "
+                                            "code (stale_epoch | "
+                                            "stale_member | "
+                                            "unknown_member | "
+                                            "stale_step)", ("code",)),
+    "cluster.resyncs_total": ("counter", "elastic-worker state refetches "
+                                         "(+ re-placement onto the local "
+                                         "mesh/layout) at an epoch or "
+                                         "step barrier"),
+    "cluster.rebucket_tasks_total": ("counter", "in-flight shard tasks "
+                                                "requeued off a departed "
+                                                "member at an epoch bump "
+                                                "(ahead of the timeout "
+                                                "re-dispatch)"),
     # -- coord: runtime/coord.py (CoordServer._dispatch) ----------------
     "coord.requests_total": ("counter", "coord RPCs dispatched, "
                                         "labels: type", ("type",)),

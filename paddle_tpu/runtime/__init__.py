@@ -18,11 +18,16 @@ from .arena import HostArena
 from .optimizer import HostOptimizer
 from .lease import FileLease, LeaseKeeper
 from .coord import CoordServer, NetworkFencedStore, NetworkLease
+from .master_service import StaleMemberError
+from .membership import (HeartbeatKeeper, MembershipClient,
+                         MembershipService, autoscale_recommendation)
 from .host_embedding import (HostEmbedBatch, HostEmbeddingTable,
                              HostEmbedPrefetcher)
 
 __all__ = ["load_library", "native_available", "TaskMaster",
            "FileLease", "LeaseKeeper",
            "CoordServer", "NetworkLease", "NetworkFencedStore",
+           "MembershipService", "MembershipClient", "HeartbeatKeeper",
+           "StaleMemberError", "autoscale_recommendation",
            "HostEmbeddingTable", "HostEmbedBatch", "HostEmbedPrefetcher",
            "RecordReader", "RecordWriter", "HostArena", "HostOptimizer"]

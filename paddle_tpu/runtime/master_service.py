@@ -36,6 +36,35 @@ _HDR = struct.Struct("<I")
 # 4-byte header must not make the daemon attempt a multi-GiB allocation
 _MAX_FRAME = 64 << 20
 
+#: structured error codes of the membership/epoch fencing contract
+#: (runtime/membership.py + trainer/elastic.py). Defined ONCE here — the
+#: client's fail-fast behavior keys on these exact strings, so emitters
+#: import the constants instead of respelling them.
+CODE_UNKNOWN_MEMBER = "unknown_member"
+CODE_STALE_MEMBER = "stale_member"
+CODE_STALE_EPOCH = "stale_epoch"
+CODE_STALE_STEP = "stale_step"
+#: AUTHORITATIVE refusals — the server is healthy and said no — so the
+#: client fails fast with a typed error instead of burning its reconnect
+#: budget the way it does (correctly) against a connection-refused master
+#: that is restarting from snapshot.
+FENCE_CODES = frozenset({CODE_UNKNOWN_MEMBER, CODE_STALE_MEMBER,
+                         CODE_STALE_EPOCH, CODE_STALE_STEP})
+
+
+class StaleMemberError(RuntimeError):
+    """A structured membership/epoch fencing refusal (``code`` in
+    :data:`FENCE_CODES`). Deliberately NOT a ConnectionError: the shared
+    RetryPolicy's retryable set never re-sends a fenced request, and the
+    caller gets the refusal on the FIRST attempt with the server's current
+    epoch attached — resync-and-retry is the caller's decision."""
+
+    def __init__(self, msg: str, *, code: str, epoch=None, attempts: int = 1):
+        super().__init__(msg)
+        self.code = code
+        self.epoch = epoch
+        self.attempts = attempts
+
 
 def _send_msg(sock: socket.socket, obj, *, chaos: bool = False) -> None:
     payload = json.dumps(obj).encode()
@@ -507,6 +536,11 @@ class _RpcClient:
             self.policy.observer = obs.retry_observer("rpc")
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        #: last membership epoch seen in ANY reply (None until one carries
+        #: it) — stamped into the final reconnect error so an operator
+        #: reading "unreachable after N attempts" also sees how current
+        #: this client's view was when the master went away
+        self.last_epoch = None
 
     @property
     def addr(self) -> Tuple[str, int]:
@@ -549,12 +583,26 @@ class _RpcClient:
         if resp is None:
             self._drop_sock()
             raise ConnectionError("server closed connection")
-        if not resp.get("ok") and \
-                str(resp.get("error", "")).startswith("fenced"):
-            # deposed server: rotate to the standby and retry
-            self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
-            self._drop_sock()
-            raise ConnectionError(resp["error"])
+        if isinstance(resp, dict) and resp.get("epoch") is not None and \
+                str(req.get("op", "")).startswith(("mbr_", "ela_")):
+            # only membership-plane replies stamp the epoch: the built-in
+            # "stats" op also answers an "epoch" field, but that one is
+            # the TaskMaster's pass/dataset generation — reporting it as
+            # a membership epoch would mislead whoever correlates the
+            # final reconnect error against cluster.epoch
+            self.last_epoch = resp["epoch"]
+        if not resp.get("ok"):
+            if resp.get("code") in FENCE_CODES:
+                # authoritative membership/epoch refusal: fail FAST (no
+                # reconnect budget spent — retrying a fence cannot help)
+                raise StaleMemberError(
+                    f"{self._rpc_name} fenced: {resp.get('error')}",
+                    code=resp["code"], epoch=resp.get("epoch"))
+            if str(resp.get("error", "")).startswith("fenced"):
+                # deposed server: rotate to the standby and retry
+                self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
+                self._drop_sock()
+                raise ConnectionError(resp["error"])
         return resp
 
     def _call(self, req):
@@ -578,10 +626,16 @@ class _RpcClient:
                     self._call_once, req,
                     describe=f"{self._rpc_name} {req.get('op')!r}")
             except RetryBudgetExceeded as e:
+                # connection-refused/timeout class: the reconnect budget
+                # WAS the right response (a restarting master comes back
+                # inside the snapshot/restore window) — report how hard we
+                # tried and how current our membership view was
+                seen = ("unknown" if self.last_epoch is None
+                        else str(self.last_epoch))
                 raise ConnectionError(
                     f"{self._rpc_name} server unreachable after "
-                    f"{e.attempts} attempt(s): {e.last_error}") \
-                    from e.last_error
+                    f"{e.attempts} attempt(s) (last seen membership epoch "
+                    f"{seen}): {e.last_error}") from e.last_error
 
     def close(self):
         with self._lock:

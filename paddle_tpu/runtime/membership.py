@@ -1,0 +1,548 @@
+"""Cluster membership — heartbeat-leased worker registration on the master.
+
+The reference registers trainers/pservers in etcd (go/pserver/etcd_client.go
+slot registration under a TTL lease; doc/design/cluster_train/README.md
+"trainers are stateless consumers") but its master still assumed a FIXED
+worker set. This module makes membership first-class, reusing the repo's
+own lease semantics (:mod:`paddle_tpu.runtime.lease` — TTL + monotonic
+fencing tokens) over the master's RPC plane:
+
+* workers ``mbr_join`` under a heartbeat lease and receive a **member
+  fencing token** (monotonic per service — the etcd-revision discipline of
+  :class:`~paddle_tpu.runtime.lease.FileLease`). A re-join under the same
+  worker name mints a NEW token; the old incarnation's heartbeats and
+  submissions are refused with structured ``stale_member`` errors — a
+  partitioned-but-alive zombie can never act for its replacement.
+* the master maintains an **epoch-numbered membership view**: every change
+  (join, graceful ``mbr_leave``, missed-heartbeat eviction) bumps the
+  epoch and notifies ``on_change`` subscribers (the elastic trainer
+  re-buckets its task queue there, :mod:`paddle_tpu.trainer.elastic`).
+* requests that mutate shared training state carry their sender's epoch;
+  :func:`MembershipService.fence` answers an outdated one with a
+  structured ``stale_epoch`` error instead of applying a stale worker's
+  work — the split-brain guard the Ascend field study (PAPERS.md) shows
+  accelerator clusters dying without.
+
+Ops ride :meth:`MasterServer.register_op` (the native unknown-op fallback
+path, like ``srv_submit``): ``mbr_join`` / ``mbr_heartbeat`` /
+``mbr_leave`` / ``mbr_view``. ``mbr_view`` additionally carries the
+**autoscale hook**: :func:`autoscale_recommendation` folds the master's
+task-queue depth and the aggregated ``goodput.ratio`` / starvation
+telemetry (PR 9's gauges, via the in-process ClusterAggregator) into a
+``join`` / ``leave`` / ``hold`` recommendation an external scaler can act
+on without understanding the internals.
+
+Worker side: :class:`MembershipClient` (a :class:`MasterClient` with the
+mbr ops) and :class:`HeartbeatKeeper` (the LeaseKeeper analog). The
+keeper distinguishes failure classes the way the hardened
+``MasterClient._call`` reports them: connection-refused (master
+restarting) is retried against the snapshot/restore window, and a
+structured ``unknown_member``/``stale_member`` answer (we were evicted,
+or the master restarted and lost the ephemeral member table) triggers an
+automatic **re-join** — a rolling master restart costs one epoch bump,
+not the fleet. The chaos site ``mbr.heartbeat`` (faults plane) injects
+heartbeat failures to drive the eviction path deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import faults, obs
+from ..utils.logging import get_logger
+from ..utils.retry import RetryPolicy
+from .master_service import (CODE_STALE_EPOCH, CODE_STALE_MEMBER,
+                             CODE_UNKNOWN_MEMBER, MasterClient,
+                             StaleMemberError)
+
+log = get_logger(__name__)
+
+
+def _err(code: str, msg: str, **extra) -> Dict[str, Any]:
+    d = {"ok": False, "code": code, "error": msg}
+    d.update(extra)
+    return d
+
+
+class _Member:
+    __slots__ = ("worker", "token", "deadline", "caps", "joined_at")
+
+    def __init__(self, worker: str, token: int, deadline: float, caps,
+                 joined_at: float):
+        self.worker = worker
+        self.token = token
+        self.deadline = deadline
+        self.caps = caps or {}
+        self.joined_at = joined_at
+
+    def describe(self) -> Dict[str, Any]:
+        return {"worker": self.worker, "token": self.token,
+                "caps": dict(self.caps)}
+
+
+class MembershipService:
+    """Epoch-numbered, heartbeat-leased membership table on the master.
+
+    Args:
+      ttl: seconds a member survives without a heartbeat before eviction
+        (the lease TTL; workers heartbeat at ``ttl / 3``).
+      clock: injectable monotonic clock — chaos tests time-travel
+        evictions instead of sleeping.
+      epoch0: starting epoch; a restarted master seeds it from its
+        snapshot so epoch fencing stays monotonic ACROSS restarts (the
+        FileLease ``.epoch`` sidecar discipline).
+      tick_interval: expiry-check cadence of :meth:`start`'s thread.
+    """
+
+    def __init__(self, *, ttl: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 epoch0: int = 0, tick_interval: Optional[float] = None):
+        self.ttl = ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._members: Dict[str, _Member] = {}
+        self.epoch = epoch0
+        self._next_token = 0
+        self._server = None
+        self._on_change: List[Callable] = []
+        self._tick_interval = (tick_interval if tick_interval is not None
+                               else max(ttl / 4.0, 0.05))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring -------------------------------------------------------------
+    def attach(self, server) -> "MembershipService":
+        """Register the mbr_* ops on a MasterServer (before ``start()`` so
+        no request can observe a half-wired op table)."""
+        self._server = server
+        server.register_op("mbr_join", self._op_join)
+        server.register_op("mbr_heartbeat", self._op_heartbeat)
+        server.register_op("mbr_leave", self._op_leave)
+        server.register_op("mbr_view", self._op_view)
+        return self
+
+    def subscribe(self, fn: Callable[..., None]) -> None:
+        """``fn(view, joined=[...], left=[...], reason=str)`` after every
+        epoch bump. Called OUTSIDE the membership lock (subscribers
+        re-bucket task queues and may call back into stats)."""
+        self._on_change.append(fn)
+
+    def start(self) -> "MembershipService":
+        """Run the eviction housekeeping thread (real deployments; tests
+        with a fake clock call :meth:`expire` directly)."""
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="membership-expiry")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._tick_interval):
+            self.expire()
+
+    # -- the table ----------------------------------------------------------
+    def members(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [m.describe() for m in self._members.values()]
+
+    def view(self) -> Dict[str, Any]:
+        """The epoch-stamped membership view (stable contract: epoch,
+        members sorted by worker name)."""
+        with self._lock:
+            return {"epoch": self.epoch,
+                    "members": sorted((m.describe()
+                                       for m in self._members.values()),
+                                      key=lambda d: d["worker"])}
+
+    def join(self, worker: str, caps=None) -> Tuple[int, int]:
+        """Register (or re-register) ``worker``; returns (token, epoch).
+        A join over a live same-name member REPLACES it — the newer
+        incarnation wins, the older one's token goes stale."""
+        now = self._clock()
+        with self._lock:
+            replaced = worker in self._members
+            self._next_token += 1
+            token = self._next_token
+            self._members[worker] = _Member(worker, token, now + self.ttl,
+                                            caps, now)
+            self._bump_locked()
+            epoch = self.epoch
+        obs.count("cluster.joins_total")
+        if replaced:
+            obs.count("cluster.leaves_total", reason="replaced")
+        log.info("member %s joined (token %d) -> epoch %d%s", worker, token,
+                 epoch, " [replaced live incarnation]" if replaced else "")
+        self._notify(joined=[worker], left=[worker] if replaced else [],
+                     reason="join")
+        return token, epoch
+
+    def heartbeat(self, worker: str, token: int) -> Optional[Dict[str, Any]]:
+        """Extend the member's lease. Returns a structured-error dict on a
+        fencing refusal, None when the heartbeat was accepted."""
+        with self._lock:
+            m = self._members.get(worker)
+            if m is None:
+                return _err(CODE_UNKNOWN_MEMBER,
+                            f"worker {worker!r} is not a member "
+                            "(evicted, or the master restarted) — re-join",
+                            epoch=self.epoch)
+            if token != m.token:
+                return _err(CODE_STALE_MEMBER,
+                            f"worker {worker!r} token {token} superseded by "
+                            f"{m.token} (a newer incarnation joined)",
+                            epoch=self.epoch)
+            m.deadline = self._clock() + self.ttl
+        obs.count("cluster.heartbeats_total")
+        return None
+
+    def leave(self, worker: str, token: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            m = self._members.get(worker)
+            if m is None:
+                return None                   # idempotent: already gone
+            if token != m.token:
+                return _err(CODE_STALE_MEMBER,
+                            f"worker {worker!r} token {token} superseded by "
+                            f"{m.token}", epoch=self.epoch)
+            del self._members[worker]
+            self._bump_locked()
+        obs.count("cluster.leaves_total", reason="graceful")
+        log.info("member %s left gracefully -> epoch %d", worker, self.epoch)
+        self._notify(joined=[], left=[worker], reason="leave")
+        return None
+
+    def expire(self, now: Optional[float] = None) -> List[str]:
+        """Evict members whose heartbeat lease lapsed; returns the evicted
+        worker names (one epoch bump covers the whole batch)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            dead = [w for w, m in self._members.items() if m.deadline <= now]
+            for w in dead:
+                del self._members[w]
+            if dead:
+                self._bump_locked()
+        for w in dead:
+            obs.count("cluster.leaves_total", reason="evicted")
+            log.warning("member %s missed its heartbeat window (ttl %.1fs): "
+                        "evicted -> epoch %d", w, self.ttl, self.epoch)
+        if dead:
+            self._notify(joined=[], left=dead, reason="evicted")
+        return dead
+
+    def validate(self, worker: str, token) -> Optional[Dict[str, Any]]:
+        """Member fencing for state-mutating ops (the elastic trainer's
+        grad/task RPCs): structured error dict, or None when current."""
+        with self._lock:
+            m = self._members.get(worker)
+            if m is None:
+                return _err(CODE_UNKNOWN_MEMBER,
+                            f"worker {worker!r} is not a member — re-join",
+                            epoch=self.epoch)
+            if token != m.token:
+                return _err(CODE_STALE_MEMBER,
+                            f"worker {worker!r} token {token} superseded by "
+                            f"{m.token}", epoch=self.epoch)
+        return None
+
+    def fence(self, req_epoch) -> Optional[Dict[str, Any]]:
+        """Epoch fencing: a submission stamped with an older view is
+        answered with ``stale_epoch`` (and the current epoch, so the
+        caller can resync at its next step boundary) instead of applied."""
+        with self._lock:
+            cur = self.epoch
+        if req_epoch is None or int(req_epoch) == cur:
+            return None
+        obs.count("cluster.stale_rpcs_total", code=CODE_STALE_EPOCH)
+        return _err(CODE_STALE_EPOCH,
+                    f"request epoch {req_epoch} != current {cur} "
+                    "(membership changed; resync and retry)", epoch=cur)
+
+    def _bump_locked(self) -> None:
+        self.epoch += 1
+        obs.gauge_set("cluster.epoch", float(self.epoch))
+        obs.gauge_set("cluster.members", float(len(self._members)))
+
+    def _notify(self, **kw) -> None:
+        view = self.view()
+        for fn in list(self._on_change):
+            try:
+                fn(view, **kw)
+            except Exception:
+                log.exception("membership on_change subscriber failed")
+
+    # -- op handlers (native fallback threads) ------------------------------
+    def _fenced_master(self) -> Optional[Dict[str, Any]]:
+        # a deposed master must not mutate membership any more than its
+        # task queue: same "fenced:" wording, so clients rotate endpoints
+        srv = self._server
+        if srv is not None and srv._fenced_out():
+            return {"ok": False,
+                    "error": f"fenced: stale master token {srv.fence_token}"}
+        return None
+
+    def _op_join(self, req):
+        fenced = self._fenced_master()
+        if fenced is not None:
+            return fenced
+        worker = str(req.get("worker", ""))
+        if not worker:
+            return {"ok": False, "error": "mbr_join needs a worker name"}
+        token, epoch = self.join(worker, req.get("caps"))
+        return {"ok": True, "member_token": token, "epoch": epoch,
+                "ttl": self.ttl, "view": self.view()}
+
+    def _op_heartbeat(self, req):
+        fenced = self._fenced_master()
+        if fenced is not None:
+            return fenced
+        err = self.heartbeat(str(req.get("worker", "")),
+                             req.get("member_token"))
+        if err is not None:
+            for code in (CODE_UNKNOWN_MEMBER, CODE_STALE_MEMBER):
+                if err.get("code") == code:
+                    obs.count("cluster.stale_rpcs_total", code=code)
+            return err
+        with self._lock:
+            return {"ok": True, "epoch": self.epoch}
+
+    def _op_leave(self, req):
+        fenced = self._fenced_master()
+        if fenced is not None:
+            return fenced
+        err = self.leave(str(req.get("worker", "")), req.get("member_token"))
+        if err is not None:
+            return err
+        with self._lock:
+            return {"ok": True, "epoch": self.epoch}
+
+    def _op_view(self, req):
+        view = self.view()
+        rec = None
+        srv = self._server
+        if srv is not None:
+            try:
+                todo, pending, _, _, _ = srv.master.stats()
+                samples = srv.aggregator.merged_samples()
+                rec = autoscale_recommendation(
+                    members=len(view["members"]), todo=todo,
+                    pending=pending, samples=samples)
+            except Exception as e:   # telemetry must not break the view
+                rec = {"action": "hold",
+                       "reason": f"recommendation unavailable: {e}"}
+        view["ok"] = True
+        view["ttl"] = self.ttl
+        view["recommendation"] = rec
+        return view
+
+
+# -- autoscale hook -------------------------------------------------------------
+
+def autoscale_recommendation(*, members: int, todo: int, pending: int,
+                             samples=(), scale_up_backlog: float = 2.0,
+                             scale_down_goodput: float = 0.25
+                             ) -> Dict[str, Any]:
+    """Fold queue depth + fleet telemetry into a join/leave recommendation.
+
+    Inputs are the master's own task-queue stats and the aggregated
+    cluster samples (``ClusterAggregator.merged_samples()`` — every series
+    carries a ``worker=<id>`` label). Heuristics, in priority order:
+
+    * no live members → ``join`` (nothing can drain the queue);
+    * backlog per worker above ``scale_up_backlog`` → ``join`` (the queue
+      is outrunning the fleet);
+    * empty queue AND (mean ``goodput.ratio`` below ``scale_down_goodput``
+      OR reader starvation observed — ``data.starved_total`` /
+      ``data.giveups_total``) with >1 member → ``leave`` (the fleet idles
+      waiting for work);
+    * otherwise ``hold``.
+
+    Pure function of its inputs — unit-testable, and callers (the
+    ``mbr_view`` op, external scalers) share one policy.
+    """
+    ratios: List[float] = []
+    starved = 0.0
+    for s in samples or ():
+        try:
+            name, value = s.get("name"), s.get("value")
+        except AttributeError:
+            continue
+        if value is None:
+            continue
+        if name == "goodput.ratio":
+            ratios.append(float(value))
+        elif name in ("data.starved_total", "data.giveups_total"):
+            starved += float(value)
+    goodput = sum(ratios) / len(ratios) if ratios else None
+    backlog = todo + pending
+    out = {"members": members, "backlog": backlog,
+           "backlog_per_worker": (backlog / members) if members else None,
+           "goodput_ratio": goodput, "starved": starved}
+    if members == 0:
+        out.update(action="join",
+                   reason=f"no live workers for {backlog} queued task(s)")
+    elif backlog / members > scale_up_backlog:
+        out.update(action="join",
+                   reason=f"backlog {backlog} over {members} worker(s) "
+                          f"exceeds {scale_up_backlog}/worker")
+    elif backlog == 0 and members > 1 and (
+            starved > 0 or (goodput is not None
+                            and goodput < scale_down_goodput)):
+        why = (f"reader starvation observed ({starved:.0f})" if starved > 0
+               else f"mean goodput.ratio {goodput:.2f} < "
+                    f"{scale_down_goodput}")
+        out.update(action="leave", reason=f"queue empty and {why}")
+    else:
+        out.update(action="hold", reason="queue and fleet in balance")
+    return out
+
+
+# -- worker side ----------------------------------------------------------------
+
+class MembershipClient(MasterClient):
+    """MasterClient + the membership ops. Structured fencing refusals
+    surface as :class:`StaleMemberError` (fail fast — the hardened
+    ``_call`` contract); transport failures keep the reconnect/backoff
+    behavior."""
+
+    _rpc_name = "membership rpc"
+
+    def join(self, worker: str, caps=None) -> Tuple[int, int, dict]:
+        """-> (member_token, epoch, reply) — reply carries ``view`` (the
+        epoch-stamped member list) and ``ttl`` (the heartbeat lease; beat
+        at ttl/3, evicted after ttl)."""
+        r = self._call({"op": "mbr_join", "worker": worker,
+                        "caps": caps or {}})
+        if not r.get("ok"):
+            raise RuntimeError(f"mbr_join failed: {r.get('error')}")
+        return int(r["member_token"]), int(r["epoch"]), r
+
+    def heartbeat(self, worker: str, member_token: int) -> int:
+        """-> current epoch. Raises StaleMemberError on a fencing refusal
+        (evicted / superseded / master forgot us) and fires the
+        ``mbr.heartbeat`` chaos site (faults plane) on the send edge."""
+        faults.fire("mbr.heartbeat")
+        r = self._call({"op": "mbr_heartbeat", "worker": worker,
+                        "member_token": member_token})
+        if not r.get("ok"):
+            raise RuntimeError(f"mbr_heartbeat failed: {r.get('error')}")
+        return int(r["epoch"])
+
+    def leave(self, worker: str, member_token: int) -> None:
+        r = self._call({"op": "mbr_leave", "worker": worker,
+                        "member_token": member_token})
+        if not r.get("ok"):
+            raise RuntimeError(f"mbr_leave failed: {r.get('error')}")
+
+    def cluster_view(self) -> dict:
+        return self._call({"op": "mbr_view"})
+
+
+class HeartbeatKeeper:
+    """Background heartbeat thread for one worker membership.
+
+    The failure ladder, matching the hardened client contract:
+
+    * transport errors (master restarting, connection refused) — already
+      retried with backoff inside ``_call``; the keeper additionally
+      tolerates them for up to ``grace`` seconds measured from the last
+      accepted heartbeat (our server-side lease may still be live), then
+      declares the membership LOST;
+    * ``unknown_member`` / ``stale_member`` — we were evicted or the
+      master restarted with an empty table: **re-join** under a
+      RetryPolicy; success reports the new (token, epoch) through
+      ``on_rejoin`` so the owner can resync; exhaustion → ``on_lost``;
+    * an epoch moving in a heartbeat reply fires ``on_epoch`` — the cheap
+      membership-changed signal the elastic worker barriers on.
+    """
+
+    def __init__(self, client: MembershipClient, worker: str, token: int,
+                 *, ttl: float, epoch: int = 0,
+                 on_epoch: Optional[Callable[[int], None]] = None,
+                 on_rejoin: Optional[Callable[[int, int], None]] = None,
+                 on_lost: Optional[Callable[[], None]] = None,
+                 rejoin_policy: Optional[RetryPolicy] = None,
+                 caps=None):
+        self.client = client
+        self.worker = worker
+        self.token = token
+        self.ttl = ttl
+        self.epoch = epoch
+        self.caps = caps or {}
+        self.on_epoch = on_epoch
+        self.on_rejoin = on_rejoin
+        self.on_lost = on_lost
+        self.grace = ttl * 3.0
+        self._rejoin = rejoin_policy or RetryPolicy(
+            max_attempts=6, base_delay=0.1, multiplier=2.0, max_delay=1.0,
+            jitter=0.25)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatKeeper":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"heartbeat-{self.worker}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        last_ok = time.monotonic()
+        while not self._stop.wait(self.ttl / 3.0):
+            try:
+                epoch = self.client.heartbeat(self.worker, self.token)
+            except StaleMemberError:
+                if not self._try_rejoin():
+                    self._lost()
+                    return
+                last_ok = time.monotonic()
+                continue
+            except Exception:
+                # transport outage or injected chaos: our lease may still
+                # be running server-side; only give up past the grace
+                if time.monotonic() - last_ok >= self.grace:
+                    self._lost()
+                    return
+                continue
+            last_ok = time.monotonic()
+            if epoch != self.epoch:
+                self.epoch = epoch
+                if self.on_epoch is not None:
+                    self.on_epoch(epoch)
+
+    def _try_rejoin(self) -> bool:
+        def attempt():
+            return self.client.join(self.worker, self.caps)
+        try:
+            token, epoch, _ = self._rejoin.call(
+                attempt, describe=f"re-join {self.worker!r}")
+        except Exception as e:  # noqa: BLE001 - any failure = not rejoined
+            log.warning("worker %s could not re-register: %s", self.worker, e)
+            return False
+        self.token, old = token, self.epoch
+        self.epoch = epoch
+        log.info("worker %s re-registered (token %d, epoch %d)",
+                 self.worker, token, epoch)
+        if self.on_rejoin is not None:
+            self.on_rejoin(token, epoch)
+        if epoch != old and self.on_epoch is not None:
+            self.on_epoch(epoch)
+        return True
+
+    def _lost(self) -> None:
+        log.error("worker %s lost its membership (heartbeats failing "
+                  "past the %.1fs grace)", self.worker, self.grace)
+        if self.on_lost is not None:
+            self.on_lost()

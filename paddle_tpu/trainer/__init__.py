@@ -14,9 +14,10 @@ from .evaluator import (AucEvaluator, ChunkEvaluator,
                         MaxIdPrinterEvaluator, PnpairEvaluator,
                         PrecisionRecallEvaluator, SumEvaluator,
                         ValuePrinterEvaluator)
+from .elastic import ElasticMaster, ElasticWorker
 from .trainer import Trainer
 
-__all__ = ["Trainer", "event",
+__all__ = ["Trainer", "event", "ElasticMaster", "ElasticWorker",
            "Evaluator", "EvaluatorGroup", "ClassificationErrorEvaluator",
            "SumEvaluator", "AucEvaluator", "PrecisionRecallEvaluator",
            "ChunkEvaluator", "CTCErrorEvaluator", "DetectionMAPEvaluator",

@@ -77,6 +77,87 @@ def paged_model_and_params():
     return model, params
 
 
+_MP_CPU_PROBE = None
+
+_MP_PROBE_SRC = r"""
+import os, sys
+port, pid = sys.argv[1], int(sys.argv[2])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=pid)
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()), ("d",))
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("d")), np.ones((1,), np.float32))
+assert float(jax.jit(lambda a: a.sum())(x)) == 2.0
+print("MP_OK")
+"""
+
+
+def multiprocess_cpu_support():
+    """(supported, reason): can this jaxlib run a COMPILED computation
+    across two CPU processes? ``jax.distributed.initialize`` succeeding is
+    NOT enough — some jaxlib builds join the job fine and then fail every
+    cross-process computation with 'Multiprocess computations aren't
+    implemented on the CPU backend'. The probe runs the real thing (a
+    2-process 1-float reduction over a global mesh) once per session, so
+    the multiprocess-on-CPU tests skip with the actual backend error as
+    the reason instead of failing red on a capability the environment
+    never had."""
+    global _MP_CPU_PROBE
+    if _MP_CPU_PROBE is not None:
+        return _MP_CPU_PROBE
+    import socket
+    import subprocess
+    import sys
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _MP_PROBE_SRC, str(port), str(i)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    outs, ok = [], True
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=90)
+            outs.append(out.decode(errors="replace"))
+            ok = ok and p.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+        outs.append("probe timed out after 90s")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if ok:
+        _MP_CPU_PROBE = (True, "")
+    else:
+        tail = [ln for o in outs for ln in o.strip().splitlines()
+                if ln.strip()]
+        reason = tail[-1] if tail else "probe subprocess failed"
+        _MP_CPU_PROBE = (False, reason[:300])
+    return _MP_CPU_PROBE
+
+
+def require_multiprocess_cpu():
+    """Capability gate for tests that need REAL cross-process collectives
+    on the CPU backend (tests/test_multiprocess_dp.py + the launcher's
+    training e2es). A skip here always names the backend's own error, so
+    a red tier-1 run means a genuine regression, never a missing
+    environment capability."""
+    ok, reason = multiprocess_cpu_support()
+    if not ok:
+        pytest.skip("multiprocess-on-CPU collectives unavailable in this "
+                    f"environment: {reason}")
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deterministic fault-injection tests "

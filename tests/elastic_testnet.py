@@ -1,0 +1,47 @@
+"""Shared tiny workload for the elastic-cluster tests.
+
+One definition of (net, loss, optimizer, data) imported by BOTH the test
+process (master + thread workers + references) and the subprocess worker
+script (tests/elastic_worker_script.py), so every participant of a chaos
+run computes identical per-shard math — the byte-stability assertions
+compare apples to apples.
+"""
+
+import numpy as np
+
+
+def build(steps: int = 5, batch: int = 32, seed: int = 0):
+    """-> (loss_fn, params0_fn, make_optimizer, batches)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import nn
+    from paddle_tpu.optimizer import Adam
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16, act="relu")
+            self.fc2 = nn.Linear(16, 2)
+
+        def __call__(self, params, x, **kw):
+            return self.fc2(params["fc2"], self.fc1(params["fc1"], x))
+
+    model = Net()
+
+    def loss_fn(params, x, y):
+        logits = model(params, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], 1).mean()
+
+    rs = np.random.RandomState(seed)
+    batches = [(rs.randn(batch, 8).astype(np.float32),
+                rs.randint(0, 2, batch).astype(np.int32))
+               for _ in range(steps)]
+
+    def params0():
+        return model.init(jax.random.PRNGKey(7))
+
+    # Adam deliberately: its moment slots ride the master's canonical
+    # state, so restarts/resharding cover "Adam slots included"
+    return loss_fn, params0, (lambda: Adam(0.01)), batches

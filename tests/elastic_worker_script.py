@@ -1,0 +1,36 @@
+"""Subprocess elastic worker for the kill -9 chaos tests.
+
+Usage: python elastic_worker_script.py HOST PORT WORKER_ID [MAX_SECONDS]
+
+Joins the elastic master at HOST:PORT under a heartbeat lease, serves
+shard-gradient tasks until the master reports the job done, then leaves
+gracefully and exits 0. A worker the test SIGKILLs mid-pass obviously
+never reaches the leave — that is the point: its eviction + task
+re-bucketing is what the test asserts.
+"""
+
+import os
+import sys
+
+
+def main():
+    host, port, worker_id = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    max_seconds = float(sys.argv[4]) if len(sys.argv) > 4 else 120.0
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from elastic_testnet import build
+    from paddle_tpu.trainer.elastic import ElasticWorker
+
+    loss_fn, _, _, _ = build()
+    worker = ElasticWorker(loss_fn, (host, port), worker=worker_id)
+    summary = worker.run(max_seconds=max_seconds)
+    print("WORKER_DONE", summary["worker"], summary["shards"], flush=True)
+    sys.exit(0 if summary["done"] else 2)
+
+
+if __name__ == "__main__":
+    main()

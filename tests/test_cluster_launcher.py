@@ -12,6 +12,8 @@ SCRIPT = os.path.join(REPO, "tests", "cluster_train_script.py")
 
 
 def test_cluster_train_two_workers():
+    from conftest import require_multiprocess_cpu
+    require_multiprocess_cpu()
     rc = cli_main(["cluster_train", SCRIPT, "--num_workers", "2",
                    "--devices_per_worker", "2", "--timeout", "240"])
     assert rc == 0
@@ -35,6 +37,9 @@ def test_cluster_restart_on_failure_resumes_and_matches(tmp_path, monkeypatch):
     import subprocess
 
     import numpy as np
+
+    from conftest import require_multiprocess_cpu
+    require_multiprocess_cpu()
 
     script = os.path.join(REPO, "tests", "cluster_restart_script.py")
     kill_dir = tmp_path / "killed"
@@ -71,6 +76,9 @@ def test_cluster_worker_death_reaps_job_cleanly(tmp_path, monkeypatch):
     (its on_job_teardown hook ran => checkpoint marker written) — not be
     SIGKILLed. The dead worker, by construction, never reaches its hook."""
     import time
+
+    from conftest import require_multiprocess_cpu
+    require_multiprocess_cpu()
 
     script = os.path.join(REPO, "tests", "cluster_death_script.py")
     monkeypatch.setenv("DEATH_TEST_DIR", str(tmp_path))

@@ -64,6 +64,8 @@ def _single_process_reference():
 
 
 def test_two_process_dp_matches_single(tmp_path):
+    from conftest import require_multiprocess_cpu
+    require_multiprocess_cpu()
     port = _free_port()
     out = str(tmp_path / "mp_params.npz")
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
