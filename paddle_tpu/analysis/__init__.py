@@ -26,7 +26,7 @@ import numpy as np
 
 from .diagnostics import (Diagnostic, ProgramVerificationError, Severity,
                           errors, format_diagnostics, max_severity, op_site)
-from .lints import (LINT_CATALOGUE, lint_autotune_cache,
+from .lints import (LINT_CATALOGUE, lint_alert_rules, lint_autotune_cache,
                     lint_catalogue_drift, lint_metric_names, lint_program)
 from .shape_infer import (UNKNOWN, ShapeInferRegistry, infer_program_shapes,
                           register_shape_infer)
@@ -37,7 +37,7 @@ __all__ = [
     "errors", "format_diagnostics", "max_severity", "op_site",
     "verify_program", "infer_program_shapes", "register_shape_infer",
     "ShapeInferRegistry", "UNKNOWN", "lint_program", "lint_metric_names",
-    "lint_catalogue_drift", "lint_autotune_cache",
+    "lint_catalogue_drift", "lint_autotune_cache", "lint_alert_rules",
     "LINT_CATALOGUE",
     "analyze_program", "check_or_raise",
 ]

@@ -39,6 +39,17 @@ Lints are advisory by default (WARNING/INFO); the CLI's ``--fail-on`` and
   with ``Executor(buckets=None)``.  Fix: pass a
   :class:`~paddle_tpu.data.feeder.BucketSpec`
   (docs/design/executor_perf.md).
+- **L009 alert-rules** (warning): an alert rule
+  (:mod:`paddle_tpu.obs.alerts`) referencing a metric name the catalogue
+  does not declare, filtering on a label key the metric's catalogue entry
+  does not carry (``worker`` is always legal — the merged-view label
+  contract), or applying a kind that cannot evaluate against the metric's
+  kind (``burn_rate`` needs a histogram; ``threshold`` needs a
+  counter/gauge value).  Rules are config pointed at the catalogue's API
+  surface — a rule naming a typo'd metric silently never fires, which is
+  the worst possible alerting failure.  Runs over the shipped default
+  rule set in ``paddle_tpu lint`` (:func:`lint_alert_rules`) and the obs
+  test-suite.
 - **L007 catalogue-drift** (warning): an emit site in ``paddle_tpu/``
   (``obs.count/gauge_set/observe``, ``registry.counter/gauge/histogram``,
   a span's ``metric=``) passes a string-literal metric name that is not
@@ -68,6 +79,7 @@ LINT_CATALOGUE = {
     "L006": ("shape-churn", Severity.WARNING),
     "L007": ("catalogue-drift", Severity.WARNING),
     "L008": ("autotune-staleness", Severity.WARNING),
+    "L009": ("alert-rules", Severity.WARNING),
 }
 
 # control-flow / executor-lowered ops act through sub-blocks, not outputs
@@ -424,6 +436,65 @@ def lint_catalogue_drift(root=None, catalogue=None,
             "(orphan)", var=name,
             hint="delete the entry, or wire the metric where it was "
                  "meant to be observed"))
+    return diags
+
+
+def lint_alert_rules(rules=None, catalogue=None,
+                     severity: Severity = None) -> List[Diagnostic]:
+    """L009: alert rules vs the metric catalogue — the alerting twin of
+    L005/L007.
+
+    Checks every rule (default: the shipped
+    :func:`paddle_tpu.obs.alerts.default_rules` set, which is what a
+    master aggregator starts with) against the catalogue (default:
+    :data:`paddle_tpu.obs.CATALOGUE`):
+
+    * the rule's ``metric`` must be a catalogued name — a rule naming a
+      typo'd or renamed metric never fires, silently;
+    * every label key the rule filters on must be declared by the
+      metric's catalogue entry (``worker`` is always legal: the merged
+      cluster view stamps it on every pushed series);
+    * ``burn_rate`` rules must target histograms (the math needs
+      cumulative buckets); ``threshold`` rules must target counters or
+      gauges (a histogram has no single value to compare).
+    """
+    if catalogue is None:
+        from ..obs import CATALOGUE as catalogue
+    if rules is None:
+        from ..obs.alerts import default_rules
+        rules = default_rules()
+    sev = severity if severity is not None else LINT_CATALOGUE["L009"][1]
+    diags: List[Diagnostic] = []
+
+    def emit(msg: str, rule, hint: str):
+        diags.append(Diagnostic("L009", sev, msg, var=rule.name, hint=hint))
+
+    for rule in rules:
+        spec = catalogue.get(rule.metric)
+        if spec is None:
+            emit(f"alert rule '{rule.name}' references metric "
+                 f"'{rule.metric}' which obs/catalogue.py does not "
+                 "declare — the rule can never fire", rule,
+                 "fix the metric name, or catalogue the new metric first")
+            continue
+        kind = spec[0] if isinstance(spec, (tuple, list)) else spec
+        declared = (tuple(spec[2]) if isinstance(spec, (tuple, list))
+                    and len(spec) > 2 else ())
+        for key in rule.labels:
+            if key != "worker" and key not in declared:
+                emit(f"alert rule '{rule.name}' filters on label "
+                     f"'{key}' which '{rule.metric}' does not declare "
+                     f"(declared: {list(declared) or 'none'})", rule,
+                     "filter only on declared label keys (or 'worker')")
+        if rule.kind == "burn_rate" and kind != "histogram":
+            emit(f"alert rule '{rule.name}' is burn_rate over "
+                 f"'{rule.metric}' ({kind}); burn-rate math needs a "
+                 "histogram's cumulative buckets", rule,
+                 "use a threshold rule, or target the _seconds histogram")
+        elif rule.kind == "threshold" and kind == "histogram":
+            emit(f"alert rule '{rule.name}' thresholds histogram "
+                 f"'{rule.metric}' which has no single value", rule,
+                 "use burn_rate with an slo_le bucket bound instead")
     return diags
 
 

@@ -445,6 +445,11 @@ def cmd_lint(args):
     for d in analysis.lint_autotune_cache(args.autotune_cache):
         d.program = "autotune"
         all_diags.append(d)
+    # L009: the shipped alert rules must reference catalogued metrics —
+    # a rule naming a typo'd metric silently never fires
+    for d in analysis.lint_alert_rules():
+        d.program = "obs"
+        all_diags.append(d)
     n_err = len(analysis.errors(all_diags))
     n_warn = sum(1 for d in all_diags
                  if d.severity == analysis.Severity.WARNING)
@@ -1175,9 +1180,17 @@ def cmd_obs_serve(args):
             client = telemetry_client(*master_addr)
             try:
                 workers, samples = client.obs_stats()
+                try:
+                    h = client.obs_health()
+                except (OSError, ConnectionError):
+                    # a master predating obs_health still serves metrics
+                    h = {"health": {}, "active": [], "events": []}
                 dumps.append({"meta": {"process": "master",
                                        "obs_workers": workers},
-                              "metrics": samples, "events": []})
+                              "metrics": samples,
+                              "events": h["events"],
+                              "alerts": h["active"],
+                              "health": h["health"]})
             except (OSError, ConnectionError) as e:
                 # keep serving whatever dumps we do have; a master-only
                 # serve surfaces the outage as a 500 with the cause
@@ -1187,7 +1200,18 @@ def cmd_obs_serve(args):
                       file=sys.stderr)
             finally:
                 client.close()
-        return dumps[0] if len(dumps) == 1 else obs.merge_dumps(dumps)
+        if len(dumps) == 1:
+            return dumps[0]
+        merged = obs.merge_dumps(dumps)
+        # merge_dumps knows meta/metrics/events; the health-plane extras
+        # (live alerts, derived health) carry through for /alerts and the
+        # /summary fleet table
+        for d in dumps:
+            if d.get("alerts"):
+                merged.setdefault("alerts", []).extend(d["alerts"])
+            if d.get("health"):
+                merged.setdefault("health", {}).update(d["health"])
+        return merged
 
     srv = ObsHttpServer(provider, host=args.host, port=args.port).start()
     # machine-parseable address line first (port 0 binds an ephemeral one)
@@ -1203,6 +1227,92 @@ def cmd_obs_serve(args):
     finally:
         srv.stop()
     return 0
+
+
+def cmd_obs_top(args):
+    """``paddle_tpu obs top`` — the live fleet terminal view: one row per
+    worker (goodput ratio, mfu, queue depth, straggler score, heartbeat
+    jitter, active alerts) over a live master's health plane
+    (``--master`` → ``obs_stats`` + ``obs_health``) and/or dump files
+    (``--input``, re-read per refresh). ``--once`` prints a single table
+    and exits (tests, scripts); otherwise the view refreshes every
+    ``--interval`` seconds until Ctrl-C.
+    """
+    from . import obs
+    from .obs.health import health_table
+    inputs = list(args.input or ())
+    master = getattr(args, "master", None)
+    if not inputs and not master:
+        print("obs top: pass --input dump.jsonl (repeatable) and/or "
+              "--master host:port", file=sys.stderr)
+        return 2
+    master_addr = None
+    if master:
+        master_addr = _parse_hostport(master)
+        if master_addr is None:
+            print(f"obs top: --master must be host:port, got {master!r}",
+                  file=sys.stderr)
+            return 2
+
+    def fetch():
+        samples, alerts, health = [], [], {}
+        if inputs:
+            dumps = _read_obs_inputs(inputs)
+            # always merge (even one dump): the merge stamps the worker
+            # label every per-worker cell keys on
+            merged = obs.merge_dumps(dumps)
+            samples.extend(merged.get("metrics", ()))
+            alerts.extend(e for e in merged.get("events", ())
+                          if e.get("name") == "alert")
+        if master_addr is not None:
+            from .obs.aggregate import telemetry_client
+            client = telemetry_client(*master_addr)
+            try:
+                _, live = client.obs_stats()
+                samples.extend(live)
+                try:
+                    h = client.obs_health()
+                except (OSError, ConnectionError):
+                    # a master predating obs_health still serves metrics
+                    h = {"health": {}, "active": [], "events": []}
+                health = h["health"]
+                # transitions first (chronological fold), live state last
+                alerts.extend(h["events"])
+                alerts.extend(h["active"])
+            finally:
+                client.close()
+        return samples, alerts, health
+
+    def render():
+        try:
+            samples, alerts, health = fetch()
+        except (OSError, ConnectionError) as e:
+            return None, f"obs top: source unavailable: {e}"
+        from .obs.health import fold_alert_stream
+        table = health_table(samples, alerts=alerts, health=health)
+        firing = fold_alert_stream(alerts)
+        head = (f"fleet: {len(health) if health else '-'} worker(s) in "
+                f"health view, {len(firing)} alert(s) firing")
+        return table, head
+
+    once = bool(getattr(args, "once", False))
+    try:
+        while True:
+            table, head = render()
+            if table is None:
+                print(head, file=sys.stderr)
+                if once:
+                    return 2
+            else:
+                if not once:
+                    print("\x1b[2J\x1b[H", end="")   # clear + home
+                print(head)
+                print(table if table else "(no per-worker series yet)")
+            if once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_serve(args):
@@ -1583,6 +1693,19 @@ def main(argv=None) -> int:
     osv.add_argument("--port", type=int, default=0,
                      help="0 binds an ephemeral port (printed on start)")
     osv.set_defaults(fn=cmd_obs_serve)
+    ot = obsub.add_parser("top", help="live per-worker fleet table: "
+                                      "goodput, mfu, queue, straggler "
+                                      "score, active alerts")
+    ot.add_argument("--input", action="append",
+                    help="JSONL dump(s) to read (re-read per refresh)")
+    ot.add_argument("--master", default=None,
+                    help="host:port of a live MasterServer — renders its "
+                         "obs_stats + obs_health fleet view")
+    ot.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    ot.add_argument("--once", action="store_true",
+                    help="print one table and exit (scripts, tests)")
+    ot.set_defaults(fn=cmd_obs_top)
 
     sv = sub.add_parser("serve", help="serving daemon: paged KV-cache "
                         "continuous batching behind the native RPC plane "

@@ -17,6 +17,7 @@ site                      where it fires
 ``lease.renew``           per lease renewal (runtime/lease.py, runtime/coord.py)
 ``reader.next``           per chunk-task stream opened (data/chunks.py)
 ``step.grad``             per train-step loss produced (trainer/trainer.py)
+                          and per elastic shard gradient (trainer/elastic.py)
 ``mbr.heartbeat``         per membership heartbeat sent (runtime/membership.py)
 ========================  =====================================================
 
@@ -120,7 +121,8 @@ class FaultPlan:
     :meth:`installed` context manager; only one plan is active at a time.
     """
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0,
+                 sleep: Optional[Callable[[float], None]] = None):
         self.seed = seed
         self.rng = random.Random(seed)
         self.faults: List[Fault] = []
@@ -128,6 +130,10 @@ class FaultPlan:
         #: chronological (site, hit_number, action) log of every fault fired
         self.fired: List[Tuple[str, int, str]] = []
         self._lock = threading.Lock()
+        # injectable sleeper for `delay` actions: fake-clock chaos tests
+        # (ISSUE 15 straggler detection) advance a counter instead of
+        # stalling the suite — the utils/retry clock discipline
+        self._sleep = sleep or time.sleep
 
     # -- authoring ----------------------------------------------------------
     def add(self, site: str, action: str = "raise", **kw) -> "FaultPlan":
@@ -183,7 +189,7 @@ class FaultPlan:
         _, due = self._hit(site)
         for f in due:
             if f.action == "delay":
-                time.sleep(f.delay_s)
+                self._sleep(f.delay_s)
             elif f.action == "raise":
                 # flight recorder (obs/flight.py): persist the span ring
                 # BEFORE the injected exception starts unwinding — even if
@@ -204,7 +210,7 @@ class FaultPlan:
         _, due = self._hit(site)
         for f in due:
             if f.action == "delay":
-                time.sleep(f.delay_s)
+                self._sleep(f.delay_s)
             elif f.action == "raise":
                 obs.flight_dump(f"fault:{site}")
                 raise self._make_exc(f, site)
@@ -226,7 +232,7 @@ class FaultPlan:
         _, due = self._hit(site)
         for f in due:
             if f.action == "delay":
-                time.sleep(f.delay_s)
+                self._sleep(f.delay_s)
             elif f.action == "raise":
                 obs.flight_dump(f"fault:{site}")
                 raise self._make_exc(f, site)

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from . import context, goodput, roofline
+from . import alerts, context, goodput, health, roofline
 from .catalogue import CATALOGUE, SPANS
 from .export import (chrome_trace, merge_dumps, prometheus_text, read_jsonl,
                      summary, write_jsonl)
@@ -45,7 +45,7 @@ __all__ = [
     "install", "uninstall", "count", "gauge_set", "observe", "span",
     "instant", "server_span", "wire_context", "retry_observer",
     "FlightRecorder", "flight_recorder", "flight_dump", "NullSpan",
-    "NULL_SPAN", "context", "goodput", "roofline",
+    "NULL_SPAN", "context", "goodput", "roofline", "health", "alerts",
 ]
 
 #: process-global default registry — what an installed session reports into

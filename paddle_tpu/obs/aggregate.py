@@ -115,31 +115,62 @@ def wire_safe_samples(samples: Any) -> List[Any]:
 
 
 class ClusterAggregator:
-    """Latest-snapshot-per-worker store behind the master's ``obs_push``.
+    """Latest-snapshot-per-worker store behind the master's ``obs_push``
+    — plus, since ISSUE 15, the fleet health plane: every push also lands
+    in a bounded windowed :class:`~paddle_tpu.obs.health.TimeSeriesStore`
+    (``history``), and a rate-limited evaluation pass derives per-worker
+    health (``health`` — straggler score, heartbeat jitter, goodput EWMA;
+    emitted as ``cluster.health_*`` gauges and recorded back into the
+    store) and runs the declarative ``alerts`` engine over it.
 
     ``ttl`` bounds both memory and staleness: worker ids embed pids, so a
     chaos-churned fleet (preempt, restart, repeat for days) would
     otherwise accumulate one frozen snapshot per dead incarnation forever.
     A worker that stops pushing for ``ttl`` seconds ages out of the
-    merged view (and out of memory) on the next push or read.
+    merged view (and out of memory) on the next push or read; its history
+    series age out with it.
     """
 
     def __init__(self, ttl: float = 900.0,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 window_s: float = 300.0, max_points: int = 240,
+                 rules: Any = None, eval_interval_s: float = 2.0):
         import time
+        from .alerts import AlertEngine, default_rules
+        from .health import FleetHealth, TimeSeriesStore
         self.ttl = ttl
         self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         # worker -> (last_push_monotonic, cleaned samples)
         self._snaps: Dict[str, Any] = {}
+        self.history = TimeSeriesStore(window_s=window_s,
+                                       max_points=max_points,
+                                       clock=self._clock)
+        self.health = FleetHealth(clock=self._clock)
+        self.alerts = AlertEngine(
+            default_rules() if rules is None else rules, self.history)
+        self.eval_interval_s = float(eval_interval_s)
+        self._last_eval = float("-inf")
+        self._health_snapshot: Dict[str, Dict[str, Any]] = {}
 
     def _prune_locked(self) -> None:
         cutoff = self._clock() - self.ttl
-        for wid in [w for w, (ts, _) in self._snaps.items() if ts < cutoff]:
+        dead = [w for w, (ts, _) in self._snaps.items() if ts < cutoff]
+        for wid in dead:
             del self._snaps[wid]
+        if dead:
+            # prune history to workers still alive by EITHER signal:
+            # pushing snapshots, or feeding the health plane (elastic
+            # workers feed shard timings/heartbeats without ever
+            # obs_pushing — membership leave/evict forget()s them, which
+            # is what lets their series age out here)
+            self.history.prune(set(self._snaps)
+                               | self.health.known_workers())
 
     def push(self, worker: str, samples: Any) -> int:
-        """Replace ``worker``'s snapshot; returns the accepted count."""
+        """Replace ``worker``'s snapshot; returns the accepted count. The
+        cleaned samples also append to the windowed history, and (rate-
+        limited by ``eval_interval_s``) the health/alert pass runs."""
         if not isinstance(samples, (list, tuple)):
             samples = []
         cleaned = []
@@ -147,12 +178,73 @@ class ClusterAggregator:
             c = _clean_sample(s)
             if c is not None:
                 cleaned.append(c)
+        now = self._clock()
         with self._lock:
-            self._snaps[str(worker)] = (self._clock(), cleaned)
+            self._snaps[str(worker)] = (now, cleaned)
             self._prune_locked()
             n_workers = len(self._snaps)
+        self.history.record(worker, cleaned, ts=now)
         _gauge_set("master.obs_workers", n_workers)
+        self.maybe_evaluate(now)
         return len(cleaned)
+
+    # -- the health/alert evaluation pass -----------------------------------
+    def maybe_evaluate(self, now: Optional[float] = None) -> bool:
+        """Run the derivation + alert pass if ``eval_interval_s`` elapsed
+        since the last one (the push path's rate limit); tests drive
+        :meth:`evaluate` directly."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            if now - self._last_eval < self.eval_interval_s:
+                return False
+            self._last_eval = now
+        self.evaluate(now)
+        return True
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Derive per-worker health, emit/record the ``cluster.health_*``
+        gauges, then evaluate the alert rules. Returns the health
+        snapshot."""
+        now = self._clock() if now is None else float(now)
+        snap = self.health.snapshot(self.history, now=now)
+
+        def record(metric: str, v: float, w: str) -> None:
+            # back into the store: alert rules threshold derived health
+            # exactly like any pushed series
+            self.history.record_value(w, metric, v,
+                                      labels={"worker": w}, ts=now)
+
+        for w, h in snap.items():
+            v = h.get("straggler_score")
+            if v is not None:
+                _gauge_set("cluster.health_straggler_score", v, worker=w)
+                record("cluster.health_straggler_score", v, w)
+            v = h.get("goodput_ewma")
+            if v is not None:
+                _gauge_set("cluster.health_goodput_ewma", v, worker=w)
+                record("cluster.health_goodput_ewma", v, w)
+            v = h.get("heartbeat_jitter")
+            if v is not None:
+                _gauge_set("cluster.health_heartbeat_jitter", v, worker=w)
+                record("cluster.health_heartbeat_jitter", v, w)
+        with self._lock:
+            self._health_snapshot = snap
+        self.alerts.evaluate(now)
+        return snap
+
+    def forget_worker(self, worker: str) -> None:
+        """A worker authoritatively departed (membership leave/eviction):
+        drop its health feeds AND its history series now — the next alert
+        evaluation then resolves anything firing on it (series_gone)
+        instead of freezing a dead incarnation's alert as active."""
+        self.health.forget(worker)
+        self.history.drop_worker(worker)
+
+    def health_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """The last evaluated per-worker health (the ``obs_health`` op's
+        payload); empty before the first evaluation."""
+        with self._lock:
+            return {w: dict(h) for w, h in self._health_snapshot.items()}
 
     def workers(self) -> List[str]:
         with self._lock:
@@ -247,13 +339,14 @@ class ObsHttpServer:
     only; any other method is 405; unknown paths 404.
     """
 
-    ROUTES = ("/metrics", "/trace", "/summary", "/")
+    ROUTES = ("/metrics", "/trace", "/summary", "/alerts", "/")
 
     def __init__(self, provider: Callable[[], Dict[str, Any]],
                  host: str = "127.0.0.1", port: int = 0):
         import http.server
 
         from .export import chrome_trace, prometheus_text, summary
+        from .health import health_table
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -270,8 +363,30 @@ class ObsHttpServer:
                         body = json.dumps(
                             chrome_trace(outer.provider())).encode()
                         ctype = "application/json"
+                    elif path == "/alerts":
+                        # alert transitions are dump EVENTS (name="alert")
+                        # plus whatever live state the provider attached
+                        # ("alerts" key, master mode) — file mode works
+                        # from the events alone
+                        dump = outer.provider()
+                        events = [e for e in dump.get("events", ())
+                                  if e.get("name") == "alert"]
+                        body = json.dumps(
+                            {"active": dump.get("alerts") or [],
+                             "events": events}, indent=1).encode()
+                        ctype = "application/json"
                     elif path in ("/summary", "/"):
-                        body = (summary(outer.provider()) + "\n").encode()
+                        dump = outer.provider()
+                        text = summary(dump)
+                        table = health_table(
+                            dump.get("metrics", ()),
+                            alerts=[e for e in dump.get("events", ())
+                                    if e.get("name") == "alert"]
+                            + (dump.get("alerts") or []),
+                            health=dump.get("health"))
+                        if table:
+                            text += "\n== fleet health ==\n" + table
+                        body = (text + "\n").encode()
                         ctype = "text/plain"
                     else:
                         self.send_error(404)

@@ -221,6 +221,54 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
                                                 "member at an epoch bump "
                                                 "(ahead of the timeout "
                                                 "re-dispatch)"),
+    # worker labels below are BOUNDED by the fleet size (membership-leased
+    # worker names), the same contract as the merged-registry worker tag
+    "cluster.shard_seconds": ("histogram", "worker-reported shard gradient "
+                                           "wall time per accepted "
+                                           "ela_grad (the straggler "
+                                           "score's raw feed), labels: "
+                                           "worker (bounded: fleet size)",
+                              ("worker",)),
+    "cluster.health_straggler_score": ("gauge", "derived: worker median "
+                                                "shard latency / the OTHER "
+                                                "workers' median (leave-"
+                                                "one-out) over the health "
+                                                "window (>2 for 2+ "
+                                                "evaluations = straggler), "
+                                                "labels: worker (bounded)",
+                                       ("worker",)),
+    "cluster.health_goodput_ewma": ("gauge", "derived: exponentially-"
+                                             "weighted goodput.ratio over "
+                                             "the worker's windowed "
+                                             "history, labels: worker "
+                                             "(bounded)", ("worker",)),
+    "cluster.health_heartbeat_jitter": ("gauge", "derived: stddev of the "
+                                                 "worker's heartbeat "
+                                                 "arrival intervals "
+                                                 "(seconds) over the "
+                                                 "health window, labels: "
+                                                 "worker (bounded)",
+                                        ("worker",)),
+    "cluster.backlog_per_worker": ("gauge", "autoscale input at each "
+                                            "mbr_view: (todo + pending "
+                                            "tasks) / live members — the "
+                                            "windowed series hysteresis "
+                                            "reads"),
+    "cluster.autoscale_signal": ("gauge", "the tentative autoscale action "
+                                          "recorded per mbr_view "
+                                          "(join=1, hold=0, leave=-1); a "
+                                          "recommendation only commits "
+                                          "when the signal held for the "
+                                          "whole hysteresis window"),
+    # -- alerts: obs/alerts.py (the fleet alert engine) ------------------
+    "alerts.fired_total": ("counter", "alert rules transitioning to "
+                                      "firing, labels: rule (bounded: "
+                                      "the declared rule set)", ("rule",)),
+    "alerts.resolved_total": ("counter", "alert rules transitioning back "
+                                         "to resolved, labels: rule "
+                                         "(bounded)", ("rule",)),
+    "alerts.active": ("gauge", "alert series currently firing across "
+                               "the whole rule set"),
     # -- coord: runtime/coord.py (CoordServer._dispatch) ----------------
     "coord.requests_total": ("counter", "coord RPCs dispatched, "
                                         "labels: type", ("type",)),

@@ -396,7 +396,7 @@ class MasterServer:
     #: hostile/buggy peer cannot mint unbounded counter series (the
     #: failure mode our own L005 cardinality lint flags)
     _KNOWN_OPS = _MUTATING_OPS | frozenset({"stats", "obs_push",
-                                            "obs_stats"})
+                                            "obs_stats", "obs_health"})
 
     # -- dispatch ----------------------------------------------------------
     # The network path dispatches in C++ (master_server.cc, byte-identical
@@ -474,6 +474,15 @@ class MasterServer:
         if op == "obs_stats":
             return {"ok": True, "workers": self.aggregator.workers(),
                     "samples": self.aggregator.merged_samples()}
+        if op == "obs_health":
+            # the fleet health plane's read surface: derived per-worker
+            # health, live alerts, and the bounded transition log
+            # (obs/health.py, obs/alerts.py)
+            agg = self.aggregator
+            agg.maybe_evaluate()
+            return {"ok": True, "health": agg.health_snapshot(),
+                    "active": agg.alerts.active(),
+                    "events": agg.alerts.recent_events()}
         if op == "set_dataset":
             self.master.set_dataset(req["payloads"])
             return {"ok": True}
@@ -703,3 +712,15 @@ class MasterClient(_RpcClient):
             raise ConnectionError(
                 f"obs_stats rejected: {r.get('error', 'unknown error')}")
         return list(r.get("workers", ())), list(r.get("samples", ()))
+
+    def obs_health(self):
+        """The fleet health view (ISSUE 15): ``{"health": per-worker
+        derived health, "active": firing alerts, "events": recent alert
+        transitions}`` — what ``paddle_tpu obs top --master`` renders."""
+        r = self._call({"op": "obs_health"})
+        if not r.get("ok"):
+            raise ConnectionError(
+                f"obs_health rejected: {r.get('error', 'unknown error')}")
+        return {"health": r.get("health") or {},
+                "active": list(r.get("active", ())),
+                "events": list(r.get("events", ()))}

@@ -113,6 +113,26 @@ class MembershipService:
         self._thread: Optional[threading.Thread] = None
 
     # -- wiring -------------------------------------------------------------
+    def _aggregator(self):
+        """The attached server's ClusterAggregator (None when detached —
+        unit tests on a bare service)."""
+        srv = self._server
+        return getattr(srv, "aggregator", None) if srv is not None else None
+
+    def _fleet_health(self):
+        return getattr(self._aggregator(), "health", None)
+
+    def _forget_worker(self, worker: str) -> None:
+        """Authoritative departure: reap the worker's health feeds AND
+        its history series so no alert freezes on a dead incarnation."""
+        agg = self._aggregator()
+        if agg is None:
+            return
+        if hasattr(agg, "forget_worker"):
+            agg.forget_worker(worker)
+        elif getattr(agg, "health", None) is not None:
+            agg.health.forget(worker)
+
     def attach(self, server) -> "MembershipService":
         """Register the mbr_* ops on a MasterServer (before ``start()`` so
         no request can observe a half-wired op table)."""
@@ -201,6 +221,11 @@ class MembershipService:
                             epoch=self.epoch)
             m.deadline = self._clock() + self.ttl
         obs.count("cluster.heartbeats_total")
+        # feed the fleet health plane: heartbeat ARRIVAL times are the
+        # jitter detector's raw signal (obs/health.py)
+        h = self._fleet_health()
+        if h is not None:
+            h.note_heartbeat(worker)
         return None
 
     def leave(self, worker: str, token: int) -> Optional[Dict[str, Any]]:
@@ -214,6 +239,7 @@ class MembershipService:
                             f"{m.token}", epoch=self.epoch)
             del self._members[worker]
             self._bump_locked()
+        self._forget_worker(worker)
         obs.count("cluster.leaves_total", reason="graceful")
         log.info("member %s left gracefully -> epoch %d", worker, self.epoch)
         self._notify(joined=[], left=[worker], reason="leave")
@@ -230,6 +256,7 @@ class MembershipService:
             if dead:
                 self._bump_locked()
         for w in dead:
+            self._forget_worker(w)
             obs.count("cluster.leaves_total", reason="evicted")
             log.warning("member %s missed its heartbeat window (ttl %.1fs): "
                         "evicted -> epoch %d", w, self.ttl, self.epoch)
@@ -333,7 +360,8 @@ class MembershipService:
                 samples = srv.aggregator.merged_samples()
                 rec = autoscale_recommendation(
                     members=len(view["members"]), todo=todo,
-                    pending=pending, samples=samples)
+                    pending=pending, samples=samples,
+                    history=getattr(srv.aggregator, "history", None))
             except Exception as e:   # telemetry must not break the view
                 rec = {"action": "hold",
                        "reason": f"recommendation unavailable: {e}"}
@@ -345,9 +373,15 @@ class MembershipService:
 
 # -- autoscale hook -------------------------------------------------------------
 
+#: tentative action -> the cluster.autoscale_signal gauge encoding
+_SIGNAL = {"join": 1.0, "hold": 0.0, "leave": -1.0}
+
+
 def autoscale_recommendation(*, members: int, todo: int, pending: int,
                              samples=(), scale_up_backlog: float = 2.0,
-                             scale_down_goodput: float = 0.25
+                             scale_down_goodput: float = 0.25,
+                             history=None, hysteresis_windows: int = 3,
+                             now: Optional[float] = None
                              ) -> Dict[str, Any]:
     """Fold queue depth + fleet telemetry into a join/leave recommendation.
 
@@ -364,8 +398,17 @@ def autoscale_recommendation(*, members: int, todo: int, pending: int,
       waiting for work);
     * otherwise ``hold``.
 
-    Pure function of its inputs — unit-testable, and callers (the
-    ``mbr_view`` op, external scalers) share one policy.
+    **Hysteresis** (ISSUE 15): with ``history`` (the aggregator's
+    :class:`~paddle_tpu.obs.health.TimeSeriesStore`), each call records
+    its inputs and TENTATIVE action as master-side series
+    (``cluster.backlog_per_worker``, ``cluster.autoscale_signal``) and a
+    non-``hold`` action only commits once the signal has pointed the same
+    way for the last ``hysteresis_windows`` evaluations — a one-sample
+    backlog spike (or one idle scrape) recommends ``hold`` with the
+    hysteresis reason instead of flapping the fleet. The "no live
+    members" branch bypasses hysteresis: a dead fleet with queued work
+    must scale up NOW. Without ``history`` the function stays pure
+    (unit tests, external scalers sharing the instantaneous policy).
     """
     ratios: List[float] = []
     starved = 0.0
@@ -388,7 +431,10 @@ def autoscale_recommendation(*, members: int, todo: int, pending: int,
     if members == 0:
         out.update(action="join",
                    reason=f"no live workers for {backlog} queued task(s)")
-    elif backlog / members > scale_up_backlog:
+        if history is not None:
+            _record_autoscale(history, out, now)
+        return out                     # bypass hysteresis: fleet is dead
+    if backlog / members > scale_up_backlog:
         out.update(action="join",
                    reason=f"backlog {backlog} over {members} worker(s) "
                           f"exceeds {scale_up_backlog}/worker")
@@ -401,7 +447,49 @@ def autoscale_recommendation(*, members: int, todo: int, pending: int,
         out.update(action="leave", reason=f"queue empty and {why}")
     else:
         out.update(action="hold", reason="queue and fleet in balance")
+    if history is not None:
+        past = _record_autoscale(history, out, now)
+        if out["action"] != "hold":
+            want = _SIGNAL[out["action"]]
+            recent = past[-hysteresis_windows:]
+            # sustained = the last K evaluations agreed, OR — for callers
+            # polling too sparsely to ever land K points inside the store
+            # window — every in-window evaluation agreed AND they span at
+            # least half the window (a single spike spans nothing; a
+            # backlog persisting across sparse polls still scales)
+            span = past[-1][0] - past[0][0] if len(past) >= 2 else 0.0
+            sustained = (
+                (len(recent) >= hysteresis_windows
+                 and all(v == want for _, v in recent))
+                or (len(past) >= 2
+                    and all(v == want for _, v in past)
+                    and span >= history.window_s / 2.0))
+            if not sustained:
+                out["tentative"] = out["action"]
+                out.update(action="hold",
+                           reason=f"hysteresis: '{out['tentative']}' "
+                                  f"signal not sustained over "
+                                  f"{hysteresis_windows} window(s)")
     return out
+
+
+def _record_autoscale(history, out: Dict[str, Any], now) -> list:
+    """Record this evaluation's inputs + tentative signal into the
+    master-side history series; returns the signal points (incl. this
+    one, oldest first). Emits the matching gauges so the flap debugging
+    series is visible in every export."""
+    from ..obs.health import MASTER_WORKER
+    signal = _SIGNAL[out["action"]]
+    bpw = out.get("backlog_per_worker")
+    if bpw is not None:
+        history.record_value(MASTER_WORKER, "cluster.backlog_per_worker",
+                             float(bpw), ts=now)
+        obs.gauge_set("cluster.backlog_per_worker", float(bpw))
+    history.record_value(MASTER_WORKER, "cluster.autoscale_signal",
+                         signal, ts=now)
+    obs.gauge_set("cluster.autoscale_signal", signal)
+    return history.points(MASTER_WORKER, "cluster.autoscale_signal",
+                          now=now)
 
 
 # -- worker side ----------------------------------------------------------------

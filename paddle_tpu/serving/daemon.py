@@ -58,6 +58,10 @@ class ServingDaemon:
         self.server.register_op("srv_poll", self._srv_poll)
         self.server.register_op("srv_cancel", self._srv_cancel)
         self.server.register_op("srv_stats", self._srv_stats)
+        # the engine's SLO burn-rate defaults join the aggregator's rule
+        # set, so the daemon's own TTFT/TPOT pushes are alertable at the
+        # engine's configured targets (obs serve /alerts, obs_health)
+        self.server.aggregator.alerts.add_rules(self.engine.alert_rules())
         self._obs_interval = obs_interval_s
         self._stop = threading.Event()
         self._draining = threading.Event()

@@ -92,6 +92,8 @@ class ServingEngine:
                  prefix_cache: bool = False,
                  class_weights: Optional[Dict[str, float]] = None,
                  max_tenants: int = 32,
+                 slo_ttft_s: float = 1.0, slo_tpot_s: float = 0.25,
+                 slo_budget: float = 0.1,
                  clock=time.monotonic):
         self.pool = PagePool(model, params, slots=slots, segment=segment,
                              page_block=page_block, pages=pages,
@@ -121,6 +123,19 @@ class ServingEngine:
         # labels: the engine refuses to mint series for more than
         # max_tenants distinct tenants (structured at submit)
         self.max_tenants = max_tenants
+        # SLO targets the default burn-rate alert rules are derived from
+        # (obs/alerts.py serving_slo_rules; the daemon registers them on
+        # the master aggregator's alert engine)
+        if slo_ttft_s <= 0 or slo_tpot_s <= 0:
+            raise ValueError("slo_ttft_s / slo_tpot_s must be > 0")
+        if not (0.0 < slo_budget < 1.0):
+            # fail at the parameter the operator set, not from AlertRule
+            # deep inside daemon construction
+            raise ValueError(
+                f"slo_budget must be in (0, 1), got {slo_budget!r}")
+        self.slo_ttft_s = float(slo_ttft_s)
+        self.slo_tpot_s = float(slo_tpot_s)
+        self.slo_budget = float(slo_budget)
         self._tenants = set()
         self._clock = clock
         self._lock = threading.Lock()
@@ -238,6 +253,16 @@ class ServingEngine:
             rec = self._recs[rid]
             return {"t_submit": rec.t_submit, "t_first": rec.t_first,
                     "t_done": rec.t_done}
+
+    def alert_rules(self):
+        """The engine's SLO alert defaults: multi-window burn-rate rules
+        over ``serving.ttft_seconds`` / ``serving.tpot_seconds`` at THIS
+        engine's configured targets — what the daemon registers on the
+        master aggregator's alert engine (docs/design/observability.md
+        "Fleet health & alerting")."""
+        from ..obs.alerts import serving_slo_rules
+        return serving_slo_rules(self.slo_ttft_s, self.slo_tpot_s,
+                                 self.slo_budget)
 
     def stats(self) -> Dict[str, float]:
         with self._lock:

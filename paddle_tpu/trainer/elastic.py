@@ -62,7 +62,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from .. import obs
+from .. import faults, obs
 from ..runtime.master_service import (CODE_STALE_EPOCH, CODE_STALE_STEP,
                                       MasterServer, StaleMemberError)
 from ..runtime.membership import (MembershipClient, MembershipService,
@@ -519,8 +519,22 @@ class ElasticMaster:
             if req.get("loss") is not None:
                 self._losses[shard] = float(req["loss"])
             self._cv.notify_all()
-            return {"ok": True, "duplicate": False,
-                    "epoch": self.membership.epoch}
+        # feed the fleet health plane OUTSIDE the step lock: the worker-
+        # reported shard wall time is the straggler score's raw signal
+        # (obs/health.py; duplicates were answered above and don't count)
+        el = req.get("elapsed_s")
+        if el is not None:
+            try:
+                el = float(el)
+            except (TypeError, ValueError):
+                el = None
+        if el is not None and el >= 0:
+            obs.observe("cluster.shard_seconds", el, worker=worker)
+            agg = getattr(self.server, "aggregator", None)
+            if agg is not None and getattr(agg, "health", None) is not None:
+                agg.health.note_shard(worker, el)
+        return {"ok": True, "duplicate": False,
+                "epoch": self.membership.epoch}
 
     def _op_state(self, req):
         with self._mu:
@@ -549,7 +563,8 @@ class ElasticWorker:
 
     def __init__(self, loss_fn: Callable, endpoints, *,
                  worker: Optional[str] = None, mesh=None, layout=None,
-                 poll: float = 0.02, retries: int = 8, caps=None):
+                 poll: float = 0.02, retries: int = 8, caps=None,
+                 clock: Callable[[], float] = time.monotonic):
         if isinstance(endpoints, tuple) and len(endpoints) == 2 and \
                 isinstance(endpoints[1], int):
             endpoints = [endpoints]
@@ -561,6 +576,10 @@ class ElasticWorker:
         self.caps = caps or {}
         self.retries = retries
         self.loss_fn = loss_fn
+        # shard wall-time source (injectable: fake-clock chaos tests) —
+        # the measured duration rides each ela_grad and feeds the
+        # master-side straggler score (obs/health.py)
+        self._shard_clock = clock
         self._vg = jax.jit(jax.value_and_grad(loss_fn))
         self._params = None
         self._version: Optional[Tuple[int, int]] = None
@@ -590,7 +609,21 @@ class ElasticWorker:
         obs.count("cluster.resyncs_total")
         return True
 
+    def _timed_grad(self, payload: dict):
+        """(loss, grads, elapsed_s) — the shard compute under the shard
+        wall clock. The elapsed time rides the ela_grad push and feeds
+        the master-side straggler score (obs/health.py), so the timing
+        boundary and the chaos site live in ONE place."""
+        t0 = self._shard_clock()
+        loss, grads = self._grad_of(payload)
+        return loss, grads, max(self._shard_clock() - t0, 0.0)
+
     def _grad_of(self, payload: dict):
+        # the elastic shard twin of the trainer's step.grad chaos site: a
+        # `delay` rule here makes THIS worker a straggler (its inflated
+        # shard time rides the ela_grad push into the health plane); a
+        # `raise` kills the shard like any injected worker failure
+        faults.fire("step.grad")
         arrays = _unpack_arrays(payload["batch"])
         if self.mesh is not None:
             # data-sharding is an optimization, not a requirement: an
@@ -682,14 +715,15 @@ class ElasticWorker:
                 # the dispatch timeout requeue it for someone current
                 time.sleep(self.poll)
                 return False
-        loss, grads = self._grad_of(payload)
+        loss, grads, elapsed = self._timed_grad(payload)
         try:
             resp = client._call({
                 "op": "ela_grad", "worker": self.worker,
                 "member_token": keeper.token, "epoch": self.last_epoch,
                 "pass": version[0], "step": version[1],
                 "shard": int(payload["shard"]), "task_id": task["id"],
-                "loss": loss, "grad": _pack_tree(grads)})
+                "loss": loss, "grad": _pack_tree(grads),
+                "elapsed_s": elapsed})
         except StaleMemberError as e:
             if e.code == CODE_STALE_EPOCH or e.code == CODE_STALE_STEP:
                 self._resync.set()

@@ -1,0 +1,759 @@
+"""Fleet health plane (ISSUE 15): windowed time-series, straggler/anomaly
+detection, the alert rules engine, and their consumers.
+
+Everything here runs on FAKE clocks — the faults plane's injectable
+sleeper (``FaultPlan(sleep=...)``) means even a chaos ``delay`` advances
+a counter instead of stalling the suite. The acceptance chaos test drives
+the REAL paths end to end: an elastic worker's ``_timed_grad`` under an
+installed ``step.grad`` delay plan, the real ``ela_grad`` dispatch into
+the master's health tracker, the aggregator's evaluation loop, the alert
+engine, the armed flight recorder, and the merged chrome export.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from elastic_testnet import build
+from paddle_tpu import analysis, faults, obs
+from paddle_tpu.obs.aggregate import ClusterAggregator, ObsHttpServer
+from paddle_tpu.obs.alerts import (AlertEngine, AlertRule, default_rules,
+                                   serving_slo_rules)
+from paddle_tpu.obs.health import (FleetHealth, TimeSeriesStore, ewma,
+                                   health_table, rate)
+from paddle_tpu.runtime.membership import autoscale_recommendation
+from paddle_tpu.trainer.elastic import (ElasticMaster, ElasticWorker,
+                                        _pack_arrays)
+
+pytestmark = pytest.mark.obs
+
+LOSS_FN, PARAMS0, MK_OPT, BATCHES = build(steps=3)
+
+
+def _counter_sample(name, value, labels=None):
+    return {"type": "counter", "name": name, "value": float(value),
+            "labels": labels or {}}
+
+
+def _gauge_sample(name, value, labels=None):
+    return {"type": "gauge", "name": name, "value": float(value),
+            "labels": labels or {}}
+
+
+def _hist_sample(name, count, total, buckets, labels=None):
+    return {"type": "histogram", "name": name, "count": count,
+            "sum": total, "buckets": buckets, "labels": labels or {},
+            "max": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# the windowed store
+# ---------------------------------------------------------------------------
+
+def test_store_rings_are_bounded_and_windowed():
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=10.0, max_points=4, max_series=3,
+                         clock=lambda: clock[0])
+    for i in range(10):
+        clock[0] = float(i)
+        st.record("w0", [_gauge_sample("goodput.ratio", i / 10.0)])
+    # per-series ring bound: only the last max_points survive
+    pts = st.points("w0", "goodput.ratio", window_s=100.0)
+    assert len(pts) == 4 and pts[-1] == (9.0, 0.9)
+    # the read window drops old points even inside the ring
+    assert [t for t, _ in st.points("w0", "goodput.ratio", window_s=1.5)] \
+        == [8.0, 9.0]
+    # total-series bound: the 4th distinct series is dropped and counted
+    st.record("w1", [_gauge_sample("goodput.ratio", 0.5)])
+    st.record("w2", [_gauge_sample("goodput.ratio", 0.5)])
+    st.record("w3", [_gauge_sample("goodput.ratio", 0.5)])
+    assert st.n_series() == 3
+    assert st.dropped_series == 1
+    # pruning dead workers frees their series
+    assert st.prune(["w0"]) == 2
+    assert st.n_series() == 1
+
+
+def test_store_memory_bound_under_flood():
+    # the aggregator-ring memory bound guardrail: a flood of pushes can
+    # never hold more than max_points * max_series points
+    st = TimeSeriesStore(max_points=16, max_series=8, clock=lambda: 0.0)
+    for i in range(1000):
+        st.record(f"w{i % 4}", [
+            _gauge_sample("goodput.ratio", 0.5),
+            _counter_sample("trainer.steps_total", i)])
+    assert st.n_series() == 8
+    assert st.n_points() <= 16 * 8
+
+
+def test_rate_counter_delta_and_reset():
+    pts = [(0.0, 100.0), (5.0, 150.0), (10.0, 200.0)]
+    assert rate(pts) == pytest.approx(10.0)
+    # restart mid-window: the counter fell back to near zero — the rate
+    # re-bases at the newest value instead of going negative
+    assert rate([(0.0, 100.0), (10.0, 40.0)]) == pytest.approx(4.0)
+    assert rate([(0.0, 1.0)]) is None
+    assert rate([]) is None
+
+
+def test_ewma_mean_and_variance():
+    m, v = ewma([1.0, 1.0, 1.0])
+    assert m == pytest.approx(1.0) and v == pytest.approx(0.0)
+    m, _ = ewma([0.0, 1.0], alpha=0.5)
+    assert m == pytest.approx(0.5)
+    assert ewma([]) == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# derived health
+# ---------------------------------------------------------------------------
+
+def test_fleet_health_straggler_and_jitter_and_collapse():
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=100.0, clock=lambda: clock[0])
+    h = FleetHealth(clock=lambda: clock[0])
+    # 3 workers; w2's shards run 5x slower than the fleet
+    for i in range(8):
+        clock[0] += 1.0
+        for w, s in (("w0", 0.1), ("w1", 0.11), ("w2", 0.5)):
+            h.note_shard(w, s)
+        # steady heartbeats for w0/w1; w2's arrivals jitter wildly
+        h.note_heartbeat("w0")
+        h.note_heartbeat("w1")
+        h.note_heartbeat("w2", now=clock[0] + (3.0 if i % 2 else -0.4))
+        # goodput pushes: w1 collapses from 0.8 to ~0
+        st.record("w0", [_gauge_sample("goodput.ratio", 0.8)])
+        st.record("w1", [_gauge_sample("goodput.ratio",
+                                       0.8 if i < 2 else 0.02)])
+        st.record("w2", [_gauge_sample("goodput.ratio", 0.7)])
+    snap = h.snapshot(st)
+    # leave-one-out reference: w2 scores against median(w0, w1) medians
+    assert snap["w2"]["straggler_score"] == pytest.approx(0.5 / 0.105,
+                                                          rel=0.01)
+    assert snap["w2"]["straggler"] is True
+    assert snap["w0"]["straggler"] is False
+    assert snap["w2"]["heartbeat_unstable"] is True
+    assert snap["w0"]["heartbeat_unstable"] is False
+    assert snap["w1"]["goodput_collapse"] is True
+    assert snap["w0"]["goodput_collapse"] is False
+    # forget drops the departed worker's feeds (re-join starts clean)
+    h.forget("w2")
+    snap = h.snapshot(st)
+    assert snap["w2"]["straggler_score"] is None
+
+
+def test_health_step_ewma_from_histogram_deltas():
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=100.0, clock=lambda: clock[0])
+    h = FleetHealth(clock=lambda: clock[0])
+    # two snapshots of a step-time histogram: 10 steps totalling 2s, then
+    # 20 steps totalling 6s -> windowed mean (6-2)/(20-10) = 0.4
+    for count, total in ((10, 2.0), (20, 6.0)):
+        clock[0] += 1.0
+        st.record("w0", [_hist_sample("trainer.step_seconds", count, total,
+                                      [[0.5, count], ["+Inf", count]])])
+    snap = h.snapshot(st)
+    assert snap["w0"]["step_ewma"] == pytest.approx(0.4)
+
+
+# ---------------------------------------------------------------------------
+# the alert engine
+# ---------------------------------------------------------------------------
+
+def test_threshold_rule_hysteresis_fires_and_resolves():
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=100.0, clock=lambda: clock[0])
+    eng = AlertEngine([AlertRule("hot", "cluster.health_straggler_score",
+                                 kind="threshold", op=">", threshold=2.0,
+                                 for_windows=2)], st)
+    r = obs.MetricsRegistry()
+    with obs.ObsSession(registry=r).installed() as s:
+        def tick(value):
+            clock[0] += 1.0
+            st.record_value("w1", "cluster.health_straggler_score", value,
+                            labels={"worker": "w1"})
+            return eng.evaluate()
+
+        assert tick(3.0) == []            # 1st true window: pending
+        fired = tick(3.5)                 # 2nd: fires
+        assert [e["args"]["state"] for e in fired] == ["fired"]
+        assert fired[0]["args"]["worker"] == "w1"
+        assert eng.active()[0]["rule"] == "hot"
+        assert tick(4.0) == []            # still firing: no re-fire
+        assert tick(1.0) == []            # 1st false window: still firing
+        resolved = tick(1.0)              # 2nd: resolves
+        assert [e["args"]["state"] for e in resolved] == ["resolved"]
+        assert eng.active() == []
+    # transitions counted and visible in the live tracer
+    assert r.counter("alerts.fired_total").get(rule="hot") == 1
+    assert r.counter("alerts.resolved_total").get(rule="hot") == 1
+    assert r.gauge("alerts.active").get() == 0
+    names = [e["args"]["state"] for e in s.dump()["events"]
+             if e["name"] == "alert"]
+    assert names == ["fired", "resolved"]
+
+
+def test_firing_alert_resolves_when_series_vanishes():
+    # review fix: a SIGKILLed worker whose series prune out of the store
+    # must not leave a ghost active alert (or leak engine state) forever
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=100.0, clock=lambda: clock[0])
+    eng = AlertEngine([AlertRule("hot", "cluster.health_straggler_score",
+                                 kind="threshold", op=">", threshold=2.0,
+                                 for_windows=1)], st)
+    st.record_value("w1", "cluster.health_straggler_score", 5.0)
+    assert eng.evaluate()[0]["args"]["state"] == "fired"
+    assert eng.active()
+    st.prune([])                        # the worker aged out entirely
+    clock[0] += 10.0
+    out = eng.evaluate()
+    assert out[0]["args"] == {"rule": "hot", "state": "resolved",
+                              "reason": "series_gone", "worker": "w1",
+                              "value": 5.0}
+    assert eng.active() == [] and eng._state == {}
+
+
+def test_prune_keeps_health_fed_workers():
+    # review fix: elastic workers feed shard timings/heartbeats without
+    # ever obs_pushing; a pushing worker ageing out must not wipe their
+    # derived-health series
+    clock = [0.0]
+    agg = ClusterAggregator(ttl=50.0, clock=lambda: clock[0],
+                            eval_interval_s=0.0)
+    agg.push("pusher", [_gauge_sample("goodput.ratio", 0.9)])
+    agg.health.note_shard("ela0", 0.1)
+    agg.health.note_shard("ela1", 0.5)
+    agg.evaluate()
+    assert agg.history.points("ela1", "cluster.health_straggler_score",
+                              labels={"worker": "ela1"})
+    clock[0] += 100.0                   # pusher TTLs out; ela* still feed
+    agg.push("pusher2", [_gauge_sample("goodput.ratio", 0.8)])
+    assert agg.history.points("ela1", "cluster.health_straggler_score",
+                              labels={"worker": "ela1"}, window_s=1e9)
+    # once membership forgets them, the next prune drops their series
+    agg.health.forget("ela0")
+    agg.health.forget("ela1")
+    clock[0] += 100.0
+    agg.push("pusher3", [_gauge_sample("goodput.ratio", 0.8)])
+    assert agg.history.points("ela1", "cluster.health_straggler_score",
+                              labels={"worker": "ela1"},
+                              window_s=1e9) == []
+
+
+def test_absence_rule_fires_when_series_goes_quiet():
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=500.0, clock=lambda: clock[0])
+    eng = AlertEngine([AlertRule("quiet", "goodput.ratio", kind="absence",
+                                 window_s=60.0, for_windows=1)], st)
+    st.record("w0", [_gauge_sample("goodput.ratio", 0.9)])
+    clock[0] = 30.0
+    assert eng.evaluate() == []           # fresh enough
+    clock[0] = 100.0                      # 100s silent > 60s window
+    fired = eng.evaluate()
+    assert fired[0]["args"]["rule"] == "quiet"
+    assert fired[0]["args"]["silent_s"] == pytest.approx(100.0)
+    # a store that never saw the metric stays silent (no series, no rule)
+    st2 = TimeSeriesStore(clock=lambda: clock[0])
+    assert AlertEngine([AlertRule("q2", "goodput.ratio", kind="absence",
+                                  window_s=1.0)], st2).evaluate() == []
+
+
+def test_burn_rate_rule_multi_window():
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=600.0, clock=lambda: clock[0])
+    rule = AlertRule("ttft_burn", "serving.ttft_seconds", kind="burn_rate",
+                     slo_le=1.0, budget=0.1, short_s=60.0, long_s=300.0,
+                     for_windows=1)
+    eng = AlertEngine([rule], st)
+
+    def push(count, good):
+        # cumulative histogram: `good` of `count` within the 1.0s bound
+        st.record("serving", [_hist_sample(
+            "serving.ttft_seconds", count, count * 0.5,
+            [[0.5, good // 2], [1.0, good], ["+Inf", count]])])
+
+    # healthy traffic: 2% bad << 10% budget — no alert across the window
+    n = 0
+    for i in range(7):
+        clock[0] += 50.0
+        n += 100
+        push(n, int(n * 0.98))
+        assert eng.evaluate() == []
+    # regression: every new request misses the SLO -> both windows burn
+    for i in range(7):
+        clock[0] += 50.0
+        n += 100
+        push(n, int(700 * 0.98))     # good count frozen: all new are bad
+        out = eng.evaluate()
+        if out:
+            assert out[0]["args"]["rule"] == "ttft_burn"
+            assert out[0]["args"]["burn_short"] > 1.0
+            assert out[0]["args"]["burn_long"] > 1.0
+            break
+    else:
+        pytest.fail("burn-rate rule never fired on sustained SLO misses")
+
+
+def test_alert_rule_authoring_errors():
+    with pytest.raises(ValueError):
+        AlertRule("r", "m.x", kind="nope")
+    with pytest.raises(ValueError):
+        AlertRule("r", "m.x", kind="threshold")          # no threshold
+    with pytest.raises(ValueError):
+        AlertRule("r", "m.x_seconds", kind="burn_rate")  # no slo_le
+    with pytest.raises(ValueError):
+        AlertRule("r", "m.x_seconds", kind="burn_rate", slo_le=1.0,
+                  budget=2.0)
+    with pytest.raises(ValueError):
+        AlertRule("r", "m.x_seconds", kind="burn_rate", slo_le=1.0,
+                  short_s=300.0, long_s=60.0)
+    with pytest.raises(ValueError):
+        AlertRule("r", "m.x", kind="threshold", threshold=1, op="!=")
+
+
+# ---------------------------------------------------------------------------
+# L009 + catalogue cleanliness (tree-clean suite tests)
+# ---------------------------------------------------------------------------
+
+def test_l009_lint_matrix_and_shipped_rules_clean():
+    # the shipped default rule set (incl. the serving SLO burn rates) is
+    # L009-clean against the shipped catalogue
+    assert analysis.lint_alert_rules() == []
+    # and the new catalogue entries are L005-clean (satellite bar)
+    assert analysis.lint_metric_names(obs.CATALOGUE) == []
+    bad = [
+        AlertRule("r1", "nope.metric_total", kind="threshold", threshold=1),
+        AlertRule("r2", "serving.ttft_seconds", kind="threshold",
+                  threshold=1),
+        AlertRule("r3", "goodput.ratio", kind="burn_rate", slo_le=1.0),
+        AlertRule("r4", "rpc.calls_total", kind="threshold", threshold=1,
+                  labels={"bogus": "x"}),
+        # worker label is always legal: the merged-view contract
+        AlertRule("r5", "cluster.health_straggler_score", kind="threshold",
+                  threshold=2, labels={"worker": "w0"}),
+    ]
+    diags = analysis.lint_alert_rules(bad)
+    assert sorted(d.var for d in diags) == ["r1", "r2", "r3", "r4"]
+    assert all(d.code == "L009" for d in diags)
+    # engine-parameterized serving rules stay clean at any target
+    assert analysis.lint_alert_rules(
+        serving_slo_rules(0.5, 0.1, 0.05)) == []
+
+
+def test_engine_slo_rule_defaults():
+    from paddle_tpu.obs.alerts import serving_slo_rules as slo
+    rules = slo(2.0, 0.5, 0.2)
+    assert [r.metric for r in rules] == ["serving.ttft_seconds",
+                                        "serving.tpot_seconds"]
+    assert rules[0].slo_le == 2.0 and rules[1].slo_le == 0.5
+    assert all(r.kind == "burn_rate" and r.budget == 0.2 for r in rules)
+
+
+def test_add_rules_replaces_same_named_defaults():
+    # review fix: a daemon registering its engine's configured SLO
+    # targets must OVERRIDE the aggregator's same-named defaults — a
+    # silent dedupe would evaluate the operator's 0.2s SLO at the
+    # default 1.0s forever
+    clock = [0.0]
+    st = TimeSeriesStore(clock=lambda: clock[0])
+    eng = AlertEngine(default_rules(), st)
+    eng._state[("serving_ttft_slo_burn", ("serving",))] = object()
+    eng.add_rules(serving_slo_rules(0.2, 0.05, 0.01))
+    by_name = {r.name: r for r in eng.rules}
+    assert by_name["serving_ttft_slo_burn"].slo_le == 0.2
+    assert by_name["serving_tpot_slo_burn"].slo_le == 0.05
+    # no duplicate names, and the replaced rule's stale state is reset
+    assert len(by_name) == len(eng.rules)
+    assert ("serving_ttft_slo_burn", ("serving",)) not in eng._state
+
+
+def test_evicted_health_fed_worker_alert_resolves():
+    # review fix: an evicted elastic worker (fed shard timings, never
+    # obs_pushed) must not leave its straggler alert frozen as firing —
+    # membership departure reaps its history series, and the next
+    # evaluation resolves series_gone
+    clock = [0.0]
+    agg = ClusterAggregator(clock=lambda: clock[0], eval_interval_s=0.0)
+    for i in range(6):
+        clock[0] += 1.0
+        agg.health.note_shard("fast", 0.1)
+        agg.health.note_shard("slow", 1.0)
+        agg.evaluate()
+    assert any(a["rule"] == "worker_straggler" and a["worker"] == "slow"
+               for a in agg.alerts.active())
+    agg.forget_worker("slow")          # the membership eviction hook
+    clock[0] += 1.0
+    agg.evaluate()
+    assert any(e["args"]["state"] == "resolved"
+               and e["args"].get("reason") == "series_gone"
+               and e["args"]["worker"] == "slow"
+               for e in agg.alerts.recent_events())
+    assert not any(a["worker"] == "slow" for a in agg.alerts.active())
+
+
+# ---------------------------------------------------------------------------
+# aggregator integration
+# ---------------------------------------------------------------------------
+
+def test_aggregator_history_health_and_ttl_pruning():
+    clock = [0.0]
+    agg = ClusterAggregator(ttl=100.0, clock=lambda: clock[0],
+                            eval_interval_s=5.0)
+    r = obs.MetricsRegistry()
+    with obs.ObsSession(registry=r).installed():
+        for i in range(6):
+            clock[0] += 10.0
+            for w, g in (("w0", 0.8), ("w1", 0.7)):
+                agg.push(w, [_gauge_sample("goodput.ratio", g)])
+        # history recorded per push
+        assert len(agg.history.points("w0", "goodput.ratio")) == 6
+        # rate-limited evaluation ran (eval_interval < push spacing) and
+        # derived gauges landed in the live registry + back in the store
+        agg.health.note_shard("w0", 0.1)
+        agg.health.note_shard("w1", 0.3)
+        clock[0] += 10.0
+        agg.evaluate()
+        assert r.gauge("cluster.health_goodput_ewma").get(worker="w0") \
+            == pytest.approx(0.8)
+        assert agg.history.points("w1", "cluster.health_straggler_score",
+                                  labels={"worker": "w1"})
+        # TTL ageing drops the worker's snapshot AND (once membership
+        # forgot it — it is no longer health-fed) its history series
+        agg.health.forget("w1")
+        clock[0] += 200.0
+        agg.push("w0", [_gauge_sample("goodput.ratio", 0.8)])
+        assert agg.workers() == ["w0"]
+        assert agg.history.points("w1", "goodput.ratio",
+                                  window_s=1e9) == []
+
+
+# ---------------------------------------------------------------------------
+# autoscale hysteresis
+# ---------------------------------------------------------------------------
+
+def test_autoscale_hysteresis_no_flapping():
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=300.0, clock=lambda: clock[0])
+
+    def tick(todo):
+        clock[0] += 5.0
+        return autoscale_recommendation(
+            members=2, todo=todo, pending=0, history=st,
+            hysteresis_windows=3)
+
+    # a one-window backlog spike recommends HOLD (tentative join noted)
+    r = tick(20)
+    assert r["action"] == "hold" and r["tentative"] == "join"
+    assert "hysteresis" in r["reason"]
+    r = tick(0)
+    assert r["action"] == "hold" and "tentative" not in r
+    # sustained backlog commits join on the 3rd consecutive window
+    actions = [tick(20)["action"] for _ in range(3)]
+    assert actions == ["hold", "hold", "join"]
+    # members == 0 bypasses hysteresis: a dead fleet must scale NOW
+    r = autoscale_recommendation(members=0, todo=5, pending=0, history=st)
+    assert r["action"] == "join"
+    # pure-function mode (no history) unchanged: instantaneous policy
+    r = autoscale_recommendation(members=2, todo=20, pending=0)
+    assert r["action"] == "join"
+
+
+def test_autoscale_hysteresis_sparse_poller_still_scales():
+    # review fix: a scaler polling every 150s (window 300s) can never
+    # land 3 points in the window — a PERSISTENT backlog must still
+    # commit join once agreeing evaluations span >= half the window
+    clock = [0.0]
+    st = TimeSeriesStore(window_s=300.0, clock=lambda: clock[0])
+
+    def tick(todo):
+        clock[0] += 150.0
+        return autoscale_recommendation(members=1, todo=todo, pending=0,
+                                        history=st, hysteresis_windows=3)
+
+    assert tick(10)["action"] == "hold"        # single point: no span
+    assert tick(10)["action"] == "join"        # 2 points spanning 150s
+    # but a single sparse spike still never commits
+    st2 = TimeSeriesStore(window_s=300.0, clock=lambda: clock[0])
+    clock[0] += 150.0
+    r = autoscale_recommendation(members=1, todo=10, pending=0,
+                                 history=st2, hysteresis_windows=3)
+    assert r["action"] == "hold" and r["tentative"] == "join"
+
+
+# ---------------------------------------------------------------------------
+# obs serve endpoints + obs top (file mode AND live-provider mode)
+# ---------------------------------------------------------------------------
+
+def _fleet_dump():
+    return {
+        "meta": {"pid": 11, "process": "master",
+                 "clock_origin_unix": 1000.0},
+        "metrics": [
+            _gauge_sample("goodput.ratio", 0.8, {"worker": "w0"}),
+            _gauge_sample("goodput.ratio", 0.2, {"worker": "w1"}),
+            _gauge_sample("cluster.health_straggler_score", 3.2,
+                          {"worker": "w1"}),
+            _gauge_sample("serving.queue_depth", 4, {"worker": "serving"}),
+        ],
+        "events": [
+            {"kind": "instant", "name": "alert", "ts": 1.0, "tid": 0,
+             "pid": 11, "parent": None,
+             "args": {"rule": "worker_straggler", "state": "fired",
+                      "worker": "w1", "value": 3.2,
+                      "metric": "cluster.health_straggler_score",
+                      "severity": "warning"}},
+        ]}
+
+
+def _get(addr, path):
+    host, port = addr
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_obs_serve_summary_table_and_alerts_file_mode(tmp_path):
+    # file mode: a dump on disk, NO live master anywhere
+    p = str(tmp_path / "fleet.jsonl")
+    obs.write_jsonl(p, _fleet_dump())
+    srv = ObsHttpServer(lambda: obs.read_jsonl(p)).start()
+    try:
+        code, body = _get(srv.address, "/summary")
+        assert code == 200
+        assert "== fleet health ==" in body
+        row = next(ln for ln in body.splitlines() if ln.startswith("w1"))
+        assert "3.20" in row and "worker_straggler" in row
+        code, body = _get(srv.address, "/alerts")
+        assert code == 200
+        al = json.loads(body)
+        assert al["events"][0]["args"]["rule"] == "worker_straggler"
+        assert al["active"] == []        # no live engine in file mode
+    finally:
+        srv.stop()
+
+
+def test_obs_serve_alerts_live_provider_mode():
+    # master mode: the provider attaches live health + active alerts the
+    # way cmd_obs_serve's --master provider does (obs_health payload)
+    dump = _fleet_dump()
+    dump["alerts"] = [{"rule": "worker_straggler", "worker": "w1",
+                       "state": "firing", "value": 3.2, "since": 5.0,
+                       "labels": {}}]
+    dump["health"] = {"w2": {"straggler_score": 1.0,
+                             "heartbeat_jitter": 0.01,
+                             "goodput_ewma": 0.9}}
+    srv = ObsHttpServer(lambda: dump).start()
+    try:
+        code, body = _get(srv.address, "/alerts")
+        assert code == 200
+        assert json.loads(body)["active"][0]["rule"] == "worker_straggler"
+        code, body = _get(srv.address, "/summary")
+        # the derived-health worker (w2) appears even with no samples
+        assert any(ln.startswith("w2") for ln in body.splitlines())
+    finally:
+        srv.stop()
+
+
+def test_obs_top_once_cli(tmp_path, capsys):
+    from paddle_tpu.cli import main
+    p = str(tmp_path / "fleet.jsonl")
+    obs.write_jsonl(p, _fleet_dump())
+    assert main(["obs", "top", "--input", p, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "worker" in out and "straggler" in out
+    row = next(ln for ln in out.splitlines() if ln.startswith("w1"))
+    assert "worker_straggler" in row
+    # no sources -> structured usage error
+    assert main(["obs", "top"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-when-off guardrail
+# ---------------------------------------------------------------------------
+
+def test_uninstalled_plane_overhead_per_batch():
+    # the worker-side hooks this plane rides (chaos site fire, obs
+    # emitters, the shard clock) with NO plan and NO session installed:
+    # <= ~5us per batch budget, measured with 10x slack like the flight
+    # recorder's precedent (test_obs.py)
+    import time as _t
+    assert not obs.is_active() and not faults.is_active()
+
+    def per_batch(n=2000):
+        t0 = _t.perf_counter()
+        for _ in range(n):
+            faults.fire("step.grad")
+            obs.observe("cluster.shard_seconds", 0.1, worker="w")
+            obs.gauge_set("cluster.health_straggler_score", 1.0, worker="w")
+            obs.count("alerts.fired_total", rule="r")
+            _t.monotonic()
+        return (_t.perf_counter() - t0) / n
+
+    assert min(per_batch() for _ in range(3)) < 50e-6
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chaos test
+# ---------------------------------------------------------------------------
+
+def test_chaos_straggler_alert_flight_chrome_and_stable_autoscale(tmp_path):
+    """ISSUE 15 acceptance: a faults-plane ``delay`` on ONE of three
+    elastic workers' ``step.grad`` site is flagged as a straggler within
+    K evaluation windows; the alert event lands in the flight-recorder
+    dump AND the merged chrome export; and the autoscale recommendation
+    is hysteresis-stable (no join/leave flapping) across the injected
+    window. Fake clocks everywhere — the injected delay advances the
+    shared counter through FaultPlan(sleep=...), nothing really sleeps.
+    """
+    clock = [0.0]
+
+    def fake():
+        return clock[0]
+
+    def advance(s):
+        clock[0] += s
+
+    r = obs.MetricsRegistry()
+    session = obs.ObsSession(registry=r, tracer=obs.Tracer(clock=fake))
+    flight_path = str(tmp_path / "flight.jsonl")
+    # three elastic workers sharing the REAL timed shard path; w2 carries
+    # the delay plan (0.4s of fake wall time per shard, every shard)
+    workers = {w: ElasticWorker(LOSS_FN, ("127.0.0.1", 1), worker=w,
+                                clock=fake)
+               for w in ("w0", "w1", "w2")}
+    for w in workers.values():
+        import jax
+        w._params = jax.device_put(PARAMS0())
+    plan = faults.FaultPlan(sleep=advance).add(
+        "step.grad", "delay", delay_s=0.4, nth=1, count=10_000)
+    # healthy workers still take (fake) time per shard — without it the
+    # fleet median is 0 and no ratio exists; the baseline plan also
+    # proves step.grad fires on every worker's shard path
+    baseline = faults.FaultPlan(sleep=advance).add(
+        "step.grad", "delay", delay_s=0.05, nth=1, count=10_000)
+
+    em = ElasticMaster(LOSS_FN, MK_OPT(), shards_per_step=3)
+    agg = ClusterAggregator(clock=fake, eval_interval_s=0.0)
+    em.server.aggregator = agg         # fake-clock health plane
+    x, y = BATCHES[0]
+
+    with session.installed():
+        rec = obs.FlightRecorder(session, flight_path, ring_size=512).arm()
+        try:
+            for w in workers:
+                em.server._dispatch({"op": "mbr_join", "worker": w})
+            epoch = em.membership.epoch
+            actions = []
+            fired_window = None
+            for window in range(6):
+                # one elastic step per window: each worker computes one
+                # shard through the real timed path and pushes ela_grad
+                with em._cv:
+                    em._pending = (0, window)
+                    em._shard_rows = [len(x) // 3] * 3
+                    em._grads, em._losses = {}, {}
+                for shard, (name, w) in enumerate(workers.items()):
+                    payload = {"batch": _pack_arrays(
+                        [x[shard::3], y[shard::3]])}
+                    with (plan if name == "w2" else baseline).installed():
+                        loss, grads, elapsed = w._timed_grad(payload)
+                    from paddle_tpu.trainer.elastic import _pack_tree
+                    resp = em.server._dispatch({
+                        "op": "ela_grad", "worker": name,
+                        "member_token": em.membership._members[name].token,
+                        "epoch": epoch, "pass": 0, "step": window,
+                        "shard": shard, "loss": loss,
+                        "grad": _pack_tree(grads), "elapsed_s": elapsed})
+                    assert resp["ok"], resp
+                # workers' telemetry pushes + the health/alert evaluation
+                for name in workers:
+                    agg.push(name, [_gauge_sample("goodput.ratio", 0.7)])
+                advance(5.0)
+                agg.evaluate()
+                active = {a["rule"]: a["worker"]
+                          for a in agg.alerts.active()}
+                if "worker_straggler" in active and fired_window is None:
+                    fired_window = window
+                # the autoscale consumer over the SAME windowed history:
+                # inject a one-window backlog spike mid-run; the
+                # recommendation must never flap to join/leave
+                spike = 30 if window == 3 else 0
+                rec_out = autoscale_recommendation(
+                    members=3, todo=spike, pending=0,
+                    samples=agg.merged_samples(), history=agg.history,
+                    hysteresis_windows=3)
+                actions.append(rec_out["action"])
+            # 1) the delayed worker (and only it) is flagged, within K
+            # windows of the injection (rule needs for_windows=2)
+            assert fired_window is not None and fired_window <= 3
+            assert active.get("worker_straggler") == "w2"
+            assert plan.hits.get("step.grad", 0) >= 1   # the chaos fired
+            score = r.gauge("cluster.health_straggler_score").get(
+                worker="w2")
+            assert score > FleetHealth.STRAGGLER_RATIO
+            # 2) hysteresis-stable autoscale: no join/leave across the
+            # injected window despite the backlog spike
+            assert set(actions) == {"hold"}, actions
+            # 3) the alert event is in the flight dump...
+            rec.dump("test")
+        finally:
+            rec.disarm()
+        flight = obs.read_jsonl(flight_path)
+        alert_evs = [e for e in flight["events"] if e["name"] == "alert"]
+        assert any(e["args"]["rule"] == "worker_straggler"
+                   and e["args"]["worker"] == "w2"
+                   and e["args"]["state"] == "fired" for e in alert_evs)
+        # ...and in the merged chrome export (master dump + flight dump)
+        merged = obs.merge_dumps([flight, session.dump()])
+        trace = obs.chrome_trace(merged)
+        assert any(ev.get("name") == "alert"
+                   and ev.get("args", {}).get("rule") == "worker_straggler"
+                   for ev in trace["traceEvents"])
+    em.server.stop()
+    em.membership.stop()
+
+
+# ---------------------------------------------------------------------------
+# elastic integration: the real wire path feeds the health plane
+# ---------------------------------------------------------------------------
+
+def test_elastic_run_feeds_shard_timings_to_master_health():
+    """A REAL 2-worker elastic pass over the RPC plane lands worker-
+    reported shard timings in the master's health tracker and the
+    cluster.shard_seconds histogram (the straggler score's feed)."""
+    r = obs.MetricsRegistry()
+    with obs.ObsSession(registry=r).installed():
+        em = ElasticMaster(LOSS_FN, MK_OPT(), ttl=5.0, task_timeout_s=10.0,
+                           shards_per_step=4, min_workers=2).start()
+        host, port = em.address
+        stop = threading.Event()
+        ws, ts = [], []
+        for i in range(2):
+            w = ElasticWorker(LOSS_FN, (host, port), worker=f"hw{i}")
+            t = threading.Thread(target=w.run, kwargs={"stop": stop},
+                                 daemon=True)
+            t.start()
+            ws.append(w)
+            ts.append(t)
+        try:
+            em.fit(BATCHES, PARAMS0(), num_passes=1,
+                   progress_timeout=60.0)
+        finally:
+            stop.set()
+            for t in ts:
+                t.join(timeout=10)
+            em.stop()
+        snap = r.histogram("cluster.shard_seconds")
+        counts = {dict(k).get("worker"): s["count"]
+                  for k, s in snap.samples()}
+        # every shard of every step reported a timing, per worker
+        assert set(counts) == {"hw0", "hw1"}
+        assert sum(counts.values()) == len(BATCHES) * 4
+        # the graceful leave hook wiped the departed workers' health
+        # feeds (a re-join under the same name starts clean)
+        with em.server.aggregator.health._lock:
+            assert set(em.server.aggregator.health._shards) == set()

@@ -65,7 +65,13 @@ def test_lenet_learns():
 
     batches = [conv_feed(rows) for rows in batch(mnist.train(256), 32)()]
     params = model.init(jax.random.PRNGKey(0))
-    params, costs = _train(model.loss, params, batches, passes=2)
+    # lr matters here: at the _train default (Adam 1e-2) this conv stack
+    # diverges on step 2 (loss 3.5 -> 53) and settles into the uniform-
+    # prediction minimum (ln 10 ~ 2.30, ratio 0.66 > 0.6) — a
+    # deterministic FAIL on this backend, the last standing tier-1 red.
+    # 1e-3 trains stably to ratio ~0.50, so the 0.6 bar now has real
+    # margin and a red run means a genuine regression.
+    params, costs = _train(model.loss, params, batches, lr=1e-3, passes=2)
     assert costs[-1] < costs[0] * 0.6
 
 
