@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 
 class Severity(enum.IntEnum):
@@ -27,14 +27,42 @@ class Severity(enum.IntEnum):
 
 
 def op_site(block_idx: Optional[int], op_idx: Optional[int],
-            op_type: Optional[str]) -> str:
-    """Canonical location string — keep in sync with executor._trace_ops."""
+            op_type: Optional[str],
+            block_path: Optional[str] = None) -> str:
+    """Canonical location string — keep in sync with executor._trace_ops.
+
+    ``block_path`` cites the full parent chain for nested sub-blocks
+    (``block 0.2, op #5``); the root block's path is ``"0"``, so root
+    sites keep the historical ``block 0, op #I`` form verbatim."""
     if block_idx is None:
         return "program"
+    label = block_path if block_path else block_idx
     if op_idx is None:
-        return f"block {block_idx}"
+        return f"block {label}"
     t = f" ({op_type})" if op_type else ""
-    return f"block {block_idx}, op #{op_idx}{t}"
+    return f"block {label}, op #{op_idx}{t}"
+
+
+def block_paths(program) -> Dict[int, str]:
+    """Root-to-leaf parent-chain path per block: ``{0: "0", 2: "0.2",
+    5: "0.2.5"}``.  Defensive against malformed parent indices (cycles,
+    out-of-range) — the verifier reports those; this must not crash."""
+    blocks = getattr(program, "blocks", None) or []
+    out: Dict[int, str] = {}
+    for b in blocks:
+        chain = []
+        idx = b.idx
+        guard = len(blocks) + 1
+        while (isinstance(idx, int) and 0 <= idx < len(blocks)
+               and idx not in chain and guard):
+            guard -= 1
+            chain.append(idx)
+            p = blocks[idx].parent_idx
+            if not isinstance(p, int) or p < 0:
+                break
+            idx = p
+        out[b.idx] = ".".join(str(i) for i in reversed(chain))
+    return out
 
 
 @dataclass
@@ -57,9 +85,16 @@ class Diagnostic:
     # analyzed together, e.g. by the lint CLI; block/op indices alone are
     # ambiguous across programs
     program: Optional[str] = None
+    # full parent-chain path for nested sub-blocks ("0.2.5"); filled by
+    # analyze_program from block_paths() so every pass cites it for free
+    block_path: Optional[str] = None
+    # def-use chain text for the var (`lint --explain`); None unless the
+    # caller asked for explanations
+    explain: Optional[str] = None
 
     def location(self) -> str:
-        site = op_site(self.block_idx, self.op_idx, self.op_type)
+        site = op_site(self.block_idx, self.op_idx, self.op_type,
+                       block_path=self.block_path)
         return f"[{self.program}] {site}" if self.program else site
 
     def __str__(self):
@@ -68,13 +103,16 @@ class Diagnostic:
         s = " ".join(parts)
         if self.hint:
             s += f"\n    hint: {self.hint}"
+        if self.explain:
+            s += f"\n    chain: {self.explain}"
         return s
 
     def to_dict(self) -> dict:
         return {"code": self.code, "severity": str(self.severity),
                 "message": self.message, "block_idx": self.block_idx,
                 "op_idx": self.op_idx, "op_type": self.op_type,
-                "var": self.var, "hint": self.hint, "program": self.program}
+                "var": self.var, "hint": self.hint, "program": self.program,
+                "block_path": self.block_path, "explain": self.explain}
 
 
 def errors(diags: Sequence[Diagnostic]) -> List[Diagnostic]:

@@ -59,6 +59,11 @@ Lints are advisory by default (WARNING/INFO); the CLI's ``--fail-on`` and
   direction means dashboards and docs lie.  Runs over the source tree in
   the ``paddle_tpu lint`` CLI and the obs test-suite
   (:func:`lint_catalogue_drift`).
+- **L010 dead-write** (warning), **L011 donation-hazard** (error), **L012
+  alias-escape** (warning): the dataflow-backed lints — def-use chains,
+  alias roots, and the donation-safety proof from
+  :mod:`paddle_tpu.analysis.dataflow` (see :func:`_lint_dataflow` and
+  docs/design/analysis.md "Dataflow & liveness").
 """
 
 from __future__ import annotations
@@ -80,6 +85,11 @@ LINT_CATALOGUE = {
     "L007": ("catalogue-drift", Severity.WARNING),
     "L008": ("autotune-staleness", Severity.WARNING),
     "L009": ("alert-rules", Severity.WARNING),
+    # L010-L012 are dataflow-backed (analysis.dataflow): def-use chains,
+    # alias roots, and the donation-safety proof, not per-block scans
+    "L010": ("dead-write", Severity.WARNING),
+    "L011": ("donation-hazard", Severity.ERROR),
+    "L012": ("alias-escape", Severity.WARNING),
 }
 
 # control-flow / executor-lowered ops act through sub-blocks, not outputs
@@ -115,14 +125,20 @@ def lint_program(program, fetch: Iterable[str] = (),
                  mesh_axes: Optional[Sequence[str]] = None,
                  enable: Optional[Iterable[str]] = None,
                  severity_overrides: Optional[Dict[str, Severity]] = None,
+                 feed: Iterable[str] = (),
+                 donate: Optional[bool] = None,
                  diags: Optional[List[Diagnostic]] = None) -> List[Diagnostic]:
     """Run the lint catalogue; returns the diagnostic list.
 
-    ``fetch`` — names the caller will fetch (liveness roots for L001/L002).
-    ``mesh_axes`` — valid sharding axis names; defaults to
-    ``parallel.mesh.CANONICAL_ORDER``.  ``enable`` — subset of lint IDs to
-    run (default: all).  ``severity_overrides`` — e.g. promote
+    ``fetch`` — names the caller will fetch (liveness roots for L001/L002,
+    donation exclusions for L011).  ``feed`` — names the caller feeds
+    (donation exclusions).  ``mesh_axes`` — valid sharding axis names;
+    defaults to ``parallel.mesh.CANONICAL_ORDER``.  ``enable`` — subset of
+    lint IDs to run (default: all).  ``severity_overrides`` — e.g. promote
     ``{"L001": Severity.ERROR}`` to make dead ops hard failures.
+    ``donate`` — the executor's donation switch: ``True`` makes L011 an
+    ERROR (the run WILL donate hazardous buffers), ``None`` (static /CLI
+    context) demotes it to an advisory WARNING, ``False`` skips it.
     """
     diags = [] if diags is None else diags
     enabled = set(enable) if enable is not None else set(LINT_CATALOGUE)
@@ -148,7 +164,85 @@ def lint_program(program, fetch: Iterable[str] = (),
         _lint_trace_safety(program, emit)
     if "L004" in enabled:
         _lint_sharding(program, mesh_axes, emit)
+    if enabled & {"L010", "L011", "L012"}:
+        _lint_dataflow(program, fetch, set(feed), donate, enabled, emit)
     return diags
+
+
+def _lint_dataflow(program, fetch, feed, donate, enabled, emit):
+    """The dataflow-backed lints (analysis.dataflow consumers).
+
+    - **L010 dead-write**: a Def with zero recorded Uses that a later Def
+      of the same name kills before the end of the program.  Same-block
+      linear kills are V003's domain (an ERROR there) and skipped here;
+      L010 owns the cross-block cases V003's per-block pending scan cannot
+      see (a sub-block write overwritten after the loop, a branch write
+      overwritten by the parent).
+    - **L011 donation-hazard**: :func:`analysis.dataflow.donation_hazards`
+      found a donated persistable whose entry value may be read after its
+      overwrite — an ERROR when ``donate=True`` (the run corrupts), an
+      advisory WARNING in static/CLI context (``donate=None``), skipped
+      when donation is off.
+    - **L012 alias-escape**: a sub-block op writes a name that aliases an
+      outer-scope var (through assign/reshape/... view roots) while the
+      base var itself is never updated in that control region: the write
+      rebinds only the view name — under the reference's shared-buffer
+      semantics the base would change, under traced semantics it silently
+      does not.
+    """
+    from . import dataflow as D
+    df = D.analyze_dataflow(program, feed=feed, fetch=fetch)
+    paths = df.block_paths
+
+    if "L010" in enabled:
+        for d in df.defs:
+            if d.kind != "op" or d.uses or d.name in fetch:
+                continue
+            if d in df.final_env.get(d.name, ()):
+                continue          # reaches the end: fetchable/synced, live
+            killers = sorted((k for k in df.defs
+                              if k.name == d.name and k.kind == "op"
+                              and k.pos > d.pos), key=lambda k: k.pos)
+            if not killers:
+                continue          # never overwritten: L001's dead-op case
+            if killers[0].block_idx == d.block_idx:
+                continue          # same-block linear kill: V003's ERROR
+            emit("L010",
+                 f"dead write: '{d.name}' written here is overwritten at "
+                 f"{killers[0].site(paths)} before any read",
+                 block_idx=d.block_idx, op_idx=d.op_idx, op_type=d.op_type,
+                 var=d.name,
+                 hint="read the value before the overwrite, or drop the "
+                      "first write — the traced computation discards it")
+
+    if "L011" in enabled and donate is not False:
+        sev = (LINT_CATALOGUE["L011"][1] if donate
+               else Severity.WARNING)
+        for hz in D.donation_hazards(program, feed=feed, fetch=fetch, df=df):
+            first_ow = hz.overwrites[0]
+            qualifier = ("" if donate else
+                         " (advisory: hazardous if run with donate=True, "
+                         "the Executor default)")
+            emit("L011", hz.describe(paths) + qualifier, severity=sev,
+                 block_idx=first_ow.block_idx, op_idx=first_ow.op_idx,
+                 op_type=first_ow.op_type, var=hz.name,
+                 hint="move the read before the update, fetch the var "
+                      "(fetched persistables are never donated), or run "
+                      "with donate=False; the Executor auto-downgrades "
+                      "this var's donation when verify is off")
+
+    if "L012" in enabled:
+        for esc in df.alias_escapes:
+            emit("L012",
+                 f"sub-block write to '{esc['name']}' only rebinds a view "
+                 f"of outer var '{esc['base']}' (aliased at "
+                 f"{esc['view_def'].site(paths)}); the base var is never "
+                 "updated in this control region",
+                 block_idx=esc["block_idx"], op_idx=esc["op_idx"],
+                 op_type=esc["op_type"], var=esc["name"],
+                 hint=f"write '{esc['base']}' itself (sub-block writes "
+                      "propagate by name through the loop carry), or use "
+                      "a fresh local name for the rebound value")
 
 
 def _lint_dead_ops(program, reads, fetch, persistables, emit):

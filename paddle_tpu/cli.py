@@ -377,9 +377,19 @@ def cmd_lint(args):
     BEFORE any trace/compile, and reports the advisory lint catalogue
     (dead ops, unused vars, trace-safety, sharding consistency).
 
-    Exit code: 0 clean (below the --fail-on threshold), 1 findings at or
-    above it, 2 usage errors (missing/broken config).  --json emits
-    machine-readable diagnostics on a pure-JSON stdout.
+    Exit-code contract (stable, scripts may rely on it):
+      0 — clean: no finding at or above the --fail-on threshold
+      1 — findings at or above the threshold (or invalid bench rows)
+      2 — usage error: missing/broken config or unreadable inputs
+
+    ``--format=json`` emits the stable machine schema on a pure-JSON
+    stdout: ``{"version": 1, "findings": [{code, severity, message,
+    hint, explain, site: {program, block, block_path, op, op_type,
+    var}}], "summary": {errors, warnings, info, total}}`` (human
+    summary goes to stderr).  The legacy ``--json`` flat list of
+    Diagnostic dicts is kept for old pipelines.  ``--explain``
+    annotates each finding's variable with its def-use chain from the
+    dataflow plane (where it is defined, redefined, and last read).
 
     ``--bench-rows FILE...`` additionally (or, without --config, ONLY)
     validates saved bench rows — JSON or JSONL of bench.py output lines —
@@ -389,7 +399,9 @@ def cmd_lint(args):
     data."""
     from . import analysis, fluid
     if args.bench_rows and args.config is None:
-        rc = _lint_bench_rows(args.bench_rows, as_json=args.json)
+        rc = _lint_bench_rows(args.bench_rows,
+                              as_json=args.json or
+                              getattr(args, "format", "text") == "json")
         if getattr(args, "autotune_cache", None):
             rc = max(rc, _lint_autotune_only(args))
         return rc
@@ -423,11 +435,23 @@ def cmd_lint(args):
     all_diags = []
     for label, prog in (("main", fluid.default_main_program()),
                         ("startup", fluid.default_startup_program())):
-        diags = analysis.analyze_program(
-            prog, fetch=fetch if label == "main" else [],
-            mesh_axes=mesh_axes)
+        prog_fetch = fetch if label == "main" else []
+        diags = analysis.analyze_program(prog, fetch=prog_fetch,
+                                         mesh_axes=mesh_axes)
         for d in diags:
             d.program = label
+        if getattr(args, "explain", False) and any(d.var for d in diags):
+            # --explain: cite each flagged var's def-use chain so the
+            # reader sees WHY (where defined/redefined/last read), not
+            # just WHERE.  Dataflow may legitimately fail on programs
+            # with structural errors — the findings still stand alone.
+            try:
+                df = analysis.analyze_dataflow(prog, fetch=prog_fetch)
+                for d in diags:
+                    if d.var:
+                        d.explain = analysis.explain_var(df, d.var)
+            except Exception:
+                pass
         all_diags.extend(diags)
     # L005: the obs metric catalogue is part of the lint surface — a PR
     # adding an off-contract metric name fails here, not on a dashboard
@@ -457,8 +481,16 @@ def cmd_lint(args):
                f"{len(all_diags) - n_err - n_warn} info over "
                f"{sum(len(b.ops) for b in fluid.default_main_program().blocks)} "
                "main-program op(s)")
-    if args.json:
-        # stdout stays pure JSON so `lint --json | jq` works
+    as_json = args.json or getattr(args, "format", "text") == "json"
+    if getattr(args, "format", "text") == "json":
+        # the STABLE machine schema (version-gated; see docstring) —
+        # stdout stays pure JSON so `lint --format=json | jq` works
+        print(json.dumps(_lint_json_payload(all_diags, n_err, n_warn),
+                         indent=1, sort_keys=True))
+        print(summary, file=sys.stderr)
+    elif args.json:
+        # legacy flat list of Diagnostic dicts, kept verbatim for old
+        # pipelines; new tooling should use --format=json
         print(json.dumps([d.to_dict() for d in all_diags], indent=1))
         print(summary, file=sys.stderr)
     else:
@@ -467,13 +499,40 @@ def cmd_lint(args):
         print(summary)
     failed = any(d.severity >= threshold for d in all_diags)
     if args.bench_rows:
-        # under --json, bench-row findings go to STDERR so stdout stays
-        # the pure diagnostics JSON (`lint --json | jq` contract)
+        # under either json mode, bench-row findings go to STDERR so
+        # stdout stays the pure diagnostics JSON (`| jq` contract)
         rc = _lint_bench_rows(args.bench_rows,
-                              stream=sys.stderr if args.json
+                              stream=sys.stderr if as_json
                               else sys.stdout)
         failed = failed or rc != 0
     return 1 if failed else 0
+
+
+def _lint_json_payload(diags, n_err: int, n_warn: int) -> dict:
+    """The ``lint --format=json`` schema.  STABLE: additions only, and a
+    shape change bumps ``version``.  Every finding has every key (null
+    when absent) so consumers can index without guards."""
+    return {
+        "version": 1,
+        "findings": [{
+            "code": d.code,
+            "severity": str(d.severity),
+            "message": d.message,
+            "hint": d.hint,
+            "explain": d.explain,
+            "site": {
+                "program": d.program,
+                "block": d.block_idx,
+                "block_path": d.block_path,
+                "op": d.op_idx,
+                "op_type": d.op_type,
+                "var": d.var,
+            },
+        } for d in diags],
+        "summary": {"errors": n_err, "warnings": n_warn,
+                    "info": len(diags) - n_err - n_warn,
+                    "total": len(diags)},
+    }
 
 
 def _lint_autotune_only(args) -> int:
@@ -1519,7 +1578,17 @@ def main(argv=None) -> int:
                     default="error", dest="fail_on",
                     help="lowest severity that makes the exit code nonzero")
     lt.add_argument("--json", action="store_true",
-                    help="emit diagnostics as JSON")
+                    help="emit diagnostics as a flat JSON list (legacy; "
+                         "prefer --format=json)")
+    lt.add_argument("--format", choices=["text", "json"], default="text",
+                    help="output format; json emits the stable schema "
+                         "{version, findings[], summary} on pure stdout "
+                         "(exit codes: 0 clean, 1 findings at/above "
+                         "--fail-on, 2 usage error)")
+    lt.add_argument("--explain", action="store_true",
+                    help="annotate each finding's variable with its "
+                         "def-use chain (defined / redefined / last "
+                         "read sites) from the dataflow plane")
     lt.add_argument("--mesh-axes", default=None, dest="mesh_axes",
                     help="comma-separated valid sharding axis names "
                          "(default: parallel.mesh.CANONICAL_ORDER, with "

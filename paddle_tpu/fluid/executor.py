@@ -149,8 +149,16 @@ def _trace_ops(ops, env: Dict[str, Any], ctx: TraceContext):
             chain = " -> ".join(o.type for o in ops[max(0, idx - 4):idx + 1])
             # one source of truth for the location format so a runtime
             # failure and the static diagnostic for an op cite the same site
-            from ..analysis.diagnostics import op_site
-            site = op_site(getattr(op.block, "idx", None), idx, op.type)
+            from ..analysis.diagnostics import block_paths, op_site
+            blk = getattr(op, "block", None)
+            bidx = getattr(blk, "idx", None)
+            path = None
+            prog = getattr(blk, "program", None)
+            if prog is not None and bidx is not None:
+                # nested sub-block failures cite the full parent chain
+                # ("block 0.2, op #5") — same format as lint diagnostics
+                path = block_paths(prog).get(bidx)
+            site = op_site(bidx, idx, op.type, block_path=path)
             msg = (f"{site} failed while tracing the Program "
                    f"(inputs={op.inputs}, outputs={op.outputs})\n"
                    f"  op chain: ...{chain}")
@@ -517,6 +525,11 @@ class Executor:
         self._miss_streaks: Dict[Tuple, int] = {}
         self._seen_keys: set = set()
         self._churn_warned = False
+        # L011 donation-safety: statically-proven-hazardous persistables
+        # per (program serial, version) — their donation is downgraded to
+        # keep (see _run), warned once per program
+        self._hazard_memo: Dict[Tuple, frozenset] = {}
+        self._hazard_warned: set = set()
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -703,19 +716,25 @@ class Executor:
         if "__step__" in block.vars and "__step__" not in feed:
             feed["__step__"] = jnp.asarray(self._step, jnp.int32)
             self._step += 1
+        donate = self.donate if donate is None else donate
         if verify:
             # static pre-flight: reject malformed programs with precise
             # Diagnostics BEFORE burning a trace/compile (analysis subpackage).
             # Memoized like the compiled-fn cache so a training loop pays the
             # analysis once per (program version, feed signature), not per step.
+            # The donation switch rides along: with donate on, a provable
+            # read-after-donate hazard (L011) is an ERROR this pre-flight
+            # refuses instead of letting the run consume a donated buffer.
             from .. import analysis
             vkey = (program._serial, program.version, tuple(fetch_names),
+                    bool(donate),
                     tuple((k, v.shape, str(v.dtype))
                           for k, v in sorted(feed.items())))
             if vkey not in self._verified:
                 with obs.span("fluid.verify", metric="fluid.verify_seconds"):
                     analysis.check_or_raise(program, feed=feed,
-                                            fetch=fetch_names)
+                                            fetch=fetch_names,
+                                            donate=bool(donate))
                 self._verified.add(vkey)
 
         # vars the block reads from the scope (persistables created earlier)
@@ -748,11 +767,34 @@ class Executor:
         # donated to XLA (updated in place) UNLESS the same run also
         # fetches it — that needs the old buffer readable (fed persistables
         # never reach persist_in at all; the fed value wins)
-        donate = self.donate if donate is None else donate
         written_set, fetch_set = set(written), set(fetch_names)
         donated_in = [n for n in persist_in
                       if donate and n in written_set
                       and n not in fetch_set]
+        # L011 donation-safety: a persistable whose pre-update value may
+        # still be read after its overwrite (proved by the dataflow plane)
+        # is downgraded to keep instead of donated — correctness beats the
+        # buffer reuse.  verify=True already refused such programs above;
+        # this protects verify=False runs.  Memoized per program version.
+        if donated_in:
+            hkey = (program._serial, program.version)
+            hz = self._hazard_memo.get(hkey)
+            if hz is None:
+                from ..analysis.dataflow import donation_hazards
+                hz = frozenset(h.name for h in donation_hazards(
+                    program, feed=feed, fetch=fetch_names))
+                self._hazard_memo[hkey] = hz
+            hazardous = [n for n in donated_in if n in hz]
+            if hazardous:
+                donated_in = [n for n in donated_in if n not in hz]
+                if hkey not in self._hazard_warned:
+                    self._hazard_warned.add(hkey)
+                    warnings.warn(
+                        "L011 donation-hazard: persistable(s) "
+                        f"{sorted(hazardous)} may be read after their "
+                        "in-place update; donation downgraded to keep for "
+                        "them (run with verify=True for the full def-use "
+                        "chain)", RuntimeWarning, stacklevel=3)
         donated_set = set(donated_in)
         kept_in = [n for n in persist_in if n not in donated_set]
 

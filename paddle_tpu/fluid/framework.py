@@ -15,6 +15,7 @@ protobuf round-trip.
 from __future__ import annotations
 
 import contextlib
+import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -263,6 +264,13 @@ def reset_default_programs():
     global _main_program, _startup_program
     _main_program = Program()
     _startup_program = Program()
+    # Also rewind the layers seed counter: initializer seeds are minted
+    # from a process-global stream, so without this a program's weight
+    # draws depend on how many layers the process built before it —
+    # programs built after a reset would not be reproducible.
+    _layers = sys.modules.get(__package__ + ".layers")
+    if _layers is not None:
+        _layers._seed_counter[0] = 0
 
 
 @contextlib.contextmanager

@@ -167,6 +167,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "obs: observability-plane tests (tests/test_obs.py); "
         "tier-1, fake clocks, no real sleeps")
+    config.addinivalue_line(
+        "markers", "perf: wall-clock budget tests (generous bounds; "
+        "override via PADDLE_TPU_VERIFY_BUDGET_S)")
 
 
 @pytest.fixture

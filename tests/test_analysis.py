@@ -491,3 +491,46 @@ def test_diagnostic_location_matches_runtime_provenance():
                               fetch=[bad.name])
     errs = [d for d in A.errors(diags) if d.code == "S001"]
     assert errs and errs[0].block_idx == 0 and errs[0].op_type == "concat"
+
+def test_structural_diags_in_sub_blocks_carry_block_path():
+    """Every pass's diagnostics cite nested sub-blocks by the full parent
+    chain — analyze_program fills block_path from diagnostics.block_paths
+    for V0xx/S0xx findings too, not only the dataflow lints."""
+    i = layers.fill_constant(shape=(), dtype="int32", value=0)
+    n = layers.fill_constant(shape=(), dtype="int32", value=2)
+    cond = layers.less_than(i, n)
+    with fluid.While(cond).block():
+        b = fluid.default_main_program().current_block()
+        out = b.create_var(shape=(4,), dtype="float32")
+        b.append_op("elementwise_add", {"X": ["ghost"], "Y": ["ghost"]},
+                    {"Out": [out.name]})
+        layers.increment(i)
+        layers.less_than(i, n, cond=cond)
+    diags = A.analyze_program(fluid.default_main_program())
+    errs = [d for d in A.errors(diags) if d.block_idx not in (None, 0)]
+    assert errs, A.format_diagnostics(diags)
+    assert all(d.block_path and d.block_path.startswith("0.")
+               for d in errs), A.format_diagnostics(errs)
+    assert any("block 0.1" in d.location() for d in errs)
+
+
+def test_legacy_json_carries_new_fields_backward_compatibly(tmp_path,
+                                                            capsys):
+    """The legacy --json flat list keeps its shape; the Diagnostic dict
+    simply grew block_path/explain keys (None when unset)."""
+    import json
+    from paddle_tpu import cli
+    cfg = tmp_path / "ok.py"
+    cfg.write_text(
+        "import paddle_tpu.fluid as fluid\n"
+        "from paddle_tpu.fluid import layers\n"
+        "x = layers.data('x', shape=(4,))\n"
+        "unused = layers.data('unused', shape=(4,))\n"
+        "cost = layers.mean(x)\n")
+    rc = cli.main(["lint", "--config", str(cfg), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert isinstance(payload, list) and payload
+    for d in payload:
+        assert "block_path" in d and "explain" in d
+        assert d["program"] in ("main", "startup")
