@@ -304,6 +304,11 @@ def main(full: bool = False):
                  ".run_paged()", ROW_TIMEOUT))
     rows.append(("__import__('benchmarks.serving_daemon', fromlist=['x'])"
                  ".run()", ROW_TIMEOUT))
+    # the disaggregation row (ROADMAP item 2): 1 prefill + 2 decode pools
+    # behind the serving router — client-measured SLOs over the real
+    # wire, the ship/adopt hop priced into TTFT
+    rows.append(("__import__('benchmarks.serving_router', fromlist=['x'])"
+                 ".run()", ROW_TIMEOUT))
     # the prefix-cache rows (ROADMAP item 2): zipf shared-prefix workload
     # warm-vs-cold — TTFT p50 and prefill FLOPs/token vs hit rate
     rows.append(("__import__('benchmarks.serving_prefix', fromlist=['x'])"

@@ -1,0 +1,116 @@
+"""Routed disaggregated-serving row — 1 prefill + 2 decode pools.
+
+The serving_daemon row measures one engine behind one daemon; this row
+measures the DISAGGREGATED fleet: a ServingRouter fronting one prefill
+worker (admits + ships KV pages, serving/ship.py) and two decode
+workers, all joined in the router's membership table. Requests go
+through route_submit (health-trend placement + the prefill->ship->adopt
+hop), tokens stream back through route_poll. TTFT/TPOT are measured
+CLIENT-side over the real wire — the ship hop's cost is IN the TTFT,
+which is the honest number for disaggregation. The ``_route_`` bench-row
+family rule (analysis/bench_schema.py) makes the SLO pair plus
+``n_decode_workers`` mandatory for rows like this one.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .serving_daemon import _pct
+from .serving_decode import VOCAB, build
+
+
+def run(n_requests: int = 32, slots: int = 8, segment: int = 32) -> dict:
+    from paddle_tpu.serving import (PagePool, PrefillDaemon, RouterClient,
+                                    ServingDaemon, ServingEngine,
+                                    ServingRouter)
+
+    model, p16, _ = build(slots)
+    rs = np.random.RandomState(0)
+    workload = [(rs.randint(0, VOCAB, int(rs.randint(32, 257))),
+                 int(rs.randint(32, 257))) for _ in range(n_requests)]
+
+    router = ServingRouter(scrape_interval_s=0.1).start()
+    daemons = []
+    try:
+        for i in range(2):
+            eng = ServingEngine(model, p16, slots=slots, segment=segment,
+                                page_block=64, cache_bucket=512,
+                                prompt_buckets=(256,),
+                                queue_cap=max(2 * n_requests, 64))
+            d = ServingDaemon(eng).start()
+            d.join_router(router.address, f"decode-{i}", role="decode")
+            daemons.append(d)
+        pool = PagePool(model, p16, slots=4, segment=segment,
+                        page_block=64, cache_bucket=512,
+                        prompt_buckets=(256,))
+        pd = PrefillDaemon(pool).start()
+        pd.join_router(router.address, "prefill-0", role="prefill")
+        daemons.append(pd)
+
+        client = RouterClient(*router.address, call_timeout=120.0)
+        # warm every compiled program on BOTH decode pools and the
+        # prefill pool before timing — a long-lived fleet serves warm
+        warm = [client.submit(rs.randint(0, VOCAB, 256), 256)
+                for _ in range(2 * slots)]
+        for rid in warm:
+            while not client.poll(rid)[1]:
+                time.sleep(0.05)
+
+        t0 = time.perf_counter()
+        t_submit, t_first, t_done, counts = {}, {}, {}, {}
+        pending = []
+        for i, (prompt, gen) in enumerate(workload):
+            t_submit[i] = time.perf_counter()
+            pending.append((i, client.submit_with_backoff(prompt, gen)))
+        cursors = {i: 0 for i, _ in pending}
+        while pending:
+            for i, rid in list(pending):
+                toks, done, _ = client.poll(rid, cursors[i])
+                now = time.perf_counter()
+                if toks and i not in t_first:
+                    t_first[i] = now
+                cursors[i] += len(toks)
+                if done:
+                    t_done[i], counts[i] = now, cursors[i]
+                    pending.remove((i, rid))
+            time.sleep(0.01)
+        dt = time.perf_counter() - t0
+        stats = client.serving_stats()
+    finally:
+        for d in daemons:
+            d.stop()
+        router.stop()
+
+    delivered = sum(counts.values())
+    ttft = [(t_first[i] - t_submit[i]) * 1e3 for i in t_first]
+    tpot = [(t_done[i] - t_first[i]) / (counts[i] - 1) * 1e3
+            for i in t_done if counts[i] > 1 and i in t_first]
+    return {"metric": f"transformer_lm_route_disagg_tokens_per_sec_"
+                      f"1p2d_slots{slots}_seg{segment}_mixed32-256",
+            "value": round(delivered / dt, 1), "unit": "tokens/sec",
+            "vs_baseline": None,
+            "requests": n_requests, "delivered_tokens": delivered,
+            "n_decode_workers": int(stats.get("n_decode_workers", 2)),
+            "ttft_p50_ms": round(_pct(ttft, 50), 1),
+            "ttft_p95_ms": round(_pct(ttft, 95), 1),
+            "tpot_p50_ms": round(_pct(tpot, 50), 2),
+            "tpot_p95_ms": round(_pct(tpot, 95), 2),
+            "methodology": "measured",    # client-clock SLOs, real wire
+            "note": "disaggregated fleet over the native RPC plane: "
+                    "route_submit -> health-trend placement -> prefill "
+                    "worker admits + ships KV pages -> decode worker "
+                    "adopts and streams; TTFT counts the ship/adopt hop, "
+                    "TPOT the segment-paced cadence after the first "
+                    "token; client-measured over the wire"}
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    print(json.dumps(run()), flush=True)

@@ -27,7 +27,11 @@ Family rules key on the metric NAME, which is itself part of the contract
 * ``*_prefix_*`` rows additionally: ``hit_rate`` — a prefix-cache row
   whose speedup is not conditioned on its measured hit rate is
   unreproducible (a serve+prefix metric name matches BOTH families, so
-  the SLO pair stays mandatory too; benchmarks/serving_prefix.py).
+  the SLO pair stays mandatory too; benchmarks/serving_prefix.py);
+* ``*_route_*`` rows: the SLO pair PLUS ``n_decode_workers`` — a routed
+  serving number is meaningless without the fleet size it was spread
+  over (1 prefill + 2 decode pools is not comparable to a solo daemon;
+  benchmarks/serving_router.py).
 """
 
 from __future__ import annotations
@@ -48,6 +52,7 @@ FAMILY_REQUIRED = {
     "_decode_": ("hbm_bw_util", "methodology", "plan_source"),
     "_serve_": ("ttft_p50_ms", "tpot_p50_ms", "methodology"),
     "_prefix_": ("hit_rate",),
+    "_route_": ("ttft_p50_ms", "tpot_p50_ms", "n_decode_workers"),
 }
 
 #: the only legal methodology stamps

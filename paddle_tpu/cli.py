@@ -1414,6 +1414,18 @@ def cmd_serve(args):
     flight = None
     if args.obs_out:
         flight = _obs.FlightRecorder(session, args.obs_out).arm()
+    if args.role == "prefill":
+        # a prefill-only worker (disaggregated serving): pool + ship, no
+        # decode scheduler — it MUST join a router to be useful
+        if not args.router:
+            if flight is not None:
+                flight.disarm()
+            session.uninstall()
+            print("serve: --role prefill requires --router HOST:PORT "
+                  "(a prefill worker only receives work via the router)",
+                  file=sys.stderr)
+            return 2
+        return _serve_prefill(args, model, params, session, flight)
     try:
         engine = ServingEngine(
             model, params, slots=args.slots, segment=args.segment,
@@ -1450,6 +1462,20 @@ def cmd_serve(args):
         return 2
     host, port = daemon.address
     print(f"SERVING {host} {port}", flush=True)
+    if args.router:
+        try:
+            epoch = daemon.join_router(
+                _parse_hostport(args.router),
+                args.worker or f"serve-{port}", role="decode")
+        except Exception as e:
+            daemon.stop()
+            if flight is not None:
+                flight.disarm()
+            session.uninstall()
+            print(f"serve: cannot join router {args.router}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"JOINED {args.router} epoch {epoch}", flush=True)
     print(f"  slots={args.slots} segment={args.segment} "
           f"page_block={engine.pool.bs} "
           f"pages={engine.pool.pages} queue_cap={args.queue_cap} "
@@ -1473,6 +1499,139 @@ def cmd_serve(args):
         daemon.stop(drain_s=args.drain)
         if flight is not None:
             flight.disarm()
+        session.uninstall()
+        if args.obs_out:
+            try:
+                session.save(args.obs_out)
+                print(f"observability dump written to {args.obs_out}",
+                      flush=True)
+            except Exception as e:
+                print(f"warning: could not write obs dump: {e}",
+                      file=sys.stderr)
+    return 0
+
+
+def _parse_hostport(s: str):
+    host, _, port = str(s).rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"expected HOST:PORT, got {s!r}")
+    return host, int(port)
+
+
+def _serve_prefill(args, model, params, session, flight):
+    """The ``--role prefill`` half of cmd_serve: a pool-only worker that
+    admits+exports KV pages and ships them to the router-chosen decode
+    worker (serving/daemon.py PrefillDaemon)."""
+    import signal
+    import threading
+
+    from .serving import PagePool, PrefillDaemon
+
+    def _teardown():
+        if flight is not None:
+            flight.disarm()
+        session.uninstall()
+
+    try:
+        pool = PagePool(model, params, slots=args.slots,
+                        segment=args.segment, page_block=args.page_block,
+                        pages=args.pages, cache_bucket=args.cache_bucket,
+                        kv_dtype=args.kv_dtype,
+                        prefix_cache=not args.no_prefix_cache)
+    except ValueError as e:
+        _teardown()
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+    try:
+        daemon = PrefillDaemon(pool, args.host, args.port).start()
+    except OSError as e:
+        _teardown()
+        print(f"serve: cannot bind {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 2
+    host, port = daemon.address
+    print(f"SERVING {host} {port}", flush=True)
+    try:
+        epoch = daemon.join_router(_parse_hostport(args.router),
+                                   args.worker or f"prefill-{port}",
+                                   role="prefill")
+    except Exception as e:
+        daemon.stop()
+        _teardown()
+        print(f"serve: cannot join router {args.router}: {e}",
+              file=sys.stderr)
+        return 2
+    print(f"JOINED {args.router} epoch {epoch}", flush=True)
+    print(f"  role=prefill slots={args.slots} page_block={pool.bs} "
+          f"pages={pool.pages} "
+          f"prefix_cache={'off' if args.no_prefix_cache else 'on'}"
+          + (f" kv_dtype={args.kv_dtype}" if args.kv_dtype else ""),
+          flush=True)
+    stop = threading.Event()
+
+    def _on_term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        daemon.stop()
+        _teardown()
+        if args.obs_out:
+            try:
+                session.save(args.obs_out)
+                print(f"observability dump written to {args.obs_out}",
+                      flush=True)
+            except Exception as e:
+                print(f"warning: could not write obs dump: {e}",
+                      file=sys.stderr)
+    return 0
+
+
+def cmd_route(args):
+    """``paddle_tpu route`` — the serving router daemon: model-free
+    placement over a membership table of prefill/decode serving workers
+    (docs/design/serving.md "Disaggregation & routing"). Workers join
+    with ``paddle_tpu serve --router HOST:PORT --role decode|prefill``;
+    clients point :class:`paddle_tpu.serving.RouterClient` here.
+
+    The address line ``ROUTER <host> <port>`` prints first and flushed
+    (machine-parseable, the ``SERVING``/``MASTER`` contract)."""
+    import signal
+    import threading
+
+    from . import obs as _obs
+    from .serving import ServingRouter
+
+    session = _obs.ObsSession().install()
+    try:
+        router = ServingRouter(args.host, args.port, ttl=args.ttl,
+                               scrape_interval_s=args.scrape_interval
+                               ).start()
+    except OSError as e:
+        session.uninstall()
+        print(f"route: cannot bind {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 2
+    host, port = router.address
+    print(f"ROUTER {host} {port}", flush=True)
+    print(f"  ttl={args.ttl:g} scrape_interval={args.scrape_interval:g}",
+          flush=True)
+    stop = threading.Event()
+
+    def _on_term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        router.stop()
         session.uninstall()
         if args.obs_out:
             try:
@@ -1824,7 +1983,37 @@ def main(argv=None) -> int:
                     "clients collect them) on SIGTERM before severing "
                     "connections; 0 = stop immediately")
     sv.add_argument("--obs_out", default=None)
+    sv.add_argument("--router", default=None, metavar="HOST:PORT",
+                    help="join this serving router's membership table "
+                    "(paddle_tpu route); the router then places client "
+                    "submits here by windowed health trends")
+    sv.add_argument("--role", choices=["decode", "prefill"],
+                    default="decode",
+                    help="decode (default): the full engine; prefill: a "
+                    "pool-only worker that admits prompts, exports the "
+                    "KV pages and ships them to the router-chosen "
+                    "decode worker (requires --router)")
+    sv.add_argument("--worker", default=None,
+                    help="membership worker name (default: "
+                    "serve-<port> / prefill-<port>)")
     sv.set_defaults(fn=cmd_serve)
+
+    rt = sub.add_parser("route", help="serving router: model-free "
+                        "placement over joined prefill/decode serving "
+                        "workers — health-trend spread, backpressure "
+                        "aggregation, re-route on eviction "
+                        "(docs/design/serving.md)")
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument("--port", type=int, default=0)
+    rt.add_argument("--ttl", type=float, default=3.0,
+                    help="membership lease TTL (seconds); workers "
+                    "heartbeat at ttl/3 and are evicted — their streams "
+                    "re-routed — after ttl without one")
+    rt.add_argument("--scrape_interval", type=float, default=0.25,
+                    help="seconds between srv_stats health scrapes (the "
+                    "windowed trend data placement scores read)")
+    rt.add_argument("--obs_out", default=None)
+    rt.set_defaults(fn=cmd_route)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=cmd_version)

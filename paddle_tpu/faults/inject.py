@@ -19,6 +19,16 @@ site                      where it fires
 ``step.grad``             per train-step loss produced (trainer/trainer.py)
                           and per elastic shard gradient (trainer/elastic.py)
 ``mbr.heartbeat``         per membership heartbeat sent (runtime/membership.py)
+``srv.ship``              per KV-page chunk serialized for shipping
+                          (serving/ship.py — corrupt/truncate mangle the raw
+                          chunk bytes AFTER the CRC was stamped, so the
+                          receiver detects the damage and refuses structured)
+``srv.adopt``             per shipped-slot adoption attempted on a decode
+                          worker (serving/daemon.py srv_adopt_pages)
+``route.submit``          per submit forwarded by the serving router
+                          (serving/router.py — raise models a worker hop
+                          dying mid-placement; the router retries the next
+                          candidate)
 ========================  =====================================================
 
 ``step.grad`` caveat: the hook filters the HOST-observed loss value after
@@ -61,7 +71,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from .. import obs
 
 SITES = ("ckpt.write", "rpc.send", "rpc.recv", "lease.renew",
-         "reader.next", "step.grad", "mbr.heartbeat")
+         "reader.next", "step.grad", "mbr.heartbeat", "srv.ship",
+         "srv.adopt", "route.submit")
 
 #: process-global active plan; None = harness disabled (the fast path)
 _PLAN: Optional["FaultPlan"] = None

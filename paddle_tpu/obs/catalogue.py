@@ -388,6 +388,59 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
                                           "the first (completion - first "
                                           "token) / (n - 1), labels: "
                                           "tenant (bounded)", ("tenant",)),
+    # disaggregation: KV-page shipping (serving/ship.py wire contract)
+    "serving.ship_pages_total": ("counter", "KV pages exported for "
+                                            "shipping to a decode worker "
+                                            "(prefill side, "
+                                            "PagePool.export_slot)"),
+    "serving.ship_bytes_total": ("counter", "payload bytes exported for "
+                                            "shipping (pre-chunking, "
+                                            "pre-base64)"),
+    "serving.adopted_total": ("counter", "shipped slots adopted into this "
+                                         "pool (decode side, "
+                                         "PagePool.adopt_slot) — each is "
+                                         "one cross-worker request "
+                                         "landing"),
+    "serving.adopt_refused_total": ("counter", "shipments refused instead "
+                                               "of adopted, labels: reason "
+                                               "(chunk = per-chunk CRC/"
+                                               "base64 damage; data_loss "
+                                               "= reassembled payload "
+                                               "failed verification; "
+                                               "no_chunks = adopt with no "
+                                               "chunks held; geometry = "
+                                               "pool page_block/kv_dtype "
+                                               "mismatch; evicted = half-"
+                                               "shipment evicted by the "
+                                               "reassembly cap)",
+                                    ("reason",)),
+    # -- router: serving/router.py (`paddle_tpu route`) ------------------
+    "router.requests_total": ("counter", "client submits the router "
+                                         "resolved, labels: outcome (ok | "
+                                         "overloaded = every decode pool "
+                                         "refused | unavailable = no "
+                                         "worker reachable | "
+                                         "invalid_argument)",
+                              ("outcome",)),
+    "router.reroutes_total": ("counter", "in-flight requests re-placed "
+                                         "on another worker, labels: "
+                                         "reason (evicted = membership "
+                                         "TTL eviction; left = graceful "
+                                         "leave; unreachable = poll "
+                                         "transport failure; not_found = "
+                                         "worker restarted and forgot "
+                                         "the stream; error = engine "
+                                         "failed mid-stream; lost; "
+                                         "prefill_fallback = every "
+                                         "prefill worker down, decode-"
+                                         "side prefill served instead)",
+                              ("reason",)),
+    "router.inflight": ("gauge", "router-tracked requests not yet done "
+                                 "(buffers still growing or awaiting "
+                                 "collection)"),
+    "router.workers": ("gauge", "serving workers live in the router's "
+                                "membership table, labels: role (decode "
+                                "| prefill)", ("role",)),
     # -- tune: tune/driver.py (`paddle_tpu tune`) -----------------------
     "tune.measurements_total": ("counter", "candidate-plan timings taken "
                                            "by the autotune driver (one "

@@ -593,12 +593,15 @@ class _RpcClient:
             self._drop_sock()
             raise ConnectionError("server closed connection")
         if isinstance(resp, dict) and resp.get("epoch") is not None and \
-                str(req.get("op", "")).startswith(("mbr_", "ela_")):
-            # only membership-plane replies stamp the epoch: the built-in
-            # "stats" op also answers an "epoch" field, but that one is
-            # the TaskMaster's pass/dataset generation — reporting it as
-            # a membership epoch would mislead whoever correlates the
-            # final reconnect error against cluster.epoch
+                str(req.get("op", "")).startswith(
+                    ("mbr_", "ela_", "srv_", "route_")):
+            # only membership-plane replies stamp the epoch (serving and
+            # router replies carry the membership epoch of the cluster
+            # they are joined to): the built-in "stats" op also answers
+            # an "epoch" field, but that one is the TaskMaster's
+            # pass/dataset generation — reporting it as a membership
+            # epoch would mislead whoever correlates the final reconnect
+            # error against cluster.epoch
             self.last_epoch = resp["epoch"]
         if not resp.get("ok"):
             if resp.get("code") in FENCE_CODES:

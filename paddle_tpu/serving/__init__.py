@@ -16,7 +16,16 @@ Layers (each file is one altitude):
   page reclamation, and TTFT/TPOT SLO telemetry.
 * :mod:`.daemon` — ``paddle_tpu serve``: the engine exposed over the
   native RPC plane (srv_submit/srv_poll/srv_cancel via the unknown-op
-  fallback) + :class:`ServingClient`.
+  fallback) + :class:`ServingClient`; :class:`PrefillDaemon` is the
+  prefill-only worker flavor for disaggregated serving.
+* :mod:`.ship` — the KV-page shipping wire format (manifest + CRC'd
+  chunks) prefill workers use to hand a prefilled slot to a decode
+  worker's pool bit-exactly.
+* :mod:`.router` — ``paddle_tpu route``: :class:`ServingRouter` places
+  client submits over a membership table of prefill/decode workers by
+  windowed health trends, aggregates backpressure, and re-routes
+  in-flight streams off evicted workers; :class:`RouterClient` adds the
+  restart-recovery ladder.
 
 The import surface is flat (``from paddle_tpu.serving import
 ContinuousBatcher``) — PR 8 turned the module into a package without
@@ -24,13 +33,17 @@ moving any public name.
 """
 
 from .batcher import (SLO_CLASSES, ContinuousBatcher, Request,
-                      SpeculativeDecoder, validate_request)
-from .daemon import ServingClient, ServingDaemon
+                      SpeculativeDecoder, prefix_resubmission_error,
+                      validate_request)
+from .daemon import PrefillDaemon, ServingClient, ServingDaemon
 from .engine import Overloaded, ServingEngine
 from .paged import PagedBatcher, PagePool
 from .prefix import PrefixIndex
+from .router import RouterClient, ServingRouter
+from .ship import ShipError
 
 __all__ = ["ContinuousBatcher", "Request", "SpeculativeDecoder",
-           "validate_request", "PagePool", "PagedBatcher", "PrefixIndex",
-           "SLO_CLASSES", "ServingEngine", "Overloaded", "ServingDaemon",
-           "ServingClient"]
+           "validate_request", "prefix_resubmission_error", "PagePool",
+           "PagedBatcher", "PrefixIndex", "SLO_CLASSES", "ServingEngine",
+           "Overloaded", "ServingDaemon", "ServingClient", "PrefillDaemon",
+           "ServingRouter", "RouterClient", "ShipError"]

@@ -70,7 +70,26 @@ class Request:
     prefix_len: Optional[int] = None
 
 
-def validate_request(r: Request, model) -> None:
+def prefix_resubmission_error(declared, recorded) -> Optional[str]:
+    """Replay-hardening shared by engine, daemon and router: a
+    router-forwarded RESUBMISSION (same submit_key — a re-route or a
+    transport replay) may not declare a ``prefix_len`` exceeding the
+    recorded original. An inflated declaration would cache request-unique
+    continuation tokens as a "shared" prefix under the original key —
+    index poisoning. Returns the structured error string (the
+    ``invalid_argument`` body) or None when the declaration is honest."""
+    if declared is None:
+        return None
+    if int(declared) > int(recorded or 0):
+        return (f"resubmission declares prefix_len {int(declared)} but the "
+                f"recorded original was {int(recorded or 0)} — a forwarded "
+                "replay may not inflate its cached-prefix claim "
+                "(replay-hardening)")
+    return None
+
+
+def validate_request(r: Request, model, *,
+                     max_prefix_len: Optional[int] = None) -> None:
     """Normalize + reject a malformed request AT SUBMIT TIME with a precise
     ValueError — before PR 8 these surfaced as shape errors deep inside the
     ragged prefill (an empty prompt's pos==0 gather wraps; max_new<=0 used
@@ -110,6 +129,12 @@ def validate_request(r: Request, model) -> None:
                 f"prompt (len {r.prompt.size}) — a shared prefix cannot "
                 "be longer than the prompt that carries it")
         r.prefix_len = int(r.prefix_len)
+    if max_prefix_len is not None:
+        # the resubmission bound (router-forwarded replays): the recorded
+        # original caps what this submission may declare
+        err = prefix_resubmission_error(r.prefix_len, max_prefix_len)
+        if err is not None:
+            raise ValueError(f"{who}: {err}")
 
 
 def clip_emission(row, left: int, eos_id: Optional[int]):

@@ -49,6 +49,7 @@ import numpy as np
 
 from .. import obs
 from ..core.lod import bucket_length
+from . import ship
 from .batcher import Request, clip_emission, validate_request
 from .prefix import Match, PrefixIndex
 
@@ -285,12 +286,15 @@ class PagePool:
         """The max_len-capped token budget a (prompt, max_new) can hold."""
         return min(max_new, self.model.max_len - prompt_len)
 
-    def validate(self, r: Request) -> int:
+    def validate(self, r: Request,
+                 max_prefix_len: Optional[int] = None) -> int:
         """Submit-time validation; returns the request's worst-case page
         need (prefix hits can only shrink it). Raises ValueError for
         malformed requests AND for requests no empty pool could ever hold
-        (the page-budget check)."""
-        validate_request(r, self.model)
+        (the page-budget check). ``max_prefix_len`` passes the recorded
+        original of a router-forwarded resubmission through to the
+        replay-hardening check (batcher.prefix_resubmission_error)."""
+        validate_request(r, self.model, max_prefix_len=max_prefix_len)
         need = self.required_pages(
             r.prompt.size, self.effective_budget(r.prompt.size, r.max_new))
         if need > self.capacity_pages:
@@ -365,6 +369,81 @@ class PagePool:
         self.slot_reserve[slot] = 0
         self.tables[slot, :] = 0
         self.pos[slot] = 0
+
+    # -- disaggregation: export / adopt (serving/ship.py) ------------------
+    def export_slot(self, slot: int, first: int):
+        """Serialize ``slot``'s prefilled page contents for shipping to a
+        decode worker's pool: gather the slot's table pages from every
+        pool array (k/v per layer + int8 scales) and pack them with the
+        request state (``pos``/first token) under a payload CRC. Rows past
+        ``pos`` inside the last page are garbage on BOTH ends — the paged
+        read masks by ``pos``, so shipping them changes nothing."""
+        plen = int(self.pos[slot])
+        npg = -(-plen // self.bs)
+        pages = jnp.asarray(self.tables[slot, :npg])
+        arrays = {nm: np.asarray(arr[pages])
+                  for nm, arr in self.pools.items()}
+        manifest, payload = ship.pack(arrays, plen=plen, first=first,
+                                      page_block=self.bs,
+                                      kv_dtype=self.kv_dtype)
+        obs.count("serving.ship_pages_total", npg)
+        obs.count("serving.ship_bytes_total", len(payload))
+        return manifest, payload
+
+    def check_shipment(self, plen: int, arrays: Dict[str, np.ndarray]
+                       ) -> None:
+        """Validate shipped arrays against THIS pool's layout without
+        touching any page. Callable at submit time (the engine's
+        ``submit_prefilled``) so a mismatched shipment is a structured
+        ValueError refusal at the wire edge, never a scheduler-thread
+        death mid-adoption."""
+        npg = -(-int(plen) // self.bs)
+        missing = set(self.pools) - set(arrays)
+        extra = set(arrays) - set(self.pools)
+        if missing or extra:
+            raise ValueError(
+                f"shipped arrays disagree with this pool's layout "
+                f"(missing {sorted(missing)}, unexpected {sorted(extra)}) "
+                "— prefill and decode pools must share model depth and "
+                "kv_dtype")
+        for nm, rows in arrays.items():
+            ref = self.pools[nm]
+            want = (npg,) + tuple(ref.shape[1:])
+            if tuple(rows.shape) != want:
+                raise ValueError(
+                    f"shipped {nm!r} shape {tuple(rows.shape)} != expected "
+                    f"{want} (page_block/heads/width mismatch)")
+            if np.dtype(rows.dtype) != np.dtype(ref.dtype):
+                raise ValueError(
+                    f"shipped {nm!r} dtype {rows.dtype} != pool "
+                    f"{ref.dtype}; refusing a lossy cast")
+
+    def adopt_slot(self, slot: int, plen: int, first: int,
+                   arrays: Dict[str, np.ndarray], need_pages: int) -> None:
+        """Land a shipped slot (the decode half of :meth:`export_slot`):
+        reserve its worst-case OWNED pages, allocate the table, scatter
+        the shipped rows in BYTE-IDENTICAL (dtype-checked — a silent cast
+        would break wire-greedy parity), and arm ``pos``/``cur`` so the
+        next segment continues exactly where the prefill worker's
+        admission stopped. Caller (the engine scheduler) has already
+        checked :meth:`fits`/:meth:`evict_for` for ``need_pages``."""
+        plen = int(plen)
+        npg = -(-plen // self.bs)
+        self.check_shipment(plen, arrays)
+        self.slot_reserve[slot] = need_pages
+        self.reserved += need_pages
+        self.slot_shared[slot] = []
+        self.slot_partial[slot] = None
+        self._ensure(slot, plen)
+        pages = jnp.asarray(self.tables[slot, :npg])
+        for nm, rows in arrays.items():
+            ref = self.pools[nm]
+            self.pools[nm] = ref.at[pages].set(
+                jnp.asarray(np.ascontiguousarray(rows)))
+        self.pos[slot] = plen
+        self.cur[slot] = int(first)
+        self.prompt_tokens_total += plen
+        obs.count("serving.adopted_total")
 
     # -- jitted programs ---------------------------------------------------
     def _admit_fn(self, tpad: int, nbp: int):

@@ -96,7 +96,11 @@ def test_lint_bench_rows_schema(tmp_path):
         + json.dumps({"metric": "z_serve_daemon_tokens_per_sec",
                       "value": 9.0, "unit": "tok/s", "vs_baseline": None,
                       "ttft_p50_ms": 12.0, "tpot_p50_ms": 3.0,
-                      "methodology": "measured"}) + "\n")
+                      "methodology": "measured"}) + "\n"
+        + json.dumps({"metric": "r_route_disagg_tokens_per_sec",
+                      "value": 7.0, "unit": "tok/s", "vs_baseline": None,
+                      "ttft_p50_ms": 20.0, "tpot_p50_ms": 4.0,
+                      "n_decode_workers": 2}) + "\n")
     bad = tmp_path / "bad.jsonl"
     bad.write_text(
         json.dumps({"metric": "y_decode_tokens_per_sec", "value": 5.0,
@@ -107,7 +111,10 @@ def test_lint_bench_rows_schema(tmp_path):
         + json.dumps({"metric": "w_train_ms_per_batch", "value": 1.0,
                       "unit": "ms", "vs_baseline": None, "mfu": 0.2,
                       "methodology": "guessed",
-                      "plan_source": "vibes"}) + "\n")
+                      "plan_source": "vibes"}) + "\n"
+        + json.dumps({"metric": "r_route_disagg_tokens_per_sec",
+                      "value": 7.0, "unit": "tok/s", "vs_baseline": None,
+                      "ttft_p50_ms": 20.0, "tpot_p50_ms": 4.0}) + "\n")
     out = _run("lint", "--bench-rows", str(good))
     assert "0 problem(s)" in out
     r = subprocess.run([sys.executable, "-m", "paddle_tpu", "lint",
@@ -124,6 +131,9 @@ def test_lint_bench_rows_schema(tmp_path):
     # plan_source is required on _train_/_decode_ rows (tuned-vs-heuristic
     # deltas stay machine-checkable) and must be tuned|heuristic
     assert "plan_source" in r.stdout and "vibes" in r.stdout
+    # the _route_ family rule (disaggregated serving): a routed row
+    # without the fleet size it was spread over is not comparable
+    assert "n_decode_workers" in r.stdout
 
 
 def test_cli_train_test_time_dump(config_file, tmp_path):
