@@ -317,6 +317,10 @@ def main(full: bool = False):
     # for the fused-RNN families + the measured decode-route crossover
     rows.append(("__import__('benchmarks.autotune_delta', fromlist=['x'])"
                  ".run()", ROW_TIMEOUT))
+    # the fleet-actor row (ROADMAP item 2): kill half the decode pool,
+    # count alert windows until the actor restores membership + SLO
+    rows.append(("__import__('benchmarks.fleet_autoscale', fromlist=['x'])"
+                 ".run()", ROW_TIMEOUT))
     if full:
         # the remaining BASELINE.md rows, so a --full session covers the
         # whole measured table in one output

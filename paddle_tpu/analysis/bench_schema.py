@@ -31,7 +31,12 @@ Family rules key on the metric NAME, which is itself part of the contract
 * ``*_route_*`` rows: the SLO pair PLUS ``n_decode_workers`` — a routed
   serving number is meaningless without the fleet size it was spread
   over (1 prefill + 2 decode pools is not comparable to a solo daemon;
-  benchmarks/serving_router.py).
+  benchmarks/serving_router.py);
+* ``*_fleet_*`` rows: ``recovery_windows`` + ``slo_recovered`` — a
+  fleet-actor recovery number is the chaos bar itself: how many alert
+  windows from kill to restored SLO, and whether the SLO actually
+  recovered (a recovery-time row that never re-met the SLO is a
+  failure wearing a latency; benchmarks/fleet_autoscale.py).
 """
 
 from __future__ import annotations
@@ -53,6 +58,7 @@ FAMILY_REQUIRED = {
     "_serve_": ("ttft_p50_ms", "tpot_p50_ms", "methodology"),
     "_prefix_": ("hit_rate",),
     "_route_": ("ttft_p50_ms", "tpot_p50_ms", "n_decode_workers"),
+    "_fleet_": ("recovery_windows", "slo_recovered"),
 }
 
 #: the only legal methodology stamps

@@ -63,6 +63,8 @@ def _cmd_train_elastic(args):
     "batches"}`` (params/batches as host arrays; workers only need
     loss_fn)."""
     import runpy
+    import signal
+    import threading
 
     from .trainer.elastic import ElasticMaster, ElasticWorker
     cfg = runpy.run_path(args.config)
@@ -85,7 +87,17 @@ def _cmd_train_elastic(args):
             return 2
         worker = ElasticWorker(wl["loss_fn"], (host, port),
                                worker=args.worker_id)
-        summary = worker.run()
+        # drain-at-barrier (ISSUE 18): the fleet actor's subprocess
+        # backend drains with SIGTERM — finish the in-flight shard, push
+        # its gradient, leave membership, then exit, so a drained worker
+        # never costs the step a discarded shard
+        stop = threading.Event()
+        try:
+            signal.signal(signal.SIGTERM, lambda *_: stop.set())
+            signal.signal(signal.SIGINT, lambda *_: stop.set())
+        except ValueError:
+            pass     # not the main thread (embedded runs): no handler
+        summary = worker.run(stop=stop)
         print(f"elastic worker {summary['worker']} served "
               f"{summary['shards']} shard(s); job done: {summary['done']}")
         return 0 if summary["done"] else 2
@@ -1243,13 +1255,15 @@ def cmd_obs_serve(args):
                     h = client.obs_health()
                 except (OSError, ConnectionError):
                     # a master predating obs_health still serves metrics
-                    h = {"health": {}, "active": [], "events": []}
+                    h = {"health": {}, "active": [], "events": [],
+                         "actions": []}
                 dumps.append({"meta": {"process": "master",
                                        "obs_workers": workers},
                               "metrics": samples,
                               "events": h["events"],
                               "alerts": h["active"],
-                              "health": h["health"]})
+                              "health": h["health"],
+                              "actions": h.get("actions", [])})
             except (OSError, ConnectionError) as e:
                 # keep serving whatever dumps we do have; a master-only
                 # serve surfaces the outage as a 500 with the cause
@@ -1270,6 +1284,8 @@ def cmd_obs_serve(args):
                 merged.setdefault("alerts", []).extend(d["alerts"])
             if d.get("health"):
                 merged.setdefault("health", {}).update(d["health"])
+            if d.get("actions"):
+                merged.setdefault("actions", []).extend(d["actions"])
         return merged
 
     srv = ObsHttpServer(provider, host=args.host, port=args.port).start()
@@ -1314,7 +1330,7 @@ def cmd_obs_top(args):
             return 2
 
     def fetch():
-        samples, alerts, health = [], [], {}
+        samples, alerts, health, actions = [], [], {}, []
         if inputs:
             dumps = _read_obs_inputs(inputs)
             # always merge (even one dump): the merge stamps the worker
@@ -1333,22 +1349,25 @@ def cmd_obs_top(args):
                     h = client.obs_health()
                 except (OSError, ConnectionError):
                     # a master predating obs_health still serves metrics
-                    h = {"health": {}, "active": [], "events": []}
+                    h = {"health": {}, "active": [], "events": [],
+                         "actions": []}
                 health = h["health"]
+                actions = h.get("actions", [])
                 # transitions first (chronological fold), live state last
                 alerts.extend(h["events"])
                 alerts.extend(h["active"])
             finally:
                 client.close()
-        return samples, alerts, health
+        return samples, alerts, health, actions
 
     def render():
         try:
-            samples, alerts, health = fetch()
+            samples, alerts, health, actions = fetch()
         except (OSError, ConnectionError) as e:
             return None, f"obs top: source unavailable: {e}"
         from .obs.health import fold_alert_stream
-        table = health_table(samples, alerts=alerts, health=health)
+        table = health_table(samples, alerts=alerts, health=health,
+                             actions=actions)
         firing = fold_alert_stream(alerts)
         head = (f"fleet: {len(health) if health else '-'} worker(s) in "
                 f"health view, {len(firing)} alert(s) firing")
@@ -1641,6 +1660,111 @@ def cmd_route(args):
             except Exception as e:
                 print(f"warning: could not write obs dump: {e}",
                       file=sys.stderr)
+    return 0
+
+
+def cmd_cluster_autoscale(args):
+    """``paddle_tpu cluster autoscale`` — the fleet actor (ISSUE 18,
+    docs/design/fleet.md): watch the membership + health planes and
+    DRIVE the fleet to them — spawn workers on a sustained join
+    recommendation or an SLO burn, drain them gracefully on leave /
+    scale-in, yield training capacity to serving under a shared
+    ``--total-workers`` budget.
+
+    Populations come from the flags: ``--train-master HOST:PORT`` +
+    ``--train-cmd`` (a launch template with ``{worker}`` — and
+    optionally ``{python}`` — placeholders) drives an elastic-DP
+    training pool; ``--router HOST:PORT`` + ``--decode-cmd`` drives a
+    decode serving pool toward ``--decode-target``. At least one
+    population is required. Spawned processes must join the matching
+    membership plane under the worker name the actor passed — that
+    (never the subprocess's exit status alone) is the success oracle."""
+    import signal
+    import threading
+
+    from . import obs as _obs
+    from .cluster import (ActorReporter, FleetActor, MasterProbe,
+                          Population, RouterProbe, SubprocessSpawnBackend)
+
+    populations, closers = [], []
+    for flag, cmd_flag, name, probe_cls, target in (
+            ("train_master", "train_cmd", "train", MasterProbe, None),
+            ("router", "decode_cmd", "serve", RouterProbe,
+             args.decode_target)):
+        addr = getattr(args, flag, None)
+        if not addr:
+            continue
+        try:
+            parsed = _parse_hostport(addr)
+        except ValueError:
+            parsed = None
+        if parsed is None or not parsed[1]:
+            print(f"cluster autoscale: --{flag.replace('_', '-')} must be "
+                  f"host:port, got {addr!r}", file=sys.stderr)
+            return 2
+        template = getattr(args, cmd_flag, None)
+        if not template or "{worker}" not in template:
+            print(f"cluster autoscale: --{cmd_flag.replace('_', '-')} must "
+                  f"be a launch template containing {{worker}}",
+                  file=sys.stderr)
+            return 2
+        host, port = parsed
+        probe = probe_cls(host, port)
+        reporter = ActorReporter(host, port, args.actor)
+        closers.extend((probe, reporter))
+        populations.append(Population(
+            name=name, backend=SubprocessSpawnBackend(template),
+            probe=probe, reporter=reporter,
+            min_workers=getattr(args, f"{name}_min"),
+            max_workers=getattr(args, f"{name}_max"),
+            target=target))
+    if not populations:
+        print("cluster autoscale: pass --train-master/--train-cmd and/or "
+              "--router/--decode-cmd", file=sys.stderr)
+        return 2
+
+    session = _obs.ObsSession().install()
+    actor = FleetActor(populations, total_workers=args.total_workers,
+                       interval_s=args.interval, cooldown_s=args.cooldown,
+                       max_churn=args.max_churn,
+                       spawn_grace_s=args.spawn_grace,
+                       drain_grace_s=args.drain_grace, name=args.actor)
+    pops = ", ".join(f"{q.name}[{q.min_workers}..{q.max_workers}"
+                     + (f"->{q.target}]" if q.target is not None else "]")
+                     for q in populations)
+    print(f"AUTOSCALE ACTOR {args.actor}", flush=True)
+    print(f"  populations: {pops}  interval={args.interval:g} "
+          f"cooldown={args.cooldown:g} max_churn={args.max_churn}"
+          + (f" total={args.total_workers}" if args.total_workers else ""),
+          flush=True)
+    stop = threading.Event()
+
+    def _on_term(signum, frame):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+    except ValueError:
+        pass
+    try:
+        if args.once:
+            for entry in actor.step():
+                print(f"  {entry['action']} {entry['population']}/"
+                      f"{entry['worker']}: {entry['reason']}", flush=True)
+        else:
+            actor.run(stop=stop)
+            if actor.deposed:
+                print("cluster autoscale: deposed by a newer actor "
+                      "registration; exiting", file=sys.stderr)
+                return 2
+    finally:
+        for c in closers:
+            try:
+                c.close()
+            except Exception:
+                pass
+        session.uninstall()
     return 0
 
 
@@ -2014,6 +2138,64 @@ def main(argv=None) -> int:
                     "windowed trend data placement scores read)")
     rt.add_argument("--obs_out", default=None)
     rt.set_defaults(fn=cmd_route)
+
+    cl = sub.add_parser("cluster", help="fleet lifecycle: the actor that "
+                        "closes the autoscale loop (docs/design/fleet.md)")
+    clsub = cl.add_subparsers(dest="cluster_cmd", required=True)
+    ca = clsub.add_parser("autoscale", help="watch the membership + "
+                          "health planes and spawn/drain workers to the "
+                          "hysteresis-stable recommendation and SLO "
+                          "burn-rate alerts")
+    ca.add_argument("--actor", default="autoscale-actor",
+                    help="actor name for act_register (single-writer: a "
+                    "newer registration deposes this one)")
+    ca.add_argument("--train-master", dest="train_master", default=None,
+                    metavar="HOST:PORT",
+                    help="elastic master whose membership/recommendation "
+                    "drives the training population")
+    ca.add_argument("--train-cmd", dest="train_cmd", default=None,
+                    help="training-worker launch template with a {worker} "
+                    "placeholder ({python} expands to this interpreter), "
+                    "e.g. '{python} -m paddle_tpu train --config c.py "
+                    "--elastic worker --master_addr H:P "
+                    "--worker_id {worker}'")
+    ca.add_argument("--train-min", dest="train_min", type=int, default=1)
+    ca.add_argument("--train-max", dest="train_max", type=int, default=8)
+    ca.add_argument("--router", default=None, metavar="HOST:PORT",
+                    help="serving router whose decode pool the actor "
+                    "keeps at --decode-target (scaling out on TTFT/TPOT "
+                    "SLO burn)")
+    ca.add_argument("--decode-cmd", dest="decode_cmd", default=None,
+                    help="decode-worker launch template with a {worker} "
+                    "placeholder, e.g. '{python} -m paddle_tpu serve "
+                    "--router H:P --worker {worker} ...'")
+    ca.add_argument("--decode-target", dest="decode_target", type=int,
+                    default=1, help="steady-state decode pool size")
+    ca.add_argument("--serve-min", dest="serve_min", type=int, default=1)
+    ca.add_argument("--serve-max", dest="serve_max", type=int, default=8)
+    ca.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between actor ticks")
+    ca.add_argument("--cooldown", type=float, default=5.0,
+                    help="per-(population, action) cooldown: damping on "
+                    "top of the recommendation's hysteresis")
+    ca.add_argument("--max-churn", dest="max_churn", type=int, default=1,
+                    help="max concurrent in-flight spawns+drains across "
+                    "the whole fleet")
+    ca.add_argument("--spawn-grace", dest="spawn_grace", type=float,
+                    default=30.0, help="seconds a spawned worker gets to "
+                    "appear in membership before the spawn counts failed")
+    ca.add_argument("--drain-grace", dest="drain_grace", type=float,
+                    default=30.0, help="seconds a draining worker gets to "
+                    "leave membership before escalation to kill")
+    ca.add_argument("--total-workers", dest="total_workers", type=int,
+                    default=None,
+                    help="shared fleet budget: when set, populations "
+                    "compete through the weighted-fair deficit scheduler "
+                    "and training yields to serving on SLO burn")
+    ca.add_argument("--once", action="store_true",
+                    help="run one control tick, print committed actions, "
+                    "exit (scripts, tests)")
+    ca.set_defaults(fn=cmd_cluster_autoscale)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=cmd_version)

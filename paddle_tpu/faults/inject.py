@@ -29,6 +29,13 @@ site                      where it fires
                           (serving/router.py — raise models a worker hop
                           dying mid-placement; the router retries the next
                           candidate)
+``actor.spawn``           per worker spawn the fleet actor commits
+                          (cluster/actor.py — raise models the launch
+                          failing; the actor journals spawn_failed, counts
+                          the failure and keeps the loop alive)
+``actor.drain``           per graceful drain the fleet actor commits
+                          (cluster/actor.py — delay models a hung drain,
+                          which the grace deadline escalates to kill)
 ========================  =====================================================
 
 ``step.grad`` caveat: the hook filters the HOST-observed loss value after
@@ -72,7 +79,7 @@ from .. import obs
 
 SITES = ("ckpt.write", "rpc.send", "rpc.recv", "lease.renew",
          "reader.next", "step.grad", "mbr.heartbeat", "srv.ship",
-         "srv.adopt", "route.submit")
+         "srv.adopt", "route.submit", "actor.spawn", "actor.drain")
 
 #: process-global active plan; None = harness disabled (the fast path)
 _PLAN: Optional["FaultPlan"] = None

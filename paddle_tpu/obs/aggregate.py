@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import threading
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from . import count as _count
@@ -152,6 +153,9 @@ class ClusterAggregator:
         self.eval_interval_s = float(eval_interval_s)
         self._last_eval = float("-inf")
         self._health_snapshot: Dict[str, Dict[str, Any]] = {}
+        #: committed fleet-actor actions (ISSUE 18), newest last — what
+        #: lets an operator tell "recommendation held" from "actor acted"
+        self.actions: deque = deque(maxlen=64)
 
     def _prune_locked(self) -> None:
         cutoff = self._clock() - self.ttl
@@ -239,6 +243,39 @@ class ClusterAggregator:
         instead of freezing a dead incarnation's alert as active."""
         self.health.forget(worker)
         self.history.drop_worker(worker)
+
+    def note_action(self, entry: Dict[str, Any]) -> Dict[str, Any]:
+        """Journal one COMMITTED autoscale action (the ``act_report``
+        ext-op lands here): stamps the aggregator clock, appends to the
+        bounded journal, and emits/records the committed-action signal —
+        ``cluster.autoscale_committed`` is the acted-on twin of the
+        tentative ``cluster.autoscale_signal`` gauge, and diverges from
+        it exactly while hysteresis/cooldowns hold the fleet still."""
+        from .health import MASTER_WORKER
+        now = self._clock()
+        e = {"ts": now,
+             "actor": str(entry.get("actor", "")),
+             "action": str(entry.get("action", "")),
+             "population": str(entry.get("population", "")),
+             "worker": str(entry.get("worker", "")),
+             "reason": str(entry.get("reason", ""))[:400],
+             "signal": float(entry.get("signal", 0.0) or 0.0)}
+        with self._lock:
+            self.actions.append(e)
+        _gauge_set("cluster.autoscale_committed", e["signal"])
+        _count("cluster.actor_actions_total",
+               population=e["population"] or "unknown",
+               action=e["action"] or "unknown")
+        self.history.record_value(MASTER_WORKER,
+                                  "cluster.autoscale_committed",
+                                  e["signal"], ts=now)
+        return e
+
+    def recent_actions(self, n: int = 32) -> List[Dict[str, Any]]:
+        """The newest ``n`` committed actions, oldest first (the
+        ``obs_health`` reply's ``actions`` field)."""
+        with self._lock:
+            return list(self.actions)[-n:]
 
     def health_snapshot(self) -> Dict[str, Dict[str, Any]]:
         """The last evaluated per-worker health (the ``obs_health`` op's
@@ -373,7 +410,9 @@ class ObsHttpServer:
                                   if e.get("name") == "alert"]
                         body = json.dumps(
                             {"active": dump.get("alerts") or [],
-                             "events": events}, indent=1).encode()
+                             "events": events,
+                             "actions": dump.get("actions") or []},
+                            indent=1).encode()
                         ctype = "application/json"
                     elif path in ("/summary", "/"):
                         dump = outer.provider()
@@ -383,7 +422,8 @@ class ObsHttpServer:
                             alerts=[e for e in dump.get("events", ())
                                     if e.get("name") == "alert"]
                             + (dump.get("alerts") or []),
-                            health=dump.get("health"))
+                            health=dump.get("health"),
+                            actions=dump.get("actions"))
                         if table:
                             text += "\n== fleet health ==\n" + table
                         body = (text + "\n").encode()

@@ -260,6 +260,26 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
                                           "recommendation only commits "
                                           "when the signal held for the "
                                           "whole hysteresis window"),
+    "cluster.autoscale_committed": ("gauge", "the last autoscale action "
+                                             "the fleet actor COMMITTED "
+                                             "(spawn=1, drain/evict=-1) — "
+                                             "diverges from "
+                                             "cluster.autoscale_signal "
+                                             "exactly while hysteresis or "
+                                             "cooldowns hold the fleet "
+                                             "still"),
+    "cluster.actor_actions_total": ("counter", "committed fleet-actor "
+                                               "actions journaled via "
+                                               "act_report, labels: "
+                                               "population, action (both "
+                                               "bounded)",
+                                    ("population", "action")),
+    "cluster.actor_failures_total": ("counter", "fleet-actor actions that "
+                                                "failed: spawns that died "
+                                                "or never joined within "
+                                                "grace, drains escalated "
+                                                "to kill, labels: action "
+                                                "(bounded)", ("action",)),
     # -- alerts: obs/alerts.py (the fleet alert engine) ------------------
     "alerts.fired_total": ("counter", "alert rules transitioning to "
                                       "firing, labels: rule (bounded: "

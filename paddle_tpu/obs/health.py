@@ -455,14 +455,17 @@ def _latest_by_worker(samples, name: str) -> Dict[str, float]:
     return out
 
 
-def health_table(samples, alerts=None, health=None) -> str:
+def health_table(samples, alerts=None, health=None, actions=None) -> str:
     """The per-worker fleet table (``obs top`` / ``obs serve /summary``):
     one row per worker with goodput ratio, mfu, queue depth, straggler
     score and its active alerts — read from a merged sample list (live
     ``obs_stats`` or a dump on disk), so the table renders with or
     without a live master. ``health`` optionally takes the master's
     derived per-worker snapshot (``obs_health``) and fills the straggler /
-    jitter / goodput cells the samples alone cannot carry."""
+    jitter / goodput cells the samples alone cannot carry. ``actions``
+    optionally takes the committed fleet-actor journal (ISSUE 18) and
+    appends an "autoscale actions" tail — the operator's one-glance
+    answer to "did the actor ACT or is the recommendation just held?"."""
     goodput = _latest_by_worker(samples, "goodput.ratio")
     mfu = _latest_by_worker(samples, "roofline.mfu")
     queue = _latest_by_worker(samples, "serving.queue_depth")
@@ -481,7 +484,7 @@ def health_table(samples, alerts=None, health=None) -> str:
     for w, rule in fold_alert_stream(alerts):
         by_worker_alerts.setdefault(w, []).append(rule)
     if not workers:
-        return ""
+        return _actions_tail(actions)
     fmt = "{:<20} {:>8} {:>7} {:>6} {:>10} {:>8}  {}"
     lines = [fmt.format("worker", "goodput", "mfu", "queue",
                         "straggler", "hb_jit", "alerts")]
@@ -497,4 +500,27 @@ def health_table(samples, alerts=None, health=None) -> str:
             cell(queue, w, "{:.0f}"), cell(score, w),
             cell(jitter, w, "{:.3f}"),
             ",".join(rules) if rules else "-"))
+    tail = _actions_tail(actions)
+    return "\n".join(lines) + (("\n\n" + tail) if tail else "")
+
+
+def _actions_tail(actions) -> str:
+    """Render the committed autoscale-action journal (newest last)."""
+    if not actions:
+        return ""
+    fmt = "{:>10} {:<6} {:<12} {:<20} {}"
+    lines = ["== autoscale actions ==",
+             fmt.format("ts", "action", "population", "worker", "reason")]
+    for a in actions:
+        if not isinstance(a, dict):
+            continue
+        try:
+            ts = "{:.1f}".format(float(a.get("ts", 0.0)))
+        except (TypeError, ValueError):
+            ts = "-"
+        lines.append(fmt.format(
+            ts, str(a.get("action", "-"))[:6],
+            str(a.get("population", "-"))[:12],
+            str(a.get("worker", "-"))[:20],
+            str(a.get("reason", ""))[:60]))
     return "\n".join(lines)

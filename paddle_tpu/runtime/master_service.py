@@ -482,7 +482,8 @@ class MasterServer:
             agg.maybe_evaluate()
             return {"ok": True, "health": agg.health_snapshot(),
                     "active": agg.alerts.active(),
-                    "events": agg.alerts.recent_events()}
+                    "events": agg.alerts.recent_events(),
+                    "actions": agg.recent_actions()}
         if op == "set_dataset":
             self.master.set_dataset(req["payloads"])
             return {"ok": True}
@@ -719,11 +720,13 @@ class MasterClient(_RpcClient):
     def obs_health(self):
         """The fleet health view (ISSUE 15): ``{"health": per-worker
         derived health, "active": firing alerts, "events": recent alert
-        transitions}`` — what ``paddle_tpu obs top --master`` renders."""
+        transitions, "actions": committed autoscale actions (ISSUE 18)}``
+        — what ``paddle_tpu obs top --master`` renders."""
         r = self._call({"op": "obs_health"})
         if not r.get("ok"):
             raise ConnectionError(
                 f"obs_health rejected: {r.get('error', 'unknown error')}")
         return {"health": r.get("health") or {},
                 "active": list(r.get("active", ())),
-                "events": list(r.get("events", ()))}
+                "events": list(r.get("events", ())),
+                "actions": list(r.get("actions", ()))}

@@ -105,6 +105,9 @@ class MembershipService:
         self._members: Dict[str, _Member] = {}
         self.epoch = epoch0
         self._next_token = 0
+        #: the registered fleet actor, (name, token) — single writer of
+        #: committed autoscale actions (ISSUE 18); None until one registers
+        self._actor: Optional[Tuple[str, int]] = None
         self._server = None
         self._on_change: List[Callable] = []
         self._tick_interval = (tick_interval if tick_interval is not None
@@ -141,6 +144,8 @@ class MembershipService:
         server.register_op("mbr_heartbeat", self._op_heartbeat)
         server.register_op("mbr_leave", self._op_leave)
         server.register_op("mbr_view", self._op_view)
+        server.register_op("act_register", self._op_act_register)
+        server.register_op("act_report", self._op_act_report)
         return self
 
     def subscribe(self, fn: Callable[..., None]) -> None:
@@ -370,6 +375,62 @@ class MembershipService:
         view["recommendation"] = rec
         return view
 
+    # -- fleet-actor registration (ISSUE 18) --------------------------------
+    def _op_act_register(self, req):
+        """Register the fleet actor that may journal committed autoscale
+        actions. SINGLE-WRITER: a new registration replaces the old one
+        and stales its token — two actors fighting over one fleet is the
+        flapping the whole plane exists to prevent, so the deposed
+        actor's next ``act_report`` gets a fencing refusal and stands
+        down. Tokens share the member counter (monotonic per master
+        incarnation)."""
+        fenced = self._fenced_master()
+        if fenced is not None:
+            return fenced
+        actor = str(req.get("actor", ""))
+        if not actor:
+            return {"ok": False, "error": "act_register needs an actor name"}
+        with self._lock:
+            self._next_token += 1
+            self._actor = (actor, self._next_token)
+            epoch = self.epoch
+            token = self._next_token
+        log.info("fleet actor %r registered (token %d)", actor, token)
+        return {"ok": True, "actor_token": token, "epoch": epoch}
+
+    def _op_act_report(self, req):
+        """Journal one COMMITTED autoscale action into the aggregator
+        (the ``cluster.autoscale_committed`` satellite): only the
+        currently-registered actor's token is accepted, with the same
+        structured fencing codes the member plane uses."""
+        fenced = self._fenced_master()
+        if fenced is not None:
+            return fenced
+        actor = str(req.get("actor", ""))
+        token = req.get("actor_token")
+        with self._lock:
+            registered = self._actor
+            epoch = self.epoch
+        if registered is None or registered[0] != actor:
+            obs.count("cluster.stale_rpcs_total", code=CODE_UNKNOWN_MEMBER)
+            return _err(CODE_UNKNOWN_MEMBER,
+                        f"actor {actor!r} is not registered", epoch=epoch)
+        if registered[1] != token:
+            obs.count("cluster.stale_rpcs_total", code=CODE_STALE_MEMBER)
+            return _err(CODE_STALE_MEMBER,
+                        f"actor {actor!r} token {token} superseded by a "
+                        f"newer registration", epoch=epoch)
+        agg = self._aggregator()
+        if agg is not None and hasattr(agg, "note_action"):
+            agg.note_action({
+                "actor": actor,
+                "action": str(req.get("action", "")),
+                "population": str(req.get("population", "")),
+                "worker": str(req.get("worker", "")),
+                "reason": str(req.get("reason", "")),
+                "signal": float(req.get("signal", 0.0) or 0.0)})
+        return {"ok": True, "epoch": epoch}
+
 
 # -- autoscale hook -------------------------------------------------------------
 
@@ -531,6 +592,29 @@ class MembershipClient(MasterClient):
 
     def cluster_view(self) -> dict:
         return self._call({"op": "mbr_view"})
+
+    # -- fleet-actor plane (ISSUE 18) ---------------------------------------
+    def act_register(self, actor: str) -> Tuple[int, int]:
+        """Register ``actor`` as THE fleet actor -> (actor_token, epoch).
+        Replaces (and fences out) any previously registered actor."""
+        r = self._call({"op": "act_register", "actor": actor})
+        if not r.get("ok"):
+            raise RuntimeError(f"act_register failed: {r.get('error')}")
+        return int(r["actor_token"]), int(r["epoch"])
+
+    def act_report(self, actor: str, actor_token: int, *, action: str,
+                   population: str, worker: str, reason: str = "",
+                   signal: float = 0.0) -> int:
+        """Journal one committed autoscale action -> current epoch.
+        Raises StaleMemberError when this actor has been superseded (the
+        hardened ``_call`` fencing contract — the cue to stand down)."""
+        r = self._call({"op": "act_report", "actor": actor,
+                        "actor_token": actor_token, "action": action,
+                        "population": population, "worker": worker,
+                        "reason": reason, "signal": signal})
+        if not r.get("ok"):
+            raise RuntimeError(f"act_report failed: {r.get('error')}")
+        return int(r["epoch"])
 
 
 class HeartbeatKeeper:

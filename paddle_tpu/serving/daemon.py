@@ -180,6 +180,12 @@ class ServingDaemon(_RouterMember):
 
     def stop(self, drain_s: float = 0.0) -> None:
         self._draining.set()     # refuse new submissions from here on
+        if self._keeper is not None:
+            # graceful-drain-before-evict (ISSUE 18): a ROUTED daemon
+            # leaves membership FIRST, so the router re-routes in-flight
+            # streams and stops placing on us while we drain what's
+            # already here — the second _leave_router below is a no-op
+            self._leave_router()
         if drain_s > 0:
             # drain: let the scheduler finish live + queued work, then let
             # clients poll the finished results home, all inside one
