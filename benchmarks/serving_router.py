@@ -23,6 +23,8 @@ from .serving_decode import VOCAB, build
 
 
 def run(n_requests: int = 32, slots: int = 8, segment: int = 32) -> dict:
+    from paddle_tpu import obs as _obs
+    from paddle_tpu.obs.requests import group_legs, stitch
     from paddle_tpu.serving import (PagePool, PrefillDaemon, RouterClient,
                                     ServingDaemon, ServingEngine,
                                     ServingRouter)
@@ -32,6 +34,11 @@ def run(n_requests: int = 32, slots: int = 8, segment: int = 32) -> dict:
     workload = [(rs.randint(0, VOCAB, int(rs.randint(32, 257))),
                  int(rs.randint(32, 257))) for _ in range(n_requests)]
 
+    # an installed obs plane arms the fleet's always-on request-timeline
+    # ledger (obs/requests.py) — the phase breakdown below comes from the
+    # SAME production instrumentation the daemons run in deployment
+    session = _obs.ObsSession(registry=_obs.MetricsRegistry()).install()
+    timelines = []
     router = ServingRouter(scrape_interval_s=0.1).start()
     daemons = []
     try:
@@ -79,15 +86,31 @@ def run(n_requests: int = 32, slots: int = 8, segment: int = 32) -> dict:
             time.sleep(0.01)
         dt = time.perf_counter() - t0
         stats = client.serving_stats()
+        led = _obs.request_ledger()
+        if led is not None:
+            timelines = led.export(n=1024)
     finally:
         for d in daemons:
             d.stop()
         router.stop()
+        session.uninstall()
 
     delivered = sum(counts.values())
     ttft = [(t_first[i] - t_submit[i]) * 1e3 for i in t_first]
     tpot = [(t_done[i] - t_first[i]) / (counts[i] - 1) * 1e3
             for i in t_done if counts[i] > 1 and i in t_first]
+    # phase-decomposed TTFT p50s (ms) from the stitched timelines — the
+    # _route_ family rule makes this mandatory so a routed-TTFT
+    # regression names WHICH hop (queue/prefill/ship/adopt) moved
+    phase_ms = {ph: [] for ph in ("queued", "prefill", "ship", "adopt")}
+    for legs in group_legs(timelines).values():
+        st = stitch(legs)
+        for ph, arr in phase_ms.items():
+            v = st["breakdown"].get(ph)
+            if v:
+                arr.append(v * 1e3)
+    ttft_breakdown = {ph: (round(_pct(arr, 50), 2) if arr else 0.0)
+                      for ph, arr in phase_ms.items()}
     return {"metric": f"transformer_lm_route_disagg_tokens_per_sec_"
                       f"1p2d_slots{slots}_seg{segment}_mixed32-256",
             "value": round(delivered / dt, 1), "unit": "tokens/sec",
@@ -98,6 +121,7 @@ def run(n_requests: int = 32, slots: int = 8, segment: int = 32) -> dict:
             "ttft_p95_ms": round(_pct(ttft, 95), 1),
             "tpot_p50_ms": round(_pct(tpot, 50), 2),
             "tpot_p95_ms": round(_pct(tpot, 95), 2),
+            "ttft_breakdown": ttft_breakdown,
             "methodology": "measured",    # client-clock SLOs, real wire
             "note": "disaggregated fleet over the native RPC plane: "
                     "route_submit -> health-trend placement -> prefill "

@@ -31,7 +31,10 @@ Family rules key on the metric NAME, which is itself part of the contract
 * ``*_route_*`` rows: the SLO pair PLUS ``n_decode_workers`` — a routed
   serving number is meaningless without the fleet size it was spread
   over (1 prefill + 2 decode pools is not comparable to a solo daemon;
-  benchmarks/serving_router.py);
+  benchmarks/serving_router.py) — PLUS ``ttft_breakdown``: the
+  phase-decomposed TTFT p50s (queued/prefill/ship/adopt, ms) from the
+  request-timeline ledger, so a routed TTFT regression names WHICH hop
+  moved instead of reopening the whole fabric;
 * ``*_fleet_*`` rows: ``recovery_windows`` + ``slo_recovered`` — a
   fleet-actor recovery number is the chaos bar itself: how many alert
   windows from kill to restored SLO, and whether the SLO actually
@@ -57,7 +60,8 @@ FAMILY_REQUIRED = {
     "_decode_": ("hbm_bw_util", "methodology", "plan_source"),
     "_serve_": ("ttft_p50_ms", "tpot_p50_ms", "methodology"),
     "_prefix_": ("hit_rate",),
-    "_route_": ("ttft_p50_ms", "tpot_p50_ms", "n_decode_workers"),
+    "_route_": ("ttft_p50_ms", "tpot_p50_ms", "n_decode_workers",
+                "ttft_breakdown"),
     "_fleet_": ("recovery_windows", "slo_recovered"),
 }
 

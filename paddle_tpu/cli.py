@@ -1263,7 +1263,9 @@ def cmd_obs_serve(args):
                               "events": h["events"],
                               "alerts": h["active"],
                               "health": h["health"],
-                              "actions": h.get("actions", [])})
+                              "actions": h.get("actions", []),
+                              "requests": h.get("requests", []),
+                              "exemplars": h.get("exemplars", [])})
             except (OSError, ConnectionError) as e:
                 # keep serving whatever dumps we do have; a master-only
                 # serve surfaces the outage as a 500 with the cause
@@ -1286,6 +1288,8 @@ def cmd_obs_serve(args):
                 merged.setdefault("health", {}).update(d["health"])
             if d.get("actions"):
                 merged.setdefault("actions", []).extend(d["actions"])
+            if d.get("exemplars"):
+                merged.setdefault("exemplars", []).extend(d["exemplars"])
         return merged
 
     srv = ObsHttpServer(provider, host=args.host, port=args.port).start()
@@ -1293,6 +1297,8 @@ def cmd_obs_serve(args):
     print(f"SERVING {srv.address[0]} {srv.address[1]}", flush=True)
     print(f"  http://{srv.address[0]}:{srv.address[1]}/metrics  (prometheus)")
     print(f"  http://{srv.address[0]}:{srv.address[1]}/trace    (chrome json)")
+    print(f"  http://{srv.address[0]}:{srv.address[1]}/requests (request "
+          f"timelines)")
     print(f"  http://{srv.address[0]}:{srv.address[1]}/summary")
     try:
         while True:
@@ -1393,6 +1399,65 @@ def cmd_obs_top(args):
         return 0
 
 
+def cmd_obs_trace(args):
+    """``paddle_tpu obs trace <submit_key>`` — print one request's
+    stitched cross-worker timeline: every phase record the fabric wrote
+    for that submit_key (admitted → queued/prefill/ship/adopt →
+    first_token → decode segments → done), legs from a mid-stream
+    re-route (``<key>#r<n>``) merged onto one clock, the phase breakdown
+    that reconciles with TTFT, and the dominant phase.
+
+    Sources: ``--input`` JSONL dumps (``--obs_out`` files, flight rings)
+    and/or ``--master host:port`` (the live aggregator's request store
+    via ``obs_health``). Passing a leg key resolves to its base request.
+    """
+    from . import obs
+    from .obs.requests import base_key, format_timeline, group_legs, stitch
+    inputs = list(args.input or ())
+    master = getattr(args, "master", None)
+    if not inputs and not master:
+        print("obs trace: pass --input dump.jsonl (repeatable) and/or "
+              "--master host:port", file=sys.stderr)
+        return 2
+    timelines = []
+    try:
+        for d in _read_obs_inputs(inputs):
+            timelines.extend(d.get("requests") or ())
+    except (OSError, ValueError) as e:
+        print(f"obs trace: cannot read dump: {e}", file=sys.stderr)
+        return 2
+    if master:
+        try:
+            addr = _parse_hostport(master)
+        except ValueError:
+            print(f"obs trace: --master must be host:port, got {master!r}",
+                  file=sys.stderr)
+            return 2
+        from .obs.aggregate import telemetry_client
+        client = telemetry_client(*addr)
+        try:
+            h = client.obs_health()
+            timelines.extend(h.get("requests") or ())
+        except (OSError, ConnectionError) as e:
+            print(f"obs trace: master {master} unreachable: {e}",
+                  file=sys.stderr)
+            if not timelines:
+                return 2
+        finally:
+            client.close()
+    groups = group_legs(timelines)
+    want = base_key(args.key)
+    legs = groups.get(want)
+    if not legs:
+        print(f"obs trace: no timeline for {args.key!r} "
+              f"({len(groups)} request(s) in the sources)", file=sys.stderr)
+        for k in sorted(groups)[:16]:
+            print(f"  known: {k}", file=sys.stderr)
+        return 1
+    print(format_timeline(stitch(legs)))
+    return 0
+
+
 def cmd_serve(args):
     """``paddle_tpu serve`` — the production serving daemon: a paged
     KV-cache continuous-batching engine behind the native RPC plane
@@ -1480,6 +1545,7 @@ def cmd_serve(args):
               file=sys.stderr)
         return 2
     host, port = daemon.address
+    _role_name_session(session, "decode", args.worker or f"serve-{port}")
     print(f"SERVING {host} {port}", flush=True)
     if args.router:
         try:
@@ -1518,8 +1584,9 @@ def cmd_serve(args):
         daemon.stop(drain_s=args.drain)
         if flight is not None:
             flight.disarm()
-        session.uninstall()
         if args.obs_out:
+            # save BEFORE uninstall: the dump captures the request ledger
+            # (per-request timelines) only while the plane is installed
             try:
                 session.save(args.obs_out)
                 print(f"observability dump written to {args.obs_out}",
@@ -1527,6 +1594,7 @@ def cmd_serve(args):
             except Exception as e:
                 print(f"warning: could not write obs dump: {e}",
                       file=sys.stderr)
+        session.uninstall()
     return 0
 
 
@@ -1535,6 +1603,18 @@ def _parse_hostport(s: str):
     if not host or not port.isdigit():
         raise ValueError(f"expected HOST:PORT, got {s!r}")
     return host, int(port)
+
+
+def _role_name_session(session, role, worker=None):
+    """Rename an installed ObsSession after its serving role (``router``,
+    ``prefill:<worker>``, ``decode:<worker>``) — the lane name the Chrome
+    exporter ranks router-above-prefill-above-decode and the worker id
+    merged request timelines stitch under. An explicit
+    PADDLE_TPU_OBS_PROCESS wins (operator override)."""
+    import os
+    if os.environ.get("PADDLE_TPU_OBS_PROCESS"):
+        return
+    session.process = f"{role}:{worker}" if worker else role
 
 
 def _serve_prefill(args, model, params, session, flight):
@@ -1569,6 +1649,7 @@ def _serve_prefill(args, model, params, session, flight):
               file=sys.stderr)
         return 2
     host, port = daemon.address
+    _role_name_session(session, "prefill", args.worker or f"prefill-{port}")
     print(f"SERVING {host} {port}", flush=True)
     try:
         epoch = daemon.join_router(_parse_hostport(args.router),
@@ -1598,8 +1679,9 @@ def _serve_prefill(args, model, params, session, flight):
             pass
     finally:
         daemon.stop()
-        _teardown()
         if args.obs_out:
+            # before _teardown: the dump captures the request ledger only
+            # while the plane is installed
             try:
                 session.save(args.obs_out)
                 print(f"observability dump written to {args.obs_out}",
@@ -1607,6 +1689,7 @@ def _serve_prefill(args, model, params, session, flight):
             except Exception as e:
                 print(f"warning: could not write obs dump: {e}",
                       file=sys.stderr)
+        _teardown()
     return 0
 
 
@@ -1626,6 +1709,7 @@ def cmd_route(args):
     from .serving import ServingRouter
 
     session = _obs.ObsSession().install()
+    _role_name_session(session, "router")
     try:
         router = ServingRouter(args.host, args.port, ttl=args.ttl,
                                scrape_interval_s=args.scrape_interval
@@ -1651,8 +1735,9 @@ def cmd_route(args):
             pass
     finally:
         router.stop()
-        session.uninstall()
         if args.obs_out:
+            # before uninstall: the dump captures the request ledger only
+            # while the plane is installed
             try:
                 session.save(args.obs_out)
                 print(f"observability dump written to {args.obs_out}",
@@ -1660,6 +1745,7 @@ def cmd_route(args):
             except Exception as e:
                 print(f"warning: could not write obs dump: {e}",
                       file=sys.stderr)
+        session.uninstall()
     return 0
 
 
@@ -2045,6 +2131,18 @@ def main(argv=None) -> int:
     osv.add_argument("--port", type=int, default=0,
                      help="0 binds an ephemeral port (printed on start)")
     osv.set_defaults(fn=cmd_obs_serve)
+    otr = obsub.add_parser("trace", help="print one request's stitched "
+                                         "cross-worker timeline (phases, "
+                                         "re-route legs, TTFT breakdown)")
+    otr.add_argument("key", help="submit_key to trace (a re-route leg key "
+                                 "like KEY#r1 resolves to its base request)")
+    otr.add_argument("--input", action="append",
+                     help="JSONL dump(s) holding request timelines "
+                          "(--obs_out files, flight rings)")
+    otr.add_argument("--master", default=None,
+                     help="host:port of a live MasterServer — trace from "
+                          "its aggregated request store")
+    otr.set_defaults(fn=cmd_obs_trace)
     ot = obsub.add_parser("top", help="live per-worker fleet table: "
                                       "goodput, mfu, queue, straggler "
                                       "score, active alerts")

@@ -206,8 +206,18 @@ class FleetActor:
             if delta > 0:
                 demands[pop.name] = delta
             elif delta < 0:
-                self._drain_surplus(pop, ob, -delta, now, committed,
-                                    reason=self._drain_reason(pop, rec))
+                # a spawn still inside its grace window counts toward
+                # `eff` (it is capacity in flight) but is NOT a drainable
+                # worker: clamp the drain to the LIVE surplus so a
+                # `leave` racing a very slow boot never double-counts the
+                # unjoined spawn and retires an extra live member. The
+                # spawn either joins (next tick re-evaluates the real
+                # surplus) or its grace reaps it.
+                surplus_live = max(0, (n_live - draining_live) - desired)
+                want = min(-delta, surplus_live)
+                if want > 0:
+                    self._drain_surplus(pop, ob, want, now, committed,
+                                        reason=self._drain_reason(pop, rec))
         self._spawn_demand(demands, urgent, effective, observations, now,
                            committed)
         self.journal.extend(committed)

@@ -46,6 +46,7 @@ __all__ = [
     "instant", "server_span", "wire_context", "retry_observer",
     "FlightRecorder", "flight_recorder", "flight_dump", "NullSpan",
     "NULL_SPAN", "context", "goodput", "roofline", "health", "alerts",
+    "req_phase", "request_ledger", "ensure_request_ledger",
 ]
 
 #: process-global default registry — what an installed session reports into
@@ -66,9 +67,10 @@ def _install(s: ObsSession) -> None:
 
 
 def _uninstall(s: ObsSession) -> None:
-    global _SESSION
+    global _SESSION, _REQUESTS
     if _SESSION is s:
         _SESSION = None
+        _REQUESTS = None
 
 
 def install(registry: Optional[MetricsRegistry] = None, **kw) -> ObsSession:
@@ -77,8 +79,9 @@ def install(registry: Optional[MetricsRegistry] = None, **kw) -> ObsSession:
 
 
 def uninstall() -> None:
-    global _SESSION
+    global _SESSION, _REQUESTS
     _SESSION = None
+    _REQUESTS = None
 
 
 def is_active() -> bool:
@@ -174,6 +177,53 @@ def flight_dump(reason: str, final: bool = False) -> Optional[str]:
     if f is None:
         return None
     return f.dump(reason, final=final)
+
+
+# -- per-request timeline ledger ------------------------------------------------
+
+#: the installed RequestLedger (obs/requests.py); None = no timeline
+#: capture. Cleared alongside _SESSION so test isolation is automatic.
+_REQUESTS = None
+
+
+def _set_requests(led) -> None:
+    global _REQUESTS
+    _REQUESTS = led
+
+
+# named request_ledger, NOT requests: the bare name would shadow the
+# paddle_tpu.obs.requests submodule attribute this package also exposes
+def request_ledger():
+    return _REQUESTS
+
+
+def ensure_request_ledger(ident: Optional[str] = None):
+    """Install a default :class:`~paddle_tpu.obs.requests.RequestLedger`
+    iff a session is installed and none is present yet — what the
+    serving daemons/router call at construction so per-request timelines
+    are always-on whenever the obs plane is. Returns the active ledger,
+    or None when the plane is off."""
+    global _REQUESTS
+    if _SESSION is None:
+        return None
+    if _REQUESTS is None:
+        from .requests import RequestLedger
+        _REQUESTS = RequestLedger(ident=ident or _SESSION.process)
+    return _REQUESTS
+
+
+def req_phase(key, phase: str, dur: Optional[float] = None,
+              **extra) -> None:
+    """Record a phase on the installed request ledger. The serving fast
+    path calls this per request (not per token): same `_SESSION is None`
+    one-load-one-branch discipline as the metric hooks, plus a None-key
+    guard so un-keyed engine use (tests, embedded) records nothing."""
+    if _SESSION is None:
+        return
+    led = _REQUESTS
+    if led is None or key is None:
+        return
+    led.phase(key, phase, dur=dur, **extra)
 
 
 def retry_observer(subsystem: str):

@@ -153,6 +153,11 @@ class ClusterAggregator:
         self.eval_interval_s = float(eval_interval_s)
         self._last_eval = float("-inf")
         self._health_snapshot: Dict[str, Dict[str, Any]] = {}
+        # per-request timeline aggregation (obs/requests.py): workers'
+        # ledger exports land here; burn-rate alert transitions get the
+        # slowest-K exemplars attached at evaluation time
+        from .requests import RequestStore
+        self.requests = RequestStore(clock=self._clock)
         #: committed fleet-actor actions (ISSUE 18), newest last — what
         #: lets an operator tell "recommendation held" from "actor acted"
         self.actions: deque = deque(maxlen=64)
@@ -233,7 +238,23 @@ class ClusterAggregator:
                 record("cluster.health_heartbeat_jitter", v, w)
         with self._lock:
             self._health_snapshot = snap
-        self.alerts.evaluate(now)
+        transitions = self.alerts.evaluate(now)
+        if transitions:
+            # answer "burn driven by WHAT" at the moment it fires: the
+            # slowest-K stitched timelines decorate each serving SLO
+            # transition IN PLACE — the same dicts live in the engine's
+            # bounded events deque, so /alerts and the flight ring see
+            # the exemplars for free
+            ex = None
+            for ev in transitions:
+                args = ev.get("args") or {}
+                if args.get("state") != "fired" or not str(
+                        args.get("metric", "")).startswith("serving."):
+                    continue
+                if ex is None:
+                    ex = self.requests.exemplars()
+                if ex:
+                    args["exemplars"] = ex
         return snap
 
     def forget_worker(self, worker: str) -> None:
@@ -243,6 +264,15 @@ class ClusterAggregator:
         instead of freezing a dead incarnation's alert as active."""
         self.health.forget(worker)
         self.history.drop_worker(worker)
+        # completed requests lose the departed worker's legs; in-flight
+        # ones keep them — their re-routed remainder still needs to
+        # stitch against what this worker recorded before it died
+        self.requests.forget_worker(worker)
+
+    def push_requests(self, worker: str, timelines: Any) -> int:
+        """Absorb one worker's request-timeline export (the scrape pump
+        and the daemons' loopback push land here); wire-tolerant."""
+        return self.requests.push(str(worker), timelines)
 
     def note_action(self, entry: Dict[str, Any]) -> Dict[str, Any]:
         """Journal one COMMITTED autoscale action (the ``act_report``
@@ -376,7 +406,8 @@ class ObsHttpServer:
     only; any other method is 405; unknown paths 404.
     """
 
-    ROUTES = ("/metrics", "/trace", "/summary", "/alerts", "/")
+    ROUTES = ("/metrics", "/trace", "/summary", "/alerts", "/requests",
+              "/")
 
     def __init__(self, provider: Callable[[], Dict[str, Any]],
                  host: str = "127.0.0.1", port: int = 0):
@@ -384,6 +415,7 @@ class ObsHttpServer:
 
         from .export import chrome_trace, prometheus_text, summary
         from .health import health_table
+        from .requests import group_legs, stitch
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -412,6 +444,24 @@ class ObsHttpServer:
                             {"active": dump.get("alerts") or [],
                              "events": events,
                              "actions": dump.get("actions") or []},
+                            indent=1).encode()
+                        ctype = "application/json"
+                    elif path == "/requests":
+                        # raw leg timelines ride the dump ("requests"
+                        # key: session dumps, merged files, or the
+                        # master's store) — stitched here so every
+                        # consumer sees one timeline per request
+                        dump = outer.provider()
+                        reqs = []
+                        for legs in group_legs(
+                                dump.get("requests")).values():
+                            st = stitch(legs)
+                            if st is not None:
+                                reqs.append(st)
+                        reqs.sort(key=lambda s: s.get("t0_unix", 0.0))
+                        body = json.dumps(
+                            {"requests": reqs,
+                             "exemplars": dump.get("exemplars") or []},
                             indent=1).encode()
                         ctype = "application/json"
                     elif path in ("/summary", "/"):

@@ -416,6 +416,33 @@ CATALOGUE: Dict[str, Tuple[str, ...]] = {
     "serving.ship_bytes_total": ("counter", "payload bytes exported for "
                                             "shipping (pre-chunking, "
                                             "pre-base64)"),
+    "serving.ship_chunks_total": ("counter", "wire chunks emitted on the "
+                                             "ship send edge (post-"
+                                             "chunking; what the ship "
+                                             "phase's timeline duration "
+                                             "is spent on)"),
+    "serving.ship_chunk_bytes_total": ("counter", "raw chunk bytes on the "
+                                                  "ship send edge (post "
+                                                  "srv.ship fault filter, "
+                                                  "pre-base64)"),
+    # per-request timelines (obs/requests.py): the phase label is the
+    # BOUNDED attributed-phase enum (queued/scheduled/prefill/ship/adopt/
+    # decode), never a request key — L005-safe by construction
+    "serving.phase_seconds": ("histogram", "per-request phase durations "
+                                           "from the timeline ledger; the "
+                                           "per-request phase sum "
+                                           "reconciles with observed "
+                                           "TTFT + decode wall (docs/"
+                                           "design/observability.md "
+                                           "'Request timelines'), labels: "
+                                           "phase (bounded enum)",
+                              ("phase",)),
+    "serving.exemplars_total": ("counter", "slowest-K timeline exemplars "
+                                           "captured by the aggregator's "
+                                           "request store, labels: phase "
+                                           "(the exemplar's dominant "
+                                           "phase, bounded enum)",
+                                ("phase",)),
     "serving.adopted_total": ("counter", "shipped slots adopted into this "
                                          "pool (decode side, "
                                          "PagePool.adopt_slot) — each is "
@@ -503,6 +530,14 @@ SPANS: Dict[str, str] = {
                        "placement (args: batch)",
     "serving.segment": "one batched decode segment across live slots "
                        "(args: live)",
+    "serving.ship": "client side of one KV shipment: every srv_ship chunk "
+                    "RPC for one request (args: xid, bytes, key)",
+    "srv_ship": "decode-side landing of one ship chunk (args: xid, seq; "
+                "remote = the prefill worker's rpc.call span — the "
+                "prefill->decode hop's flow arrow)",
+    "srv_adopt": "decode-side adoption of a reassembled shipment into the "
+                 "engine (args: xid, key; remote = the prefill worker's "
+                 "rpc.call span)",
     "ckpt.publish": "atomic pass-dir publication (args: pass_id)",
     "ckpt.member": "one member write+fsync (args: member, bytes)",
     "ckpt.fsync": "file or directory fsync",

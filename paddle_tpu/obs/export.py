@@ -4,8 +4,9 @@ All four read ONE shape — the ``dump`` dict produced by
 :meth:`ObsSession.dump` and round-tripped through the JSONL sink::
 
     {"meta":    {...},
-     "metrics": [MetricsRegistry.collect() samples],
-     "events":  [Tracer events (spans + instants)]}
+     "metrics":  [MetricsRegistry.collect() samples],
+     "events":   [Tracer events (spans + instants)],
+     "requests": [request timelines (obs/requests.py), when a ledger ran]}
 
 so the in-process path (``session.export_chrome()``) and the offline path
 (``paddle_tpu obs export --input run.jsonl``) are the same code.
@@ -42,6 +43,8 @@ def jsonl_lines(dump: Dict[str, Any]):
         yield json.dumps({"kind": "metric", **s})
     for e in dump.get("events", ()):
         yield json.dumps(e)
+    for tl in dump.get("requests", ()):
+        yield json.dumps({"kind": "request", **tl})
 
 
 def write_jsonl(path: str, dump: Dict[str, Any]) -> str:
@@ -62,6 +65,7 @@ def read_jsonl(path: str) -> Dict[str, Any]:
     meta: Dict[str, Any] = {}
     metrics: List[Dict[str, Any]] = []
     events: List[Dict[str, Any]] = []
+    requests: List[Dict[str, Any]] = []
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -80,7 +84,12 @@ def read_jsonl(path: str) -> Dict[str, Any]:
                 metrics.append(rec)
             elif kind in ("span", "instant"):
                 events.append({"kind": kind, **rec})
-    return {"meta": meta, "metrics": metrics, "events": events}
+            elif kind == "request":
+                requests.append(rec)
+    out = {"meta": meta, "metrics": metrics, "events": events}
+    if requests:
+        out["requests"] = requests
+    return out
 
 
 # -- multi-process merge --------------------------------------------------------
@@ -113,6 +122,7 @@ def merge_dumps(dumps: Iterable[Dict[str, Any]],
     meta: Dict[str, Any] = {"merged": len(dumps), "processes": {}}
     metrics: List[Dict[str, Any]] = []
     events: List[Dict[str, Any]] = []
+    requests: List[Dict[str, Any]] = []
     # per-process tracer clocks have private epochs; when EVERY dump maps
     # its epoch to the wall clock (meta.clock_origin_unix), shift events
     # onto one shared timeline so the stitched trace interleaves
@@ -156,8 +166,19 @@ def merge_dumps(dumps: Iterable[Dict[str, Any]],
             p = e.get("pid")
             if p is not None and str(p) not in meta["processes"]:
                 meta["processes"][str(p)] = wid
+        for tl in d.get("requests", ()):
+            if isinstance(tl, dict):
+                # stamp the recording process so stitch() can name which
+                # worker ran each leg; a timeline a router aggregated on a
+                # worker's behalf keeps the id the router stamped
+                if not tl.get("worker"):
+                    tl = dict(tl, worker=wid)
+                requests.append(tl)
     events.sort(key=lambda e: e.get("ts", 0.0))
-    return {"meta": meta, "metrics": metrics, "events": events}
+    out = {"meta": meta, "metrics": metrics, "events": events}
+    if requests:
+        out["requests"] = requests
+    return out
 
 
 # -- Chrome trace_event ---------------------------------------------------------
@@ -220,8 +241,12 @@ def chrome_trace(dump: Dict[str, Any]) -> Dict[str, Any]:
             continue
         fid = f"{r.get('pid')}:{r.get('span')}:{e.get('pid', 0)}:{e['id']}"
         # bind the start step just inside the client slice so Chrome
-        # attaches it to that slice, and the finish to the server slice
-        flow_common = {"name": "rpc", "cat": "rpc", "id": fid}
+        # attaches it to that slice, and the finish to the server slice.
+        # Named serving hops (srv_ship, srv_adopt) keep their span name so
+        # the prefill→decode handoff arrows read as what they are; generic
+        # dispatch edges stay "rpc".
+        fname = e["name"] if str(e["name"]).startswith("srv_") else "rpc"
+        flow_common = {"name": fname, "cat": "rpc", "id": fid}
         flows_ts = src["ts"] * 1e6 + min(1.0, src.get("dur", 0.0) * 1e6 / 2)
         out.append({**flow_common, "ph": "s", "ts": flows_ts,
                     "pid": src.get("pid", 0), "tid": src.get("tid", 0)})
@@ -256,8 +281,22 @@ def chrome_trace(dump: Dict[str, Any]) -> Dict[str, Any]:
             f"paddle_tpu pid {p}"
         out.append({"name": "process_name", "ph": "M", "pid": p, "tid": 0,
                     "args": {"name": name}})
+        rank = _role_sort_index(name)
+        if rank is not None:
+            # serving-role lanes read top-to-bottom in request order:
+            # router above the prefill tier above the decode tier
+            out.append({"name": "process_sort_index", "ph": "M", "pid": p,
+                        "tid": 0, "args": {"sort_index": rank}})
     return {"traceEvents": out, "displayTimeUnit": "ms",
             "otherData": meta}
+
+
+def _role_sort_index(process_name: str) -> Optional[int]:
+    """Lane rank for serving-role process names (``router``,
+    ``prefill:<id>``, ``decode:<id>``) — None for everything else so
+    non-serving dumps keep Chrome's default (pid-ordered) layout."""
+    role = str(process_name).split(":", 1)[0]
+    return {"router": 0, "prefill": 1, "decode": 2}.get(role)
 
 
 # -- Prometheus text format -----------------------------------------------------

@@ -100,9 +100,16 @@ class ObsSession:
 
     def dump(self) -> Dict[str, Any]:
         """The canonical export shape (see obs/export.py)."""
-        return {"meta": self.meta(),
-                "metrics": self.registry.collect(),
-                "events": self.tracer.snapshot()}
+        out = {"meta": self.meta(),
+               "metrics": self.registry.collect(),
+               "events": self.tracer.snapshot()}
+        from . import request_ledger
+        led = request_ledger()
+        if led is not None:
+            # request timelines ride every dump artifact (flight rings,
+            # --obs_out files), so obs trace works from files alone
+            out["requests"] = led.export()
+        return out
 
     def save(self, path: str) -> str:
         """Persist as JSONL — the artifact ``paddle_tpu obs`` consumes."""

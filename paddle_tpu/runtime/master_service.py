@@ -483,7 +483,11 @@ class MasterServer:
             return {"ok": True, "health": agg.health_snapshot(),
                     "active": agg.alerts.active(),
                     "events": agg.alerts.recent_events(),
-                    "actions": agg.recent_actions()}
+                    "actions": agg.recent_actions(),
+                    # raw request-timeline legs + slow exemplars (obs/
+                    # requests.py): obs trace / obs serve stitch them
+                    "requests": agg.requests.export_legs(),
+                    "exemplars": agg.requests.exemplars()}
         if op == "set_dataset":
             self.master.set_dataset(req["payloads"])
             return {"ok": True}
@@ -720,8 +724,10 @@ class MasterClient(_RpcClient):
     def obs_health(self):
         """The fleet health view (ISSUE 15): ``{"health": per-worker
         derived health, "active": firing alerts, "events": recent alert
-        transitions, "actions": committed autoscale actions (ISSUE 18)}``
-        — what ``paddle_tpu obs top --master`` renders."""
+        transitions, "actions": committed autoscale actions (ISSUE 18),
+        "requests": raw request-timeline legs, "exemplars": the
+        slowest-K stitched timelines (ISSUE 19)}`` — what ``paddle_tpu
+        obs top/trace --master`` render."""
         r = self._call({"op": "obs_health"})
         if not r.get("ok"):
             raise ConnectionError(
@@ -729,4 +735,6 @@ class MasterClient(_RpcClient):
         return {"health": r.get("health") or {},
                 "active": list(r.get("active", ())),
                 "events": list(r.get("events", ())),
-                "actions": list(r.get("actions", ()))}
+                "actions": list(r.get("actions", ())),
+                "requests": list(r.get("requests", ())),
+                "exemplars": list(r.get("exemplars", ()))}

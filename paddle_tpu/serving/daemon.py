@@ -128,6 +128,19 @@ class _RouterMember:
         return handler
 
 
+def _export_requests(req) -> list:
+    """Wire body of the ``srv_requests`` op: this process's recent
+    request timelines (empty when the obs plane is off)."""
+    led = obs.request_ledger()
+    if led is None:
+        return []
+    try:
+        n = int(req.get("n", 128))
+    except (TypeError, ValueError):
+        n = 128
+    return led.export(n=max(1, min(n, 1024)))
+
+
 class ServingDaemon(_RouterMember):
     """Long-lived serving process: engine + RPC surface + telemetry push.
 
@@ -146,6 +159,7 @@ class ServingDaemon(_RouterMember):
                        ("srv_poll", self._srv_poll),
                        ("srv_cancel", self._srv_cancel),
                        ("srv_stats", self._srv_stats),
+                       ("srv_requests", self._srv_requests),
                        ("srv_ship_pages", self._srv_ship_pages),
                        ("srv_adopt_pages", self._srv_adopt_pages)):
             self.server.register_op(op, self._stamped(fn))
@@ -153,6 +167,9 @@ class ServingDaemon(_RouterMember):
         # set, so the daemon's own TTFT/TPOT pushes are alertable at the
         # engine's configured targets (obs serve /alerts, obs_health)
         self.server.aggregator.alerts.add_rules(self.engine.alert_rules())
+        # per-request timeline capture is always-on whenever the obs
+        # plane is (no-op otherwise): engine phases key on submit_key
+        obs.ensure_request_ledger()
         self._obs_interval = obs_interval_s
         self._stop = threading.Event()
         self._draining = threading.Event()
@@ -229,6 +246,12 @@ class ServingDaemon(_RouterMember):
             try:
                 self.server.aggregator.push(
                     "serving", wire_safe_samples(s.registry.collect()))
+                led = obs.request_ledger()
+                if led is not None:
+                    # same loopback: request timelines join the local
+                    # aggregator's store for obs_health / /requests
+                    self.server.aggregator.push_requests(
+                        "serving", led.export(n=256))
             except Exception:
                 pass    # telemetry must never take the daemon down
 
@@ -292,12 +315,14 @@ class ServingDaemon(_RouterMember):
             eos = req.get("eos_id")
             timeout = req.get("timeout_s")
             prefix = req.get("prefix_len")
+            skey = req.get("submit_key")
             rid = self.engine.submit(
                 prompt, max_new, eos_id=None if eos is None else int(eos),
                 timeout_s=None if timeout is None else float(timeout),
                 tenant=str(req.get("tenant", "default")),
                 slo=str(req.get("slo", "interactive")),
-                prefix_len=None if prefix is None else int(prefix))
+                prefix_len=None if prefix is None else int(prefix),
+                submit_key=None if skey is None else str(skey))
         except Overloaded as e:
             return {"ok": False, "error": f"overloaded: {e}",
                     "code": "overloaded", "retry_after_s": e.retry_after_s}
@@ -337,6 +362,12 @@ class ServingDaemon(_RouterMember):
         stats["role"] = "decode"
         return {"ok": True, **stats}
 
+    def _srv_requests(self, req):
+        # the router's scrape pump pulls recent request timelines here so
+        # a kill -9'd worker's phases survive on the router's store —
+        # re-route stitching depends on it (obs/requests.py)
+        return {"ok": True, "requests": _export_requests(req)}
+
     # -- disaggregation receive side (KV-page adoption) --------------------
     def _srv_ship_pages(self, req):
         try:
@@ -347,7 +378,8 @@ class ServingDaemon(_RouterMember):
                     "error": "srv_ship_pages needs xid, seq, total, "
                     "data, crc"}
         try:
-            with self._ship_lock:
+            with obs.server_span("srv_ship", req.get("trace"), xid=xid,
+                                 seq=seq), self._ship_lock:
                 asm = self._ships.get(xid)
                 if asm is None:
                     asm = _ship.ChunkAssembler(total)
@@ -376,7 +408,8 @@ class ServingDaemon(_RouterMember):
         if key is None:
             if self._draining.is_set():
                 return self._refuse_draining()
-            return self._do_adopt(req, xid)
+            with obs.server_span("srv_adopt", req.get("trace"), xid=xid):
+                return self._do_adopt(req, xid)
         with self._submit_lock:
             # same idempotency ladder as srv_submit: a replay (lost reply,
             # OR a second prefill worker re-shipping after the first died
@@ -390,7 +423,11 @@ class ServingDaemon(_RouterMember):
                         if not k.startswith("_")}
             if self._draining.is_set():
                 return self._refuse_draining()
-            resp = self._do_adopt(req, xid)
+            # the named server-side endpoint of the ship→adopt hop: the
+            # merged Chrome trace draws its flow arrow into this span
+            with obs.server_span("srv_adopt", req.get("trace"), xid=xid,
+                                 key=str(key)):
+                resp = self._do_adopt(req, xid)
             if resp.get("ok"):
                 self._submit_seen[str(key)] = dict(resp, _prefix_len=None)
                 while len(self._submit_seen) > 4096:
@@ -429,6 +466,7 @@ class ServingDaemon(_RouterMember):
                     "be built alike"}
         eos = req.get("eos_id")
         timeout = req.get("timeout_s")
+        skey = req.get("submit_key")
         try:
             rid = self.engine.submit_prefilled(
                 int(manifest["plen"]), int(manifest["first"]), arrays,
@@ -436,7 +474,8 @@ class ServingDaemon(_RouterMember):
                 eos_id=None if eos is None else int(eos),
                 timeout_s=None if timeout is None else float(timeout),
                 tenant=str(req.get("tenant", "default")),
-                slo=str(req.get("slo", "interactive")))
+                slo=str(req.get("slo", "interactive")),
+                submit_key=None if skey is None else str(skey))
         except Overloaded as e:
             # keep the reassembled chunks: the sender's backoff retry
             # re-adopts without re-shipping the payload
@@ -474,8 +513,10 @@ class PrefillDaemon(_RouterMember):
         self.pool = pool
         self.server = MasterServer(host, port)
         for op, fn in (("srv_prefill", self._srv_prefill),
-                       ("srv_stats", self._srv_stats)):
+                       ("srv_stats", self._srv_stats),
+                       ("srv_requests", self._srv_requests)):
             self.server.register_op(op, self._stamped(fn))
+        obs.ensure_request_ledger()
         self._pool_lock = threading.Lock()
         self._busy: set = set()
         self._submit_lock = threading.Lock()
@@ -514,6 +555,9 @@ class PrefillDaemon(_RouterMember):
         return {"ok": True, "role": "prefill", "slots_live": live,
                 "queue_depth": 0,
                 "rpc_conns": self.server.active_connections()}
+
+    def _srv_requests(self, req):
+        return {"ok": True, "requests": _export_requests(req)}
 
     def _srv_prefill(self, req):
         key = req.get("submit_key")
@@ -558,6 +602,7 @@ class PrefillDaemon(_RouterMember):
                     str(req.get("tenant", "default")),
                     str(req.get("slo", "interactive")),
                     None if prefix is None else int(prefix))
+        t_pf = time.monotonic()
         try:
             with self._pool_lock:
                 self.pool.validate(r)
@@ -588,6 +633,11 @@ class PrefillDaemon(_RouterMember):
         except (ValueError, TypeError) as e:
             return {"ok": False, "error": str(e),
                     "code": "invalid_argument"}
+        # explicit dur (this worker measured the sub-interval itself): on
+        # a shared in-process ledger a telescoped gap would mis-bill the
+        # router's forward hop to the prefill phase
+        obs.req_phase(key, "prefill", dur=time.monotonic() - t_pf,
+                      plen=int(prompt.size), hit=bool(plan.offset > 0))
         # ship + adopt OUTSIDE the pool lock: the wire hop must not
         # serialize other admissions
         client = self._decode_client(decode_host, decode_port)
@@ -601,16 +651,25 @@ class PrefillDaemon(_RouterMember):
             adopt_req["timeout_s"] = float(req["timeout_s"])
         if key is not None:
             adopt_req["submit_key"] = key
+        t_ship = time.monotonic()
         try:
-            for _seq, _total, frame in _ship.iter_chunks(payload):
-                rc = client._call(dict(frame, op="srv_ship_pages",
-                                       xid=xid))
-                if not rc.get("ok"):
-                    return {"ok": False,
-                            "code": rc.get("code", "data_loss"),
-                            "error": f"decode worker refused chunk "
-                            f"{_seq}/{_total}: {rc.get('error')}"}
-            ra = client._call(adopt_req)
+            # the client-side endpoint of the ship→adopt hop: chunk and
+            # adopt RPCs nest under this span, so the merged Chrome trace
+            # reads prefill lane → flow arrow → decode lane
+            with obs.span("serving.ship", xid=xid,
+                          bytes=len(payload), key=key or ""):
+                for _seq, _total, frame in _ship.iter_chunks(payload):
+                    rc = client._call(dict(frame, op="srv_ship_pages",
+                                           xid=xid))
+                    if not rc.get("ok"):
+                        return {"ok": False,
+                                "code": rc.get("code", "data_loss"),
+                                "error": f"decode worker refused chunk "
+                                f"{_seq}/{_total}: {rc.get('error')}"}
+                obs.req_phase(key, "ship",
+                              dur=time.monotonic() - t_ship,
+                              bytes=len(payload))
+                ra = client._call(adopt_req)
         except ConnectionError as e:
             return {"ok": False, "code": "unavailable",
                     "error": f"decode worker {decode_host}:{decode_port} "
@@ -712,6 +771,16 @@ class ServingClient(_RpcClient):
             raise self._conn_err(
                 str(r.get("error", f"{self._op_stats} failed")))
         return {k: v for k, v in r.items() if k != "ok"}
+
+    def serving_requests(self, n: int = 128) -> list:
+        """The worker's recent request timelines (srv_requests) — what
+        the router's scrape pump aggregates for stitching."""
+        r = self._call({"op": "srv_requests", "n": int(n)})
+        if not r.get("ok"):
+            raise self._conn_err(
+                str(r.get("error", "srv_requests failed")))
+        rq = r.get("requests")
+        return rq if isinstance(rq, list) else []
 
     def submit_with_backoff(self, prompt, max_new: int, *,
                             eos_id: Optional[int] = None,

@@ -59,7 +59,7 @@ class _Rec:
     __slots__ = ("rid", "prompt", "eos_id", "left", "deadline", "t_submit",
                  "t_first", "t_done", "tokens", "done", "reason", "slot",
                  "skip", "cancelled", "collected", "tenant", "slo",
-                 "prefix_len", "ship")
+                 "prefix_len", "ship", "key")
 
     def __init__(self, rid, prompt, left, eos_id, deadline, t_submit,
                  tenant="default", slo="interactive", prefix_len=None):
@@ -78,6 +78,9 @@ class _Rec:
         #: a shipped admission's payload (disaggregation): dict with plen,
         #: first, arrays, need — consumed (and dropped) at adoption
         self.ship = None
+        #: the fabric-wide submit_key this request's timeline records
+        #: under (obs/requests.py); None = no timeline (embedded use)
+        self.key: Optional[str] = None
 
 
 class ServingEngine:
@@ -164,7 +167,8 @@ class ServingEngine:
     def submit(self, prompt, max_new: int, *, eos_id: Optional[int] = None,
                timeout_s: Optional[float] = None, tenant: str = "default",
                slo: str = "interactive",
-               prefix_len: Optional[int] = None) -> int:
+               prefix_len: Optional[int] = None,
+               submit_key: Optional[str] = None) -> int:
         """Queue one request; returns its rid. Raises ValueError for a
         malformed/unservable request (structured at submit time — the
         validation-hardening contract, now covering tenant labels, SLO
@@ -176,7 +180,9 @@ class ServingEngine:
         distinct values per engine); ``slo`` picks the weighted-fair
         scheduling class; ``prefix_len`` declares how many leading prompt
         tokens are a shared prefix worth caching (matching is always
-        attempted — the declaration only gates index insertion)."""
+        attempted — the declaration only gates index insertion);
+        ``submit_key`` keys this request's phase timeline on the obs
+        request ledger (None = record nothing)."""
         r = Request(-1, np.asarray(prompt), int(max_new), eos_id,
                     tenant=str(tenant), slo=str(slo), prefix_len=prefix_len)
         self.pool.validate(r)                  # mutates r.prompt to int32
@@ -207,17 +213,21 @@ class ServingEngine:
             self._next_rid += 1
             rec = _Rec(rid, r.prompt, left, eos_id, deadline, now,
                        tenant=r.tenant, slo=r.slo, prefix_len=r.prefix_len)
+            rec.key = submit_key
             self._recs[rid] = rec
             self._queues[r.slo].append(rec)
             obs.gauge_set("serving.queue_depth", self._queue_len_locked())
             self._wake.notify_all()
-            return rid
+        obs.req_phase(submit_key, "admitted", tenant=str(tenant),
+                      slo=str(slo))
+        return rid
 
     def submit_prefilled(self, plen: int, first: int, arrays, *,
                          max_new: int, eos_id: Optional[int] = None,
                          timeout_s: Optional[float] = None,
                          tenant: str = "default",
-                         slo: str = "interactive") -> int:
+                         slo: str = "interactive",
+                         submit_key: Optional[str] = None) -> int:
         """Queue a SHIPPED admission (disaggregation): the prompt was
         prefilled on another worker and arrives as ``arrays`` — the slot's
         page rows for every pool array (serving/ship.py ``unpack`` output)
@@ -261,13 +271,16 @@ class ServingEngine:
             self._next_rid += 1
             rec = _Rec(rid, None, left, eos_id, deadline, now,
                        tenant=r.tenant, slo=r.slo)
+            rec.key = submit_key
             rec.ship = {"plen": plen, "first": int(first),
                         "arrays": arrays, "need": need}
             self._recs[rid] = rec
             self._queues[r.slo].append(rec)
             obs.gauge_set("serving.queue_depth", self._queue_len_locked())
             self._wake.notify_all()
-            return rid
+        obs.req_phase(submit_key, "admitted", tenant=str(tenant),
+                      slo=str(slo), shipped=True)
+        return rid
 
     def poll(self, rid: int, cursor: int = 0):
         """Tokens generated so far from ``cursor`` on: returns
@@ -484,6 +497,9 @@ class ServingEngine:
                     self._live[slot] = rec
                     adopts.append((slot, rec))
                     members.append(rec)
+                    if rec.key is not None:
+                        # queue wait of a shipped admission ends here
+                        obs.req_phase(rec.key, "scheduled", slot=slot)
                     continue
                 plan = self.pool.plan_admission(
                     rec.prompt, rec.left, tenant=rec.tenant,
@@ -501,8 +517,11 @@ class ServingEngine:
                 self._live[slot] = rec
                 group.append((slot, plan))
                 members.append(rec)
+                if rec.key is not None:
+                    obs.req_phase(rec.key, "queued", slot=slot)
         if not group and not adopts:
             return 0
+        adopted = {rec.rid for _, rec in adopts}
         with obs.span("serving.prefill", batch=len(group) + len(adopts)), \
                 maybe_bucket(self._gp, "device"):
             first = self.pool.admit(group)      # device work, lock released
@@ -520,6 +539,14 @@ class ServingEngine:
                 rec.t_first = now
                 obs.observe("serving.ttft_seconds", now - rec.t_submit,
                             tenant=rec.tenant)
+                if rec.key is not None:
+                    # telescoped dur: device prefill (or local adoption)
+                    # wall since the queued/scheduled record above
+                    obs.req_phase(rec.key,
+                                  "adopt" if rec.rid in adopted
+                                  else "prefill")
+                    obs.req_phase(rec.key, "first_token",
+                                  ttft_s=round(now - rec.t_submit, 6))
                 tok = first[rec.slot]
                 if rec.eos_id is not None and tok == rec.eos_id:
                     self._release_locked(rec, "eos")
@@ -555,6 +582,9 @@ class ServingEngine:
                                                    rec.eos_id)
                 rec.tokens.extend(int(t) for t in take)
                 obs.count("decode.tokens_total", len(take), route="serve")
+                if rec.key is not None and len(take):
+                    # consecutive segments fold into one ledger record
+                    obs.req_phase(rec.key, "decode", n=len(take))
                 rec.left -= len(take)
                 if done:
                     self._release_locked(rec, reason)
@@ -572,6 +602,10 @@ class ServingEngine:
         rec.t_done = self._clock()
         obs.count("serving.requests_total", outcome=reason,
                   tenant=rec.tenant)
+        if rec.key is not None:
+            obs.req_phase(rec.key,
+                          "cancel" if reason == "cancelled" else "done",
+                          reason=reason, tokens=len(rec.tokens))
         if rec.t_first is not None and len(rec.tokens) > 1:
             # time-per-output-token over the tokens AFTER the first (TTFT
             # owns the first) — the SLO pair dashboards alert on

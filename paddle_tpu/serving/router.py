@@ -126,6 +126,10 @@ class ServingRouter:
                        ("route_cancel", self._route_cancel),
                        ("route_stats", self._route_stats)):
             self.server.register_op(op, self._stamped(fn))
+        # per-request timelines: the router records its own phases AND
+        # aggregates every worker's (scrape pump + RequestStore) so a
+        # re-routed request stitches across workers (obs/requests.py)
+        obs.ensure_request_ledger()
         self._scrape_interval = scrape_interval_s
         self._max_reroutes = max_reroutes
         self._lock = threading.Lock()
@@ -248,6 +252,19 @@ class ServingRouter:
                                   float(st.get("queue_depth", 0)))
                 hist.record_value(worker, "serving.slots_live",
                                   float(st.get("slots_live", 0)))
+                try:
+                    # timelines ride the same pump: pulled continuously,
+                    # so a kill -9'd worker's phases survive here
+                    rq = self._worker_client(worker, host,
+                                             port).serving_requests()
+                except Exception:
+                    rq = None
+                if rq:
+                    self.server.aggregator.push_requests(worker, rq)
+        led = obs.request_ledger()
+        if led is not None:
+            self.server.aggregator.push_requests("router",
+                                                 led.export(n=256))
         with self._lock:
             inflight = sum(1 for r in self._recs.values() if not r.done)
         obs.gauge_set("router.inflight", inflight)
@@ -396,6 +413,7 @@ class ServingRouter:
                   tenant=str(req.get("tenant", "default")),
                   slo=str(req.get("slo", "interactive")),
                   prefix_len=None if prefix is None else int(prefix))
+        obs.req_phase(key, "admitted", via="router")
         try:
             worker, remote_rid = self._place(
                 prompt, max_new, submit_key=key, **kw)
@@ -427,6 +445,9 @@ class ServingRouter:
                 self._by_key[str(key)] = rec.rid
             self._prune_done_locked()
         obs.count("router.requests_total", outcome="ok")
+        # a point record (explicit zero dur): the forward wall it spans
+        # is attributed by the WORKERS' phase records, not double-billed
+        obs.req_phase(key, "route", dur=0.0, worker=str(worker))
         return {"ok": True, "rid": rec.rid}
 
     def _prune_done_locked(self) -> None:
@@ -552,6 +573,10 @@ class ServingRouter:
         with self._lock:
             rec.worker, rec.remote_rid = worker, remote_rid
             rec.remote_cursor = 0
+        # recorded under the BASE key: the new leg's own phases live
+        # under the derived {key}#r{n} timeline the workers record
+        obs.req_phase(rec.key, "reroute", dur=0.0, why=why,
+                      to=str(worker), n=rec.reroutes)
         return True
 
     def _route_cancel(self, req):

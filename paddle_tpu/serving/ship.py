@@ -40,7 +40,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from .. import faults
+from .. import faults, obs
 
 #: raw bytes per shipped chunk. Base64 inflates by 4/3 and the JSON frame
 #: adds envelope overhead, so 4 MiB raw stays far under the 64 MiB frame
@@ -141,6 +141,10 @@ def iter_chunks(payload: bytes,
         raw = payload[seq * chunk_bytes:(seq + 1) * chunk_bytes]
         crc = zlib.crc32(raw) & 0xFFFFFFFF
         raw = faults.filter_bytes("srv.ship", raw)
+        # send-edge wire accounting: what the ship phase's duration in
+        # the request timeline is spent ON (obs/requests.py)
+        obs.count("serving.ship_chunks_total")
+        obs.count("serving.ship_chunk_bytes_total", len(raw))
         yield seq, total, {"seq": seq, "total": total,
                            "data": base64.b64encode(raw).decode("ascii"),
                            "crc": crc}

@@ -100,7 +100,10 @@ def test_lint_bench_rows_schema(tmp_path):
         + json.dumps({"metric": "r_route_disagg_tokens_per_sec",
                       "value": 7.0, "unit": "tok/s", "vs_baseline": None,
                       "ttft_p50_ms": 20.0, "tpot_p50_ms": 4.0,
-                      "n_decode_workers": 2}) + "\n")
+                      "n_decode_workers": 2,
+                      "ttft_breakdown": {"queued": 1.0, "prefill": 12.0,
+                                         "ship": 4.0, "adopt": 2.0}})
+        + "\n")
     bad = tmp_path / "bad.jsonl"
     bad.write_text(
         json.dumps({"metric": "y_decode_tokens_per_sec", "value": 5.0,
@@ -132,8 +135,11 @@ def test_lint_bench_rows_schema(tmp_path):
     # deltas stay machine-checkable) and must be tuned|heuristic
     assert "plan_source" in r.stdout and "vibes" in r.stdout
     # the _route_ family rule (disaggregated serving): a routed row
-    # without the fleet size it was spread over is not comparable
+    # without the fleet size it was spread over is not comparable, and
+    # without its phase-decomposed TTFT (request-timeline ledger) a
+    # routed-TTFT regression can't name which hop moved
     assert "n_decode_workers" in r.stdout
+    assert "ttft_breakdown" in r.stdout
 
 
 def test_cli_train_test_time_dump(config_file, tmp_path):

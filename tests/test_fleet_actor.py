@@ -207,6 +207,32 @@ def test_actor_spawn_that_never_joins_fails_after_grace():
     assert any(e["action"] == "spawn_failed" for e in committed)
 
 
+def test_actor_leave_racing_spawn_grace_drains_at_most_one():
+    """A `leave` recommendation arriving while a spawn is still inside
+    its grace window (a very slow boot: process alive, not yet joined)
+    must shrink the pool by ONE live member — the unjoined spawn counts
+    toward effective capacity but is not a drainable worker, so it must
+    not inflate the drain into a second live departure."""
+    clock = [0.0]
+    pool = _FakePool(["w0", "w1"])
+    pool.recommendation = {"action": "join"}
+    # the spawned process starts (alive) but never reaches membership
+    pop = Population("serve",
+                     backend=pool.backend(spawn_fn=lambda w, p: None,
+                                          alive_fn=lambda h: True),
+                     probe=pool.probe, min_workers=0)
+    actor = _actor([pop], clock, max_churn=8, spawn_grace_s=60.0)
+    assert [e["action"] for e in actor.step()] == ["spawn"]
+    pool.recommendation = {"action": "leave", "reason": "idle"}
+    clock[0] = 10.0                           # inside the spawn grace
+    committed = actor.step()
+    assert [e["action"] for e in committed] == ["drain"]
+    assert len(pool.members) == 1, \
+        "leave racing an in-grace spawn double-drained the live pool"
+    # the pending spawn itself was neither failed nor drained
+    assert len(actor._spawning["serve"]) == 1
+
+
 def test_actor_drain_escalates_to_evict_after_grace():
     clock = [0.0]
     pool = _FakePool(["w0", "w1", "w2"])

@@ -392,6 +392,54 @@ def test_chaos_adopt_raise_falls_back_and_streams_exact(model_and_params):
         c.close()
 
 
+def test_slow_ship_dominates_timeline_exemplar_and_trace_cli(
+        model_and_params, capsys):
+    """Acceptance (ISSUE 19): force the SHIP phase slow — a ``srv.ship``
+    delay fault lands inside the measured ship window — and the stitched
+    timeline names ship dominant, the router store captures it as a
+    slow exemplar, and ``paddle_tpu obs trace --master`` prints the same
+    attribution from the live aggregator."""
+    from paddle_tpu import cli
+    from paddle_tpu.serving import RouterClient
+    model, params = model_and_params
+    with _fleet(model, params, n_decode=1, prefill=True) as (router, ds,
+                                                             reg):
+        c = RouterClient(*router.address, call_timeout=60.0)
+        rs = np.random.RandomState(29)
+        # warm both pools first so compile walls don't drown the fault
+        warm = c.submit_with_backoff(rs.randint(0, VOCAB, 11), 4)
+        _drain_interleaved(c, {"w": warm})
+        plan = faults.FaultPlan().add("srv.ship", "delay", delay_s=0.25)
+        with plan.installed():
+            rid = c.submit_with_backoff(rs.randint(0, VOCAB, 11), 8)
+            _drain_interleaved(c, {"s": rid})
+        key = router._recs[rid].key
+        store = router.server.aggregator.requests
+        deadline = time.monotonic() + 15.0
+        while True:
+            st = store.get(key)
+            if st is not None and st["done"]:
+                break
+            assert time.monotonic() < deadline, \
+                "slow-ship timeline never stitched done"
+            time.sleep(0.05)
+        assert st["dominant"] == "ship"
+        assert st["breakdown"]["ship"] >= 0.25
+        assert st["ttft_s"] >= 0.25           # the hop is IN the TTFT
+        # the completed slow request is a window exemplar naming ship
+        # (the warm request may out-score it with its compile wall)
+        assert any(e["key"] == key and e["dominant"] == "ship"
+                   for e in store.exemplars())
+        # the live-aggregator CLI surface prints the same attribution
+        host, port = router.address
+        assert cli.main(["obs", "trace", key,
+                         "--master", f"{host}:{port}"]) == 0
+        out = capsys.readouterr().out
+        assert f"request {key}" in out and "dominant=ship" in out
+        assert "ship=" in out
+        c.close()
+
+
 def test_kill9_decode_worker_midstream_streams_exact(model_and_params,
                                                      tmp_path):
     """THE chaos bar: two decode workers (the victim a REAL subprocess
@@ -466,6 +514,23 @@ def test_kill9_decode_worker_midstream_streams_exact(model_and_params,
                 "every stream finished before the kill window"
             time.sleep(0.002)
 
+        # the scrape pump (0.1s) must have pulled the victim's timeline
+        # before the kill erases its ledger — that pull is exactly what
+        # lets the stitched timeline survive a kill -9
+        store = router.server.aggregator.requests
+        vkey = router._recs[work[on_victim[0]]].key
+        deadline = time.monotonic() + 15.0
+        while True:
+            stv = store.get(vkey)
+            if stv is not None and any(
+                    e["phase"] == "first_token"
+                    and e.get("worker") == "a-victim"
+                    for e in stv["events"]):
+                break
+            assert time.monotonic() < deadline, \
+                "victim's first_token never reached the router store"
+            time.sleep(0.02)
+
         os.kill(proc.pid, signal.SIGKILL)     # no goodbye, no leave
         deadline = time.monotonic() + 30.0
         while len(router._members("decode")) != 1:   # TTL eviction
@@ -480,6 +545,38 @@ def test_kill9_decode_worker_midstream_streams_exact(model_and_params,
             np.testing.assert_array_equal(full, _ref(model, params, p, g))
         assert _counter(reg, "router.reroutes_total", reason="evicted") \
             >= len(on_victim)
+
+        # satellite (ISSUE 19): the re-routed stream's STITCHED timeline
+        # holds both workers' phases — the dead victim's leg 0 (pulled by
+        # the scrape pump before the kill) and the survivor's derived
+        # {key}#r1 leg — with exactly one canonical first_token
+        deadline = time.monotonic() + 15.0
+        while True:
+            st = store.get(vkey)
+            if st is not None and st["done"] and 1 in st["legs"]:
+                break
+            assert time.monotonic() < deadline, \
+                "re-routed leg never stitched done on the router store"
+            time.sleep(0.05)
+        assert st["legs"] == [0, 1] and st["reroutes"] == 1
+        # the victim's identity survives its own death; the in-process
+        # survivor's leg is stamped by whichever pump pushed it last
+        # (the survivor scrape or the router's own-ledger push)
+        assert "a-victim" in st["workers"] and len(st["workers"]) >= 2
+        fts = [e for e in st["events"] if e["phase"] == "first_token"]
+        assert len(fts) == 2
+        assert [bool(e.get("resumed")) for e in fts] == [False, True]
+        assert [e["leg"] for e in fts] == [0, 1]
+        # phases from every seam survived: router admission + re-route,
+        # the victim's admission/decode, the survivor's remainder
+        assert {e["phase"] for e in st["events"]} >= {
+            "admitted", "route", "reroute", "queued", "first_token",
+            "decode", "done"}
+        # no gap, no double count: TTFT is the FIRST leg's first token
+        assert st["ttft_s"] is not None
+        assert 0 < st["ttft_s"] <= st["wall_s"]
+        assert fts[0]["t_unix"] - st["t0_unix"] == \
+            pytest.approx(st["ttft_s"])
         c.close()
     finally:
         if proc.poll() is None:
