@@ -9,6 +9,15 @@ profile); off-TPU the sweep runs the same kernels through the Pallas
 interpreter at proxy dims (``cpu`` profile — noted per row; interpreter
 ratios do not transfer to the chip, the closed loop does).
 
+The graph-fusion rows close the same loop one level up: for an MLP
+training step and a GPT-2-small-shaped MLP-stack step, every certified
+fusion group is measured fused-vs-unfused (``tune.fusion.measure_fusion``
+— whole executor dispatches), the verdicts persist into the throwaway
+cache, and the reported value is the steady-state step-time ratio of the
+consulting executor (``fuse=None`` — activates only measured winners)
+over the unfused executor (``fuse=False``). A ratio ≤ 1.0 is an honest
+result: the measured-only gate refused groups that don't win here.
+
 The sweep writes into a throwaway cache file (a bench row must not mutate
 ``~/.paddle_tpu``) and points the in-process consult at it, so the rows'
 ``plan_source: "tuned"`` stamp is literally true: the routing entries
@@ -19,7 +28,75 @@ from __future__ import annotations
 
 import os
 import tempfile
+import time
 from typing import List
+
+
+def _fusion_delta_row(name: str, *, batch: int, width: int, depth: int,
+                      note: str, cache_path: str, backend: str,
+                      steps: int = 10) -> dict:
+    """One `_train_` row: steady-state fused-vs-unfused step time for one
+    proxy workload, with the fusion verdicts measured into (and consulted
+    from) the throwaway cache first."""
+    from benchmarks.mfu import attach_mfu
+
+    from paddle_tpu import tune
+    from paddle_tpu.fluid.executor import Executor, Scope
+    from paddle_tpu.tune import fusion as F
+    from paddle_tpu.tune.cache import AutotuneCache, load_cache
+
+    main, startup, feed, fetch = F.build_proxy_program(
+        batch=batch, width=width, depth=depth)
+    measured = F.measure_fusion(main, startup, feed, fetch, reps=2,
+                                note=note)
+    try:
+        cache = load_cache(cache_path)
+    except (OSError, ValueError):
+        cache = AutotuneCache()
+    dk = F._device_kind()
+    for r in measured:
+        meta = {k: r[k] for k in ("certificate", "program_signature",
+                                  "shape_family", "fused_ms", "unfused_ms",
+                                  "note") if k in r}
+        cache.put(r["space"], r["kernel"], dk, r["family"], r["plan"],
+                  tune.space_hash("fusion"), methodology="measured",
+                  backend=backend, **meta)
+    cache.save(cache_path)
+    tune.reset()            # the consult now resolves the fresh verdicts
+
+    def steady(fuse) -> float:
+        exe = Executor(scope=Scope(), fuse=fuse)
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=fetch)        # warm, untimed
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            exe.run(main, feed=feed, fetch_list=fetch)
+        return (time.perf_counter() - t0) / steps
+
+    unfused_s = steady(False)
+    fused_s = steady(None)
+    plan = F.plan_for(main, {k: v.shape for k, v in feed.items()},
+                      fetch=fetch, feed=list(feed))
+    row = {
+        "metric": f"fusion_train_{name}_step",
+        "value": round(unfused_s / fused_s, 3) if fused_s else None,
+        "unit": "x_fused_vs_unfused",
+        "vs_baseline": None,
+        "plan_source": "tuned",
+        "note": {
+            "fused_step_ms": round(fused_s * 1e3, 4),
+            "unfused_step_ms": round(unfused_s * 1e3, 4),
+            "groups_certified": len(measured),
+            "groups_activated": len(plan.groups),
+            "groups_refused": [reason for _, reason in plan.rejected],
+            "workload": note,
+            "dims": {"batch": batch, "width": width, "depth": depth},
+            "backend": backend,
+        },
+    }
+    # mfu stays an honest null off-TPU; the value is a measured ratio of
+    # two whole-step timings (methodology "measured")
+    return attach_mfu(row, None, max(fused_s, 1e-9))
 
 
 def run() -> List[dict]:
@@ -64,7 +141,10 @@ def run() -> List[dict]:
                 rows.append(attach_mfu(row, None, max(tuned_s, 1e-9)))
             elif r["space"] == "decode_route":
                 row = {
-                    "metric": "autotune_decode_route_crossover",
+                    # not "..._route_...": that substring is the serving
+                    # route-row family (bench_schema), whose SLO columns
+                    # a crossover sweep doesn't have
+                    "metric": "autotune_decode_crossover",
                     "value": r["plan"].get("kernel_min_len"),
                     "unit": "min_kernel_len_tokens",
                     "vs_baseline": None,
@@ -80,6 +160,19 @@ def run() -> List[dict]:
                 }
                 rows.append(attach_hbm_bw(row, None, 1.0,
                                           methodology="measured"))
+        # graph-fusion delta rows: MLP proxy at the profile's sweep dims
+        # plus a GPT-2-small-shaped MLP-stack step (d_model-width fc
+        # stack — the transformer MLP is where the epilogue chains live)
+        fcfg = tune.PROFILES[report["profile"]]["fusion"]
+        rows.append(_fusion_delta_row(
+            "mlp", batch=fcfg["batch"], width=fcfg["width"],
+            depth=fcfg["depth"], note=f"mlp proxy ({fcfg['note']})",
+            cache_path=cache_path, backend=report["backend"]))
+        gpt_width = 768 if report["backend"] == "device" else 256
+        rows.append(_fusion_delta_row(
+            "gpt2s", batch=8, width=gpt_width, depth=4,
+            note=f"gpt2-small mlp-stack proxy (width={gpt_width})",
+            cache_path=cache_path, backend=report["backend"]))
         return rows
     finally:
         if prev is None:

@@ -25,8 +25,9 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .dataflow import (Dataflow, DonationHazard, Effect, FusionGroup,
-                       analyze_dataflow, classify_effect, donation_hazards,
-                       explain_var, fusable_groups)
+                       analyze_dataflow, certificate_matches,
+                       classify_effect, donation_hazards, explain_var,
+                       fusable_groups, region_schedulable)
 from .diagnostics import (Diagnostic, ProgramVerificationError, Severity,
                           block_paths, errors, format_diagnostics,
                           max_severity, op_site)
@@ -45,7 +46,8 @@ __all__ = [
     "LINT_CATALOGUE",
     "Dataflow", "DonationHazard", "Effect", "FusionGroup",
     "analyze_dataflow", "classify_effect", "donation_hazards",
-    "explain_var", "fusable_groups",
+    "explain_var", "fusable_groups", "region_schedulable",
+    "certificate_matches",
     "analyze_program", "check_or_raise",
 ]
 

@@ -671,6 +671,49 @@ def _certify(df: Dataflow, block, comp: List[int], kind: str,
     return FusionGroup(kind, block.idx, sorted(comp), inputs, outputs, cert)
 
 
+def region_schedulable(block, group: FusionGroup) -> bool:
+    """Can ``group`` legally execute as ONE region at its first member's
+    position?  The dependence certificate proves the intra-group edges;
+    this proves the *rewrite*: hoisting every member up to the first
+    member's slot must not cross a non-member op that (re)defines a group
+    input or touches a group output name.  Conservative — a False here
+    forgoes a fusion, never risks one (the executor counts it as
+    ``reason="not_schedulable"``)."""
+    s, e = group.op_idxs[0], group.op_idxs[-1]
+    members = set(group.op_idxs)
+    ins, outs = set(group.inputs), set(group.outputs)
+    for k in range(s + 1, e):
+        if k in members:
+            continue
+        op = block.ops[k]
+        if set(op.output_vars()) & (ins | outs):
+            return False
+        if set(op.input_vars()) & outs:
+            return False
+    return True
+
+
+def certificate_matches(cert: dict, group: FusionGroup,
+                        op_types: Sequence[str]) -> bool:
+    """Does a *persisted* certificate (an autotune-cache ``fusion`` entry)
+    still describe ``group`` as the oracle certifies it TODAY?  Exact
+    match on kind, member indices, member op types, boundary vars, and
+    edge vars — any drift means the entry was measured on a different
+    graph and is refused at consult time (and flagged by L008)."""
+    if not isinstance(cert, dict):
+        return False
+    try:
+        return (cert.get("kind") == group.kind
+                and list(cert.get("op_idxs") or []) == list(group.op_idxs)
+                and list(cert.get("op_types") or []) == list(op_types)
+                and list(cert.get("inputs") or []) == list(group.inputs)
+                and list(cert.get("outputs") or []) == list(group.outputs)
+                and [e.get("var") for e in (cert.get("edges") or [])]
+                == [e["var"] for e in group.edges])
+    except (TypeError, AttributeError):
+        return False
+
+
 # --------------------------------------------------------------------------
 # consumer 4: --explain chains
 # --------------------------------------------------------------------------

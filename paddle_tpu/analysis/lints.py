@@ -658,7 +658,71 @@ def lint_autotune_cache(path=None,
                  "STALE and ignored at consult time (heuristic applies)",
                  "re-run `paddle_tpu tune` to re-measure under the new "
                  "candidate set", var=key)
+            continue
+        if space == "fusion":
+            _lint_fusion_entry(key, entry, emit)
+        elif space == "bucket_grid":
+            _lint_bucket_grid_entry(key, entry, emit)
     return diags
+
+
+def _lint_fusion_entry(key, entry, emit):
+    """Per-entry L008 checks specific to the ``fusion`` space: the plan
+    must be the binary verdict, the dependence certificate must be
+    present, and the family's program/group signature components must
+    re-derive from the persisted certificate — a hand-edited or wrongly
+    merged cache whose proof no longer matches its key is refused at
+    consult time (``cert_invalid``), and this is what makes it visible."""
+    from ..tune import fusion as _tfusion
+    plan = entry.get("plan")
+    if not isinstance(plan, dict) or not isinstance(plan.get("fuse"), bool):
+        emit(f"fusion entry {key!r} has plan {plan!r} (expected "
+             "{'fuse': true|false}); ignored at consult time",
+             "re-run `paddle_tpu tune fusion`", var=key)
+        return
+    cert = entry.get("certificate")
+    if not isinstance(cert, dict):
+        emit(f"fusion entry {key!r} carries no dependence certificate; "
+             "the consult cannot re-validate it against the current "
+             "program and refuses it (cert_invalid)",
+             "re-run `paddle_tpu tune fusion`", var=key)
+        return
+    family = str(entry.get("family") or "")
+    parts = family.split(":")
+    if len(parts) != 3:
+        emit(f"fusion entry {key!r} family {family!r} is not "
+             "'program_sig:shape_family:group_sig'",
+             "re-run `paddle_tpu tune fusion`", var=key)
+        return
+    derived = _tfusion.group_signature(cert)
+    if derived != parts[2]:
+        emit(f"fusion entry {key!r}: group signature {parts[2]!r} in the "
+             f"family key does not re-derive from the persisted "
+             f"certificate (derived {derived!r}); the key and the proof "
+             "disagree — ignored at consult time",
+             "the cache was hand-edited or wrongly merged; re-run "
+             "`paddle_tpu tune fusion`", var=key)
+    prog_sig = entry.get("program_signature")
+    if prog_sig is not None and prog_sig != parts[0]:
+        emit(f"fusion entry {key!r}: program_signature {prog_sig!r} "
+             f"disagrees with the family key's {parts[0]!r}",
+             "re-run `paddle_tpu tune fusion`", var=key)
+
+
+def _lint_bucket_grid_entry(key, entry, emit):
+    """Per-entry L008 checks for ``bucket_grid``: the plan's grid must be
+    strictly ascending unique positive ints (the same legality the
+    consult enforces — an illegal grid silently falls back)."""
+    plan = entry.get("plan")
+    buckets = plan.get("buckets") if isinstance(plan, dict) else None
+    if (not isinstance(buckets, (list, tuple)) or not buckets
+            or not all(isinstance(b, int) and not isinstance(b, bool)
+                       and b >= 1 for b in buckets)
+            or list(buckets) != sorted(set(buckets))):
+        emit(f"bucket_grid entry {key!r} has plan {plan!r} (expected "
+             "{'buckets': [ascending unique positive ints]}); ignored "
+             "at consult time",
+             "re-run `paddle_tpu tune bucket_grid`", var=key)
 
 
 def _lint_sharding(program, mesh_axes, emit):

@@ -601,10 +601,19 @@ def cmd_tune(args):
     try:
         report = tune.run_tune(spaces=spaces, profile=profile,
                                cache_path=cache_path, reps=args.reps,
-                               save=not args.dry_run)
-    except (ValueError, KeyError) as e:
+                               save=not args.dry_run,
+                               from_ledger=args.from_ledger,
+                               ledger_topk=args.ledger_topk)
+    except (OSError, ValueError, KeyError) as e:
         print(f"tune: {e}", file=sys.stderr)
         return 2
+    if not args.json and report.get("ledger"):
+        led = report["ledger"]
+        names = [s["op"] for s in led["sites"] if s.get("space")]
+        print(f"tune: ledger {led['path']}: top-{led['topk']} sites "
+              f"implicate spaces {led['seeded_spaces'] or 'none'} "
+              f"(hot ops: {names[:4] or 'no matches'}); sweeping "
+              f"{led['swept_spaces']}")
     if args.json:
         print(json.dumps(report, indent=1))
     elif args.markdown:
@@ -674,6 +683,41 @@ def cmd_tune(args):
                 if tune.page_block(bs * 8, bs * 4) != bs:
                     problems.append("page_block: consult missed the "
                                     "tuned entry")
+            elif r["space"] == "bucket_grid":
+                got = tune.bucket_grid(r["family"])
+                if got != tuple(r["plan"]["buckets"]):
+                    problems.append(f"bucket_grid/{r['family']}: consult "
+                                    f"returned {got}, tuned "
+                                    f"{r['plan']['buckets']}")
+        # fusion: rebuild the proxy program the sweep measured and prove
+        # plan_for resolves every persisted family through the full
+        # consult chain (cert re-validation included) — a winner must
+        # activate, a measured loser must refuse with measured_slower
+        fusion_rows = [r for r in report["results"]
+                       if r["space"] == "fusion" and r.get("plan")]
+        if fusion_rows:
+            from .tune import fusion as _fusion
+            fcfg = tune.PROFILES[report["profile"]]["fusion"]
+            main, _startup, feed, fetch = _fusion.build_proxy_program(
+                batch=fcfg["batch"], width=fcfg["width"],
+                depth=fcfg["depth"])
+            plan = _fusion.plan_for(
+                main, {k: v.shape for k, v in feed.items()},
+                fetch=fetch, feed=list(feed))
+            refused = dict(plan.rejected)
+            for r in fusion_rows:
+                fam = r["family"]
+                if r["plan"]["fuse"]:
+                    if fam not in plan.families:
+                        problems.append(
+                            f"fusion/{fam}: measured winner did not "
+                            f"activate (rejected: "
+                            f"{refused.get(fam, 'missing')})")
+                elif refused.get(fam) != "measured_slower":
+                    problems.append(
+                        f"fusion/{fam}: measured loser should refuse "
+                        f"with measured_slower, got "
+                        f"{refused.get(fam, 'activated')}")
         if tune.plan_source() != "tuned":
             problems.append("plan_source() != 'tuned' with a fresh cache")
     finally:
@@ -1972,12 +2016,25 @@ def main(argv=None) -> int:
 
     tu = sub.add_parser("tune", help="measure candidate kernel plans "
                                      "(fused-RNN tiles, decode routing, "
-                                     "paged block size) and persist "
+                                     "paged block size, graph fusion, "
+                                     "serving bucket grids) and persist "
                                      "winners in the autotune cache the "
                                      "routers consult")
     tu.add_argument("--spaces", default=None,
                     help="comma-separated plan spaces (default: all of "
-                         "fused_rnn,decode_route,page_block)")
+                         "bucket_grid,decode_route,fused_rnn,fusion,"
+                         "page_block)")
+    tu.add_argument("--from-ledger", default=None, dest="from_ledger",
+                    metavar="FILE",
+                    help="seed the sweep from a profile ledger (xplane "
+                         ".pb or JSON op rows): the hottest op sites "
+                         "pick which plan spaces get swept — tuning "
+                         "effort follows the measured time (an explicit "
+                         "--spaces list overrides the seeding)")
+    tu.add_argument("--ledger-topk", type=int, default=8,
+                    dest="ledger_topk", metavar="N",
+                    help="how many top self-time op sites seed the "
+                         "sweep (default 8)")
     tu.add_argument("--profile", choices=["smoke", "cpu", "bench"],
                     default=None,
                     help="measurement profile (default: bench on TPU, "

@@ -91,8 +91,8 @@ class ServingEngine:
     def __init__(self, model, params, *, slots: int = 8, segment: int = 32,
                  page_block: Optional[int] = None,
                  pages: Optional[int] = None,
-                 cache_bucket: int = 256,
-                 prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
+                 cache_bucket: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
                  kv_dtype: Optional[str] = None, queue_cap: int = 64,
                  default_timeout_s: Optional[float] = None,
                  prefix_cache: bool = False,

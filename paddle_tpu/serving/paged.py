@@ -100,11 +100,26 @@ class PagePool:
     def __init__(self, model, params, *, slots: int, segment: int = 32,
                  page_block: Optional[int] = None,
                  pages: Optional[int] = None,
-                 cache_bucket: int = 256,
-                 prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
+                 cache_bucket: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
                  kv_dtype: Optional[str] = None,
                  prefix_cache: bool = False,
                  prefix_half_life: int = 64):
+        if cache_bucket is None or prompt_buckets is None:
+            # bucket_grid consult: the measured compile-count-vs-padding
+            # winner for this backend, legality-validated by the consult
+            # (ascending, ≤ max_len, divisible by an explicit page_block);
+            # heuristic grids otherwise. Resolved BEFORE the page_block
+            # consult below — its validation needs the real cache_bucket.
+            from .. import tune
+            if cache_bucket is None:
+                grid = tune.bucket_grid("cache", max_len=model.max_len,
+                                        divisor=page_block)
+                cache_bucket = grid[-1] if grid else 256
+            if prompt_buckets is None:
+                prompt_buckets = (
+                    tune.bucket_grid("prompt", max_len=model.max_len)
+                    or (32, 64, 128, 256, 512))
         if page_block is None:
             # autotune consult (paddle_tpu.tune, `paddle_tpu tune`): a
             # measured winner validated against THIS pool's grid
@@ -780,8 +795,8 @@ class PagedBatcher:
     def __init__(self, model, params, *, slots: int = 8, segment: int = 32,
                  page_block: Optional[int] = None,
                  pages: Optional[int] = None,
-                 cache_bucket: int = 256,
-                 prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
+                 cache_bucket: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
                  schedule: str = "longest_first",
                  kv_dtype: Optional[str] = None,
                  prefix_cache: bool = False):

@@ -30,7 +30,8 @@ __all__ = [
     "default_cache_path", "load_cache", "get_cache", "set_cache", "reset",
     "SPACE_DEFS", "SPACE_NAMES", "PROFILES", "space_hash", "fused_family",
     "fused_candidates", "run_tune", "results_markdown", "MISS",
-    "fused_plan", "decode_kernel_min_len", "page_block", "plan_source",
+    "fused_plan", "decode_kernel_min_len", "page_block", "bucket_grid",
+    "plan_source",
 ]
 
 #: sentinel for "no tuned entry applies — the heuristic decides". Distinct
@@ -119,6 +120,38 @@ def page_block(max_len: int, cache_bucket: int) -> Optional[int]:
             and cache_bucket % bs == 0):
         return bs
     return None
+
+
+def bucket_grid(kind: str, *, max_len: Optional[int] = None,
+                divisor: Optional[int] = None) -> Optional[Tuple[int, ...]]:
+    """Tuned prompt/cache bucket grid for serving compiles, or None.
+
+    ``kind`` is ``"prompt"`` or ``"cache"`` (the two bucket_grid
+    families).  The winner is re-validated for legality HERE, against the
+    caller's own constraints: strictly ascending unique positive ints,
+    every bucket ≤ ``max_len`` (buckets past the model horizon are
+    dropped; an emptied grid is a miss), and — when ``divisor`` is given —
+    every surviving bucket divisible by it (``PagePool`` passes its
+    ``page_block``; an indivisible bucket can't page).  Any violation
+    returns None and the caller's heuristic grid decides."""
+    entry = _fresh_entry("bucket_grid", "prefill_dispatch", kind)
+    if entry is None:
+        return None
+    plan = entry.get("plan")
+    if not isinstance(plan, dict):
+        return None
+    buckets = plan.get("buckets")
+    if (not isinstance(buckets, (list, tuple)) or not buckets
+            or not all(isinstance(b, int) and b >= 1 for b in buckets)
+            or list(buckets) != sorted(set(buckets))):
+        return None
+    if max_len is not None:
+        buckets = [b for b in buckets if b <= max_len]
+        if not buckets:
+            return None
+    if divisor is not None and any(b % divisor for b in buckets):
+        return None
+    return tuple(buckets)
 
 
 def plan_source() -> str:

@@ -218,24 +218,200 @@ def _sweep_page_block(cfg: Dict[str, Any], reps: int) -> Dict[str, Any]:
     }
 
 
+def _sweep_fusion(cfg: Dict[str, Any], reps: int) -> List[Dict[str, Any]]:
+    """One row per certified group of the MLP proxy program, measured
+    fused-vs-unfused through the whole executor pipeline (fusion.py owns
+    the harness; this is just the profile-dims veneer)."""
+    from . import fusion as _fusion
+    main, startup, feed, fetch = _fusion.build_proxy_program(
+        batch=cfg["batch"], width=cfg["width"], depth=cfg["depth"])
+    rows = _fusion.measure_fusion(main, startup, feed, fetch, reps=reps,
+                                  note=cfg.get("note", ""))
+    if not rows:
+        return [{"space": "fusion", "kernel": "fused_region",
+                 "family": "none", "plan": None,
+                 "skipped": "oracle certified no schedulable groups on "
+                            "the proxy program",
+                 "note": cfg.get("note", "")}]
+    return rows
+
+
+def _sweep_bucket_grid(cfg: Dict[str, Any],
+                       reps: int) -> List[Dict[str, Any]]:
+    """Measure whole bucket GRIDS, not buckets: a grid's cost over a
+    deterministic zipf-ish length sample is the replayed per-request
+    dispatch time at each request's padded bucket plus one compile cost
+    per distinct bucket the sample touches. More buckets = tighter
+    padding but more compiles — the exact tradeoff serving guesses at;
+    here it's measured. One row per kind (``prompt``/``cache``)."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..data.feeder import next_bucket
+    B, D, max_len = cfg["batch"], cfg["d_model"], cfg["max_len"]
+    rs = np.random.RandomState(3)
+    # zipf tail scaled up so the sample spans the grid instead of piling
+    # onto the smallest bucket (raw zipf(1.2) mass sits at 1-4 tokens)
+    raw = rs.zipf(cfg["zipf_a"], cfg["samples"])
+    lens = np.minimum(raw * max(1, max_len // 64), max_len).astype(int)
+    rs2 = np.random.RandomState(4)
+    w1 = jnp.asarray(rs2.randn(D, D) * 0.05, jnp.float32)
+    w2 = jnp.asarray(rs2.randn(D, D) * 0.05, jnp.float32)
+
+    proxy = jax.jit(lambda x: jnp.tanh(x @ w1) @ w2)
+
+    rows: List[Dict[str, Any]] = []
+    dispatch_s: Dict[int, float] = {}
+    compile_s: Dict[int, float] = {}
+
+    def measured(bucket: int) -> Tuple[float, float]:
+        """(dispatch seconds, compile seconds) for one padded length."""
+        if bucket not in dispatch_s:
+            x = jnp.asarray(rs2.randn(B, bucket, D) * 0.1, jnp.float32)
+            t0 = time.perf_counter()
+            jax.block_until_ready(proxy(x))       # trace + compile + run
+            first = time.perf_counter() - t0
+            best = measure_callable(proxy, (x,), reps=reps,
+                                    space="bucket_grid")
+            dispatch_s[bucket] = best
+            compile_s[bucket] = max(0.0, first - best)
+        return dispatch_s[bucket], compile_s[bucket]
+
+    heuristics = {"prompt": [32, 64, 128, 256, 512], "cache": [256]}
+    for kind in _spaces.SPACE_DEFS["bucket_grid"]["kinds"]:
+        grids = [tuple(b for b in g if b <= max_len)
+                 for g in _spaces.SPACE_DEFS["bucket_grid"]["grids"][kind]]
+        grids = [g for g in dict.fromkeys(grids) if g]
+        heur = tuple(b for b in heuristics[kind] if b <= max_len)
+        if heur and heur not in grids:
+            grids.append(heur)    # timed for the speedup column even when
+            #                       off the candidate grid (fused_rnn idiom)
+        timed: List[Tuple[Tuple[int, ...], float, int]] = []
+        for grid in grids:
+            used = sorted({next_bucket(int(n), grid) for n in lens})
+            cost = sum(measured(b)[1] for b in used)       # compiles
+            for n in lens:
+                cost += measured(next_bucket(int(n), grid))[0]
+            timed.append((grid, cost, len(used)))
+        win, tuned_c, _ = min(timed, key=lambda kv: kv[1])
+        heur_c = next((c for g, c, _ in timed if g == heur), None)
+        rows.append({
+            "space": "bucket_grid", "kernel": "prefill_dispatch",
+            "family": kind, "plan": {"buckets": list(win)},
+            "tuned_ms": round(tuned_c * 1e3, 4),
+            "heuristic_plan": {"buckets": list(heur)},
+            "heuristic_ms": (round(heur_c * 1e3, 4)
+                             if heur_c is not None else None),
+            "speedup": (round(heur_c / tuned_c, 3)
+                        if heur_c and tuned_c else None),
+            "candidates": len(timed), "note": cfg.get("note", ""),
+            "sweep": [{"buckets": list(g), "ms": round(c * 1e3, 4),
+                       "distinct_buckets": nb} for g, c, nb in timed],
+        })
+    return rows
+
+
+# -- ledger seeding ------------------------------------------------------------
+
+#: substring → (plan space, fused-RNN kernel filter) hints mapping the
+#: profile ledger's hottest op sites onto the spaces that can move them.
+#: Order matters: first match wins (paged_decode before decode).
+_LEDGER_HINTS: Tuple[Tuple[str, str, Optional[str]], ...] = (
+    ("lstm", "fused_rnn", "lstm_sequence_fused"),
+    ("gru", "fused_rnn", "gru_sequence_fused"),
+    ("paged_decode_attention", "page_block", None),
+    ("decode_attention", "decode_route", None),
+    ("prefill", "bucket_grid", None),
+    ("prompt", "bucket_grid", None),
+    ("fused_", "fusion", None),
+    ("elementwise", "fusion", None),
+    ("matmul", "fusion", None),
+    ("mul", "fusion", None),
+    ("fc", "fusion", None),
+)
+
+
+def _ledger_sites(path: str, topk: int = 8) -> List[Dict[str, Any]]:
+    """Top-``topk`` op sites by self time from a PR 9 profile ledger.
+
+    Accepts the profiler's xplane protobuf (``.pb``/``.xplane``, read via
+    ``obs.xplane``) or a JSON/JSONL row dump (``[{"op": ..., "self_ns":
+    ...}, ...]`` — the testable form ``paddle_tpu profile --json``
+    emits)."""
+    import json as _json
+    if path.endswith((".json", ".jsonl")):
+        with open(path) as f:
+            txt = f.read()
+        try:
+            data = _json.loads(txt)
+        except ValueError:
+            data = [_json.loads(ln) for ln in txt.splitlines() if ln.strip()]
+        if isinstance(data, dict):
+            data = data.get("rows") or data.get("ops") or []
+        rows = [{"op": str(r.get("op", "")),
+                 "self_ns": int(r.get("self_ns", r.get("total_ns", 0)))}
+                for r in data if isinstance(r, dict) and r.get("op")]
+    else:
+        from ..obs import xplane
+        space = xplane.read_xspace(path)
+        rows = [{"op": r["op"], "self_ns": r["self_ns"]}
+                for r in xplane.op_totals(space)]
+    rows.sort(key=lambda r: -r["self_ns"])
+    return rows[:max(1, topk)]
+
+
+def _ledger_seeding(sites: List[Dict[str, Any]]
+                    ) -> Tuple[List[str], List[str], List[Dict[str, Any]]]:
+    """(implicated spaces, implicated fused-RNN kernels, annotated sites)."""
+    spaces_hit: List[str] = []
+    kernels: List[str] = []
+    annotated: List[Dict[str, Any]] = []
+    for site in sites:
+        op = site["op"].lower()
+        hit_space = None
+        for needle, space, kern in _LEDGER_HINTS:
+            if needle in op:
+                hit_space = space
+                if space not in spaces_hit:
+                    spaces_hit.append(space)
+                if kern and kern not in kernels:
+                    kernels.append(kern)
+                break
+        annotated.append(dict(site, space=hit_space))
+    return spaces_hit, kernels, annotated
+
+
 # -- the entry point -----------------------------------------------------------
 
 def run_tune(spaces: Optional[Sequence[str]] = None,
              profile: Optional[str] = None,
              cache_path: Optional[str] = None,
              reps: Optional[int] = None,
-             save: bool = True) -> Dict[str, Any]:
+             save: bool = True,
+             from_ledger: Optional[str] = None,
+             ledger_topk: int = 8) -> Dict[str, Any]:
     """Sweep ``spaces`` under ``profile``, persist winners, return results.
 
     ``profile=None`` auto-selects: ``bench`` on a TPU, ``cpu`` elsewhere.
+    ``from_ledger`` seeds the sweep from a PR 9 profile ledger (xplane
+    protobuf or JSON row dump): the top-``ledger_topk`` op sites by self
+    time pick which plan spaces (and fused-RNN kernels) get swept — when
+    the caller pinned no ``spaces`` explicitly, only the implicated
+    spaces run, so tuning effort lands where the measured time went.
+    Each ledger-seeded family counts
+    ``tune.ledger_seeded_families_total`` on the obs plane.
     The returned dict carries ``device_kind``, ``backend``
     (``device``/``interpret``), the per-family ``results`` (full sweeps
-    included), and the ``cache_path`` written (None with ``save=False``).
+    included), the ``ledger`` seeding report when ``from_ledger`` was
+    given, and the ``cache_path`` written (None with ``save=False``).
     Winners merge into an existing cache file — a fused-RNN re-tune does
     not drop the decode entry."""
+    from .. import obs
     if profile is None:
         profile = "bench" if _on_tpu() else "cpu"
     prof = _spaces.PROFILES[profile]
+    user_pinned = bool(spaces)
     spaces = tuple(spaces) if spaces else _spaces.SPACE_NAMES
     for s in spaces:
         if s not in _spaces.SPACE_DEFS:
@@ -245,14 +421,44 @@ def run_tune(spaces: Optional[Sequence[str]] = None,
     device_kind = _device_kind()
     backend = "device" if _on_tpu() else "interpret"
 
+    ledger_report = None
+    ledger_kernels: List[str] = []
+    seeded_spaces: List[str] = []
+    if from_ledger:
+        sites = _ledger_sites(from_ledger, ledger_topk)
+        seeded_spaces, ledger_kernels, annotated = _ledger_seeding(sites)
+        if seeded_spaces and not user_pinned:
+            # effort follows the measured time: sweep only implicated
+            # spaces (an explicit --spaces list always wins over the hint)
+            spaces = tuple(s for s in _spaces.SPACE_NAMES
+                           if s in seeded_spaces)
+        ledger_report = {"path": from_ledger, "topk": ledger_topk,
+                         "sites": annotated,
+                         "seeded_spaces": seeded_spaces,
+                         "swept_spaces": list(spaces)}
+
     results: List[Dict[str, Any]] = []
     if "fused_rnn" in spaces:
-        for fam in prof["fused_families"]:
+        fams = prof["fused_families"]
+        if ledger_kernels:
+            hit = [f for f in fams if f["kernel"] in ledger_kernels]
+            fams = hit or fams
+        for fam in fams:
             results.append(_sweep_fused_family(fam, n_reps))
     if "decode_route" in spaces:
         results.append(_sweep_decode(prof["decode"], n_reps))
     if "page_block" in spaces:
         results.append(_sweep_page_block(prof["page_block"], n_reps))
+    if "fusion" in spaces:
+        results.extend(_sweep_fusion(prof["fusion"], n_reps))
+    if "bucket_grid" in spaces:
+        results.extend(_sweep_bucket_grid(prof["bucket_grid"], n_reps))
+
+    if from_ledger:
+        for r in results:
+            if r["space"] in seeded_spaces and not (
+                    r.get("plan") is None and "skipped" in r):
+                obs.count("tune.ledger_seeded_families_total")
 
     out_path = None
     if save:
@@ -266,16 +472,21 @@ def run_tune(spaces: Optional[Sequence[str]] = None,
                 continue
             meta = {k: r[k] for k in ("tuned_ms", "heuristic_ms",
                                       "heuristic_plan", "speedup", "note",
-                                      "sweep") if k in r}
+                                      "sweep", "certificate",
+                                      "program_signature", "shape_family",
+                                      "fused_ms", "unfused_ms") if k in r}
             meta.update(methodology="measured", backend=backend,
                         profile=profile)
             existing.put(r["space"], r["kernel"], device_kind, r["family"],
                          r["plan"], _spaces.space_hash(r["space"]), **meta)
         out_path = existing.save(path)
         _cache.reset()       # the fresh file is the consult target now
-    return {"device_kind": device_kind, "backend": backend,
-            "profile": profile, "results": results,
-            "cache_path": out_path}
+    report = {"device_kind": device_kind, "backend": backend,
+              "profile": profile, "results": results,
+              "cache_path": out_path}
+    if ledger_report is not None:
+        report["ledger"] = ledger_report
+    return report
 
 
 def results_markdown(report: Dict[str, Any]) -> str:

@@ -17,6 +17,21 @@ Three spaces ship:
 * ``page_block`` — the paged KV-cache page size: candidates are the
   power-of-two blocks; ``PagePool(page_block=None)`` consults the winner
   and validates divisibility against its own ``max_len``/``cache_bucket``.
+* ``fusion`` — per certified :func:`analysis.dataflow.fusable_groups`
+  group: fuse into one dispatch region, or don't. The candidate set is
+  binary but the key is rich — program signature + feed shape family +
+  group signature per ``(group kind, device_kind)`` — and entries carry
+  the dependence certificate they were measured under, so a consult can
+  refuse anything the current program no longer proves
+  (tune/fusion.py; the MEASURED-ONLY gate of ROADMAP item 3c).
+* ``bucket_grid`` — the prompt/cache bucket grids serving compiles
+  against: candidates are whole grids; the measured cost of a grid is
+  the replayed dispatch time of a deterministic length sample plus the
+  compile cost of every distinct bucket the sample touches — the
+  compile-count × padding-waste tradeoff measured instead of guessed.
+  ``PagePool(prompt_buckets=None / cache_bucket=None)`` and
+  ``BucketSpec({"feed": "tuned"})`` consult the winner with legality
+  validation (ascending, positive, bounded by the caller's max_len).
 
 Every space carries a static ``SPACE_DEFS`` literal; :func:`space_hash`
 digests it. Entries persist the hash they were tuned under, so a code
@@ -47,6 +62,22 @@ SPACE_DEFS: Dict[str, Dict[str, Any]] = {
     "page_block": {
         "version": 1,
         "blocks": [16, 32, 64, 128],
+    },
+    "fusion": {
+        "version": 1,
+        "kinds": ["elementwise_chain", "producer_consumer"],
+        "plan": "fuse",
+    },
+    "bucket_grid": {
+        "version": 1,
+        "kinds": ["prompt", "cache"],
+        "grids": {
+            "prompt": [[32, 64, 128, 256, 512], [64, 128, 256, 512],
+                       [64, 256, 512], [128, 256, 512],
+                       [32, 64, 128, 256], [256, 512]],
+            "cache": [[128, 256, 512, 1024], [256, 512, 1024],
+                      [256, 1024], [512, 1024]],
+        },
     },
 }
 
@@ -118,6 +149,9 @@ PROFILES: Dict[str, Dict[str, Any]] = {
                    "d_head": 8, "note": "smoke"},
         "page_block": {"read_pages": 4, "batch": 2, "n_heads": 2,
                        "d_head": 8, "blocks": [16, 32], "note": "smoke"},
+        "fusion": {"batch": 8, "width": 16, "depth": 2, "note": "smoke"},
+        "bucket_grid": {"batch": 2, "d_model": 16, "max_len": 128,
+                        "samples": 16, "zipf_a": 1.2, "note": "smoke"},
     },
     "cpu": {
         "reps": 2,
@@ -135,6 +169,13 @@ PROFILES: Dict[str, Dict[str, Any]] = {
         "page_block": {"read_pages": 8, "batch": 4, "n_heads": 4,
                        "d_head": 8, "blocks": [16, 32, 64],
                        "note": "serving-dims proxy"},
+        # MLP-with-epilogues proxy: carries both certified group kinds
+        # (fc->act producer_consumer epilogues + scale/add chains)
+        "fusion": {"batch": 32, "width": 64, "depth": 3,
+                   "note": "mlp proxy"},
+        "bucket_grid": {"batch": 4, "d_model": 64, "max_len": 512,
+                        "samples": 48, "zipf_a": 1.2,
+                        "note": "serving-dims proxy"},
     },
     "bench": {
         "reps": 3,
@@ -151,5 +192,10 @@ PROFILES: Dict[str, Dict[str, Any]] = {
         "page_block": {"read_pages": 16, "batch": 8, "n_heads": 12,
                        "d_head": 64, "blocks": [16, 32, 64, 128],
                        "note": "gpt2s decode"},
+        "fusion": {"batch": 256, "width": 256, "depth": 4,
+                   "note": "mlp bench dims"},
+        "bucket_grid": {"batch": 8, "d_model": 768, "max_len": 2048,
+                        "samples": 96, "zipf_a": 1.2,
+                        "note": "gpt2s serving"},
     },
 }

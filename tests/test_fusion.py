@@ -1,0 +1,410 @@
+"""The measured-only graph fusion pass (tune/fusion.py + executor wiring)
+and the bucket_grid consult (ROADMAP item 3c — "spending the oracle").
+
+Contracts under test:
+
+* PARITY — the tentpole invariant: a fused region changes dispatch
+  structure, never numerics. Forced fusion of every schedulable certified
+  group leaves multi-step fetches AND the parameter trajectory bit-equal
+  to the unfused run, composing with donation and bucketing; randomized
+  elementwise-chain programs sweep the same invariant.
+* MEASURED-ONLY GATE — with no cache entry nothing fuses; a measured
+  ``fuse: true`` entry activates (counted on
+  ``fluid.fused_regions_total{source=tuned}``); a measured loser, a stale
+  space hash, or a tampered certificate refuses with the right reason on
+  ``fluid.fusion_rejected_total``.
+* SCHEDULABILITY — a certified group whose members straddle an
+  interfering producer is refused (``not_schedulable``), even forced.
+* ACCOUNTING — fusion lives inside the one jit: AOT cost-analysis FLOPs
+  are identical fused vs unfused (MFU honesty).
+* BUCKET_GRID — consult legality validation, ``PagePool`` /
+  ``BucketSpec("tuned")`` integration.
+* LINT + CLI — L008 flags fusion/bucket_grid entry corruption;
+  ``paddle_tpu tune --from-ledger --check`` closes the seeded loop.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import obs, tune
+from paddle_tpu.fluid.executor import Executor, Scope
+from paddle_tpu.tune import fusion as F
+
+
+@pytest.fixture
+def tune_cache():
+    c = tune.AutotuneCache()
+    tune.set_cache(c)
+    yield c
+    tune.reset()
+
+
+def _proxy(batch=8, width=16, depth=2, seed=0):
+    return F.build_proxy_program(batch=batch, width=width, depth=depth,
+                                 seed=seed)
+
+
+def _param_names(program):
+    return sorted(n for n, v in program.blocks[0].vars.items()
+                  if v.persistable)
+
+
+def _run_steps(main, startup, feed, fetch, fuse, *, n=4, donate=None,
+               buckets=None):
+    """(fetches per step, final persistable values) for one fresh scope."""
+    exe = Executor(scope=Scope(), fuse=fuse, buckets=buckets)
+    exe.run(startup)
+    outs = [np.asarray(exe.run(main, feed=feed, fetch_list=fetch,
+                               donate=donate)[0]) for _ in range(n)]
+    params = {p: np.asarray(exe.scope.get(p)) for p in _param_names(main)
+              if exe.scope.has(p)}
+    return outs, params
+
+
+def _assert_bit_equal(a, b):
+    outs_a, params_a = a
+    outs_b, params_b = b
+    for x, y in zip(outs_a, outs_b):
+        assert x.tobytes() == y.tobytes()
+    assert params_a.keys() == params_b.keys() and params_a
+    for k in params_a:
+        assert params_a[k].tobytes() == params_b[k].tobytes(), k
+
+
+def _put_measured(cache, program, feed, rows_or_groups, fuse=True,
+                  space_hash=None, mangle_cert=None):
+    """Drop fusion entries for every certified group of ``program``."""
+    prog_sig = F.program_signature(program)
+    shp = F.shape_family({k: np.shape(v) for k, v in feed.items()})
+    for g in rows_or_groups:
+        cert = F.certificate(program, g)
+        fam = F.fusion_family(prog_sig, shp, F.group_signature(cert))
+        if mangle_cert is not None:
+            cert = mangle_cert(cert)
+        cache.put("fusion", g.kind, "cpu", fam, {"fuse": fuse},
+                  space_hash or tune.space_hash("fusion"),
+                  certificate=cert, program_signature=prog_sig,
+                  shape_family=shp, methodology="measured")
+
+
+# -- parity: the tentpole invariant --------------------------------------
+
+def test_forced_fusion_multi_step_bit_parity():
+    main, startup, feed, fetch = _proxy()
+    groups = F._certified(main, list(feed), fetch)
+    assert groups, "proxy program must certify at least one group"
+    un = _run_steps(main, startup, feed, fetch, False)
+    fu = _run_steps(main, startup, feed, fetch, True)
+    _assert_bit_equal(un, fu)
+    # per-group forcing (the measurement harness knob) holds too
+    one = _run_steps(main, startup, feed, fetch,
+                     frozenset({groups[0].op_idxs[0]}))
+    _assert_bit_equal(un, one)
+
+
+def test_forced_fusion_parity_composes_with_donation():
+    main, startup, feed, fetch = _proxy()
+    un = _run_steps(main, startup, feed, fetch, False, donate=True)
+    fu = _run_steps(main, startup, feed, fetch, True, donate=True)
+    _assert_bit_equal(un, fu)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_randomized_elementwise_chain_parity(seed):
+    """Randomized straight-line elementwise programs: whatever the oracle
+    certifies, forcing it is bit-invisible (with bucketing in the loop —
+    the fused plan joins the compiled-fn key next to the bucket pad)."""
+    rs = np.random.RandomState(seed)
+    fluid.reset_default_programs()
+    width = int(rs.randint(4, 12))
+    x = fluid.layers.data("rx", shape=(width,))
+    binops = [fluid.layers.elementwise_add, fluid.layers.elementwise_sub,
+              fluid.layers.elementwise_mul]
+    h = fluid.layers.fc(x, width, act="relu")
+    for _ in range(int(rs.randint(2, 6))):
+        h = binops[rs.randint(len(binops))](h, x)
+    loss = fluid.layers.mean(h)
+    fluid.SGDOptimizer(1e-2).minimize(loss)
+    main, startup = (fluid.default_main_program(),
+                     fluid.default_startup_program())
+    feed = {"rx": rs.randn(6, width).astype(np.float32)}
+    fetch = [loss.name]
+    buckets = {"rx": {"axis": 0, "buckets": (8, 16)}}
+    un = _run_steps(main, startup, feed, fetch, False, buckets=buckets)
+    fu = _run_steps(main, startup, feed, fetch, True, buckets=buckets)
+    _assert_bit_equal(un, fu)
+
+
+# -- the measured-only gate ----------------------------------------------
+
+def _counter(reg, name):
+    return sum(v for _, v in reg.counter(name).samples())
+
+
+def _labeled(reg, name):
+    return {dict(lbls).get(next(iter(dict(lbls)), ""), ""): v
+            for lbls, v in reg.counter(name).samples()}
+
+
+def test_no_entry_means_no_fusion(tune_cache):
+    main, startup, feed, fetch = _proxy()
+    plan = F.plan_for(main, {k: v.shape for k, v in feed.items()},
+                      fetch=fetch, feed=list(feed))
+    # the executor's cheap pre-gate never even analyzes: empty cache
+    assert not F.cache_has_fusion_entries("cpu")
+    assert plan.groups == [] or plan.source != "tuned" or not plan.groups
+
+
+def test_measured_winner_activates_with_counters(tune_cache):
+    main, startup, feed, fetch = _proxy()
+    groups = F._certified(main, list(feed), fetch)
+    _put_measured(tune_cache, main, feed, groups, fuse=True)
+    assert F.cache_has_fusion_entries("cpu")
+    reg = obs.MetricsRegistry()
+    with obs.ObsSession(registry=reg).installed():
+        un = _run_steps(main, startup, feed, fetch, False)
+        fu = _run_steps(main, startup, feed, fetch, None)   # consults
+    _assert_bit_equal(un, fu)
+    assert _counter(reg, "fluid.fused_regions_total") == len(groups)
+    assert _counter(reg, "fluid.fusion_rejected_total") == 0
+
+
+def test_measured_loser_refuses_measured_slower(tune_cache):
+    main, startup, feed, fetch = _proxy()
+    groups = F._certified(main, list(feed), fetch)
+    _put_measured(tune_cache, main, feed, groups, fuse=False)
+    plan = F.plan_for(main, {k: v.shape for k, v in feed.items()},
+                      fetch=fetch, feed=list(feed))
+    assert plan.groups == []
+    assert {r for _, r in plan.rejected} == {"measured_slower"}
+    assert len(plan.rejected) == len(groups)
+
+
+def test_stale_space_hash_refused(tune_cache):
+    main, startup, feed, fetch = _proxy()
+    groups = F._certified(main, list(feed), fetch)
+    _put_measured(tune_cache, main, feed, groups, fuse=True,
+                  space_hash="deadbeef0000")
+    plan = F.plan_for(main, {k: v.shape for k, v in feed.items()},
+                      fetch=fetch, feed=list(feed))
+    assert plan.groups == []
+    assert {r for _, r in plan.rejected} == {"stale"}
+
+
+def test_tampered_certificate_refused(tune_cache):
+    main, startup, feed, fetch = _proxy()
+    groups = F._certified(main, list(feed), fetch)
+
+    def swap_an_op(cert):
+        cert = dict(cert, op_types=list(cert["op_types"]))
+        cert["op_types"][0] = "matmul"       # an op swapped in place
+        return cert
+
+    _put_measured(tune_cache, main, feed, groups, fuse=True,
+                  mangle_cert=swap_an_op)
+    plan = F.plan_for(main, {k: v.shape for k, v in feed.items()},
+                      fetch=fetch, feed=list(feed))
+    assert plan.groups == []
+    assert {r for _, r in plan.rejected} == {"cert_invalid"}
+
+
+def test_unschedulable_interleaved_producer_refused():
+    """a = relu(x); b = matmul(x, w); c = add(a, b): {relu, add} may
+    certify as a chain, but hoisting add to relu's slot would read b
+    before it exists — region_schedulable must refuse, and forcing must
+    honor the refusal (correctness beats the knob)."""
+    from paddle_tpu.analysis import region_schedulable
+    from paddle_tpu.analysis.dataflow import fusable_groups
+    fluid.reset_default_programs()
+    x = fluid.layers.data("ux", shape=(4,))
+    w = fluid.layers.data("uw", shape=(4,))
+    a = fluid.layers.activation(x, "relu")
+    b = fluid.layers.elementwise_mul(x, w)       # interferes: writes b
+    c = fluid.layers.elementwise_add(a, b)
+    out = fluid.layers.mean(c)
+    main = fluid.default_main_program()
+    block = main.blocks[0]
+    groups = fusable_groups(main, fetch=[out.name],
+                            feed=["ux", "uw"])
+    straddling = [g for g in groups
+                  if g.op_idxs[-1] - g.op_idxs[0] + 1 > len(g.op_idxs)]
+    for g in straddling:
+        assert not region_schedulable(block, g)
+    plan = F.plan_for(main, {"ux": (2, 4), "uw": (2, 4)},
+                      fetch=[out.name], feed=["ux", "uw"], force=True)
+    for g in plan.groups:     # whatever force activated is convex
+        assert g.op_idxs[-1] - g.op_idxs[0] + 1 == len(g.op_idxs)
+
+
+def test_fused_flops_equal_unfused():
+    """Fusion stays inside the one jit, so the roofline ledger's AOT
+    cost-analysis FLOPs are untouched — the MFU denominator can't be
+    gamed by regrouping ops."""
+    main, startup, feed, fetch = _proxy()
+
+    def flops(fuse):
+        reg = obs.MetricsRegistry()
+        with obs.ObsSession(registry=reg).installed():
+            _run_steps(main, startup, feed, fetch, fuse, n=2)
+        return _counter(reg, "fluid.device_flops_total")
+
+    f_un, f_fu = flops(False), flops(True)
+    assert f_un > 0
+    assert f_un == f_fu
+
+
+def test_measure_fusion_rows_and_e2e_consult(tune_cache):
+    main, startup, feed, fetch = _proxy()
+    rows = F.measure_fusion(main, startup, feed, fetch, reps=1, note="t")
+    assert rows
+    for r in rows:
+        assert r["space"] == "fusion"
+        assert isinstance(r["plan"]["fuse"], bool)
+        assert r["heuristic_plan"] == {"fuse": False}
+        assert r["fused_ms"] > 0 and r["unfused_ms"] > 0
+        assert r["certificate"]["op_types"]
+        # the family's third component re-derives from the certificate
+        assert r["family"].split(":")[2] == F.group_signature(
+            r["certificate"])
+        tune_cache.put(r["space"], r["kernel"], "cpu", r["family"],
+                       r["plan"], tune.space_hash("fusion"),
+                       certificate=r["certificate"],
+                       program_signature=r["program_signature"],
+                       shape_family=r["shape_family"])
+    plan = F.plan_for(main, {k: v.shape for k, v in feed.items()},
+                      fetch=fetch, feed=list(feed))
+    # every persisted verdict resolves: winners activate, losers refuse
+    wins = sum(1 for r in rows if r["plan"]["fuse"])
+    assert len(plan.groups) == wins
+    assert len(plan.rejected) == len(rows) - wins
+    assert all(reason == "measured_slower" for _, reason in plan.rejected)
+
+
+# -- bucket_grid ---------------------------------------------------------
+
+def _put_grid(cache, kind, buckets, space_hash=None):
+    cache.put("bucket_grid", "prefill_dispatch", "cpu", kind,
+              {"buckets": list(buckets)},
+              space_hash or tune.space_hash("bucket_grid"),
+              methodology="measured")
+
+
+def test_bucket_grid_consult_validation(tune_cache):
+    assert tune.bucket_grid("prompt") is None          # no entry
+    _put_grid(tune_cache, "prompt", [32, 64, 256])
+    assert tune.bucket_grid("prompt") == (32, 64, 256)
+    assert tune.bucket_grid("prompt", max_len=128) == (32, 64)
+    assert tune.bucket_grid("prompt", max_len=16) is None   # emptied
+    assert tune.bucket_grid("prompt", divisor=64) is None   # 32 % 64 != 0
+    assert tune.bucket_grid("prompt", divisor=32) == (32, 64, 256)
+    # illegal grids are refused whole
+    _put_grid(tune_cache, "cache", [64, 32])          # not ascending
+    assert tune.bucket_grid("cache") is None
+    _put_grid(tune_cache, "cache", [])                # empty
+    assert tune.bucket_grid("cache") is None
+    _put_grid(tune_cache, "cache", [0, 32])           # non-positive
+    assert tune.bucket_grid("cache") is None
+    _put_grid(tune_cache, "cache", [128, 256], space_hash="0ld")
+    assert tune.bucket_grid("cache") is None          # stale
+
+
+def test_pagepool_and_bucketspec_consult(tune_cache,
+                                         paged_model_and_params):
+    from paddle_tpu.data.feeder import BucketSpec
+    from paddle_tpu.serving import PagePool
+    model, params = paged_model_and_params
+    # no entries: the heuristic defaults
+    pool = PagePool(model, params, slots=2)
+    assert pool.cache_bucket == 256
+    assert tuple(pool.prompt_buckets) == (32, 64, 128, 256, 512)
+    spec = BucketSpec({"words": "tuned"})
+    assert spec.spec["words"][1] == (32, 64, 128, 256, 512)
+    # tuned entries: consulted with max_len validation (model.max_len=128)
+    _put_grid(tune_cache, "prompt", [32, 64, 128, 512])
+    _put_grid(tune_cache, "cache", [64, 128])
+    pool = PagePool(model, params, slots=2)
+    assert tuple(pool.prompt_buckets) == (32, 64, 128)   # 512 > max_len
+    assert pool.cache_bucket == 128                      # grid[-1]
+    assert BucketSpec({"words": "tuned"}).spec["words"][1] \
+        == (32, 64, 128, 512)
+    # explicit args always win over the cache
+    pool = PagePool(model, params, slots=2, cache_bucket=64,
+                    prompt_buckets=(16, 32))
+    assert pool.cache_bucket == 64
+    assert tuple(pool.prompt_buckets) == (16, 32)
+
+
+# -- lint + CLI ----------------------------------------------------------
+
+def test_l008_fusion_and_bucket_grid_findings(tmp_path, tune_cache):
+    from paddle_tpu.analysis import lint_autotune_cache
+    main, startup, feed, fetch = _proxy()
+    groups = F._certified(main, list(feed), fetch)
+    # a healthy cache: clean
+    _put_measured(tune_cache, main, feed, groups, fuse=True)
+    _put_grid(tune_cache, "prompt", [32, 64])
+    path = tune_cache.save(str(tmp_path / "ok.json"))
+    assert lint_autotune_cache(path) == []
+    # tampered certificate: the family key no longer re-derives
+    c2 = tune.AutotuneCache()
+    _put_measured(c2, main, feed, groups[:1], fuse=True,
+                  mangle_cert=lambda cert: dict(
+                      cert, op_types=["matmul"] + list(
+                          cert["op_types"])[1:]))
+    diags = lint_autotune_cache(c2.save(str(tmp_path / "cert.json")))
+    assert len(diags) == 1 and diags[0].code == "L008"
+    assert "does not re-derive" in diags[0].message
+    # missing certificate / bad plan / bad grid
+    c3 = tune.AutotuneCache()
+    c3.put("fusion", "elementwise_chain", "cpu", "a:b:c",
+           {"fuse": True}, tune.space_hash("fusion"))
+    c3.put("fusion", "elementwise_chain", "cpu", "a:b:d",
+           {"fuse": "yes"}, tune.space_hash("fusion"),
+           certificate={"kind": "elementwise_chain"})
+    c3.put("bucket_grid", "prefill_dispatch", "cpu", "prompt",
+           {"buckets": [64, 32]}, tune.space_hash("bucket_grid"))
+    diags = lint_autotune_cache(c3.save(str(tmp_path / "bad.json")))
+    msgs = " | ".join(d.message for d in diags)
+    assert len(diags) == 3
+    assert "no dependence certificate" in msgs
+    assert "expected {'fuse': true|false}" in msgs
+    assert "ascending unique positive ints" in msgs
+    # the standalone CLI path exits nonzero on the findings
+    from paddle_tpu.cli import main as cli_main
+    assert cli_main(["lint", "--autotune-cache",
+                     str(tmp_path / "bad.json"),
+                     "--fail-on", "warning"]) == 1
+
+
+def test_tune_from_ledger_check_smoke(tmp_path, capsys):
+    """`paddle_tpu tune --from-ledger --check`: synthetic profile sites
+    seed the sweep (only implicated spaces run), the seeded families
+    count on the obs plane, and the measured loop still closes."""
+    from paddle_tpu.cli import main as cli_main
+    sites = [{"op": "b0_op5_fused_elementwise_chain", "self_ns": 900000},
+             {"op": "b0_op9_paged_decode_attention", "self_ns": 400000},
+             {"op": "b0_op2_layer_norm", "self_ns": 10}]
+    ledger = tmp_path / "sites.json"
+    ledger.write_text(json.dumps(sites))
+    path = str(tmp_path / "autotune.json")
+    reg = obs.MetricsRegistry()
+    with obs.ObsSession(registry=reg).installed():
+        rc = cli_main(["tune", "--check", "--cache", path,
+                       "--from-ledger", str(ledger)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "--check OK" in out
+    assert "implicate spaces ['fusion', 'page_block']" in out
+    cache = tune.load_cache(path)
+    spaces = {e["space"] for e in cache.entries.values()}
+    assert spaces == {"fusion", "page_block"}     # seeding restricted
+    assert _counter(reg, "tune.ledger_seeded_families_total") > 0
+    # fusion entries persisted the full consult payload
+    for e in cache.entries.values():
+        if e["space"] == "fusion":
+            assert isinstance(e["certificate"], dict)
+            assert e["program_signature"]
+            assert isinstance(e["plan"]["fuse"], bool)
